@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Benchmark: training throughput of the flagship config on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: point-pairs/sec/chip for the reference training configuration
+(8,192 points, 8 GRU iterations, full train step incl. backward+Adam).
+
+Baseline (BASELINE.md): the reference trains 20 epochs x 17,640 samples in
+~53 h on 2x RTX 2080 Ti => 1.849 samples/s total, 0.925 samples/s per GPU
+= 7,575 point-pairs/s per GPU at 8,192 points/sample. vs_baseline is our
+per-chip rate over that per-GPU rate.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_PAIRS_PER_SEC_PER_CHIP = 17640 * 20 / (53 * 3600) / 2 * 8192  # ~7575
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.models import PVRaft
+
+    n_points = 8192
+    iters = 8
+    batch = 2  # reference run.sh batch size
+
+    cfg = ModelConfig(truncate_k=512)
+    model = PVRaft(cfg)
+
+    rng = np.random.default_rng(0)
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (batch, n_points, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (batch, n_points, 3)).astype(np.float32))
+    gt = pc2 - pc1
+    mask = jnp.ones((batch, n_points), jnp.float32)
+
+    params = model.init(jax.random.key(0), pc1[:, :256], pc2[:, :256], 2)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, pc1, pc2, mask, gt):
+        def loss_fn(p):
+            flows, _ = model.apply(p, pc1, pc2, iters)
+            return sequence_loss(flows, mask, gt, 0.8)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # Warmup / compile.
+    params, opt_state, loss = step(params, opt_state, pc1, pc2, mask, gt)
+    jax.block_until_ready(loss)
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, pc1, pc2, mask, gt)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / n_steps
+
+    pairs_per_sec = batch * n_points / dt
+    print(
+        json.dumps(
+            {
+                "metric": "train_point_pairs_per_sec_per_chip",
+                "value": round(pairs_per_sec, 1),
+                "unit": "point-pairs/s/chip (8192 pts, 8 iters, bs=2, fwd+bwd+adam)",
+                "vs_baseline": round(
+                    pairs_per_sec / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""pvraft_tpu — a TPU-native scene-flow framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of PV-RAFT
+(CVPR 2021, reference snapshot at /root/reference): RAFT-style iterative
+scene-flow estimation on point clouds with a truncated point-voxel
+correlation volume.
+
+Design stance (TPU-first, not a port):
+  * channel-last ``(B, N, C)`` layout — every 1x1 conv of the reference is a
+    Dense layer, i.e. a single MXU matmul;
+  * static shapes end-to-end (the reference's exact-N sampling,
+    ``datasets/generic.py:101-110``, makes this natural);
+  * the GRU refinement loop is a ``lax.scan`` with ``stop_gradient``
+    replacing per-iteration ``.detach()`` (``model/RAFTSceneFlow.py:41``);
+  * the correlation cache is an explicit functional ``CorrState`` pytree
+    instead of module-state mutation (``model/corr.py:31-42``);
+  * torch-scatter's voxel binning role (``model/corr.py:50,64-65``) is a
+    Pallas TPU kernel with a pure-XLA fallback;
+  * data parallelism is ``jax.sharding`` over a device mesh with XLA
+    collectives, replacing ``nn.DataParallel`` (``tools/engine.py:63-64``).
+"""
+
+__version__ = "0.1.0"

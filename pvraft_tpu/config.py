@@ -1,0 +1,123 @@
+"""Typed configuration for pvraft_tpu.
+
+One dataclass consumed by both the train and test entry points, replacing the
+duplicated argparse blocks of the reference (``train.py:8-71``,
+``test.py:20-67``). Defaults follow the canonical hyperparameters in the
+reference ``run.sh:2-8`` and the model-internal constants
+(hidden/context = 64 ``model/RAFTSceneFlow.py:13-14``, knn = 32
+``model/corr.py:9``, encoder width 32 ``model/extractor.py:10``, graph k = 32
+``model/extractor.py:8``, lr = 1e-3 ``tools/engine.py:57``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters of the PV-RAFT flagship model."""
+
+    # Correlation volume (reference flags: train.py:24-39).
+    truncate_k: int = 512          # top-k kept of the all-pairs correlation
+    corr_levels: int = 3           # voxel pyramid levels
+    base_scale: float = 0.25       # voxel edge at level 0
+    resolution: int = 3            # local cube resolution (3x3x3 = 27 bins)
+    corr_knn: int = 32             # k of the point-branch knn lookup
+
+    # Encoder / update loop (model/RAFTSceneFlow.py:13-14, extractor.py:8-10).
+    graph_k: int = 32              # neighbors of the DGCNN graph
+    encoder_width: int = 32        # first SetConv width (doubles per layer)
+    hidden_dim: int = 64           # GRU hidden state
+    context_dim: int = 64          # context features
+    feature_dim: int = 128         # encoder output channels
+
+    # Numerics.
+    compute_dtype: str = "float32"   # "bfloat16" for the fast path
+    use_pallas: bool = False         # Pallas voxel kernel vs XLA fallback
+    corr_chunk: Optional[int] = None  # chunked/streaming top-k over N2 if set
+    remat: bool = False              # rematerialize each GRU iteration
+
+    def __post_init__(self):
+        if self.corr_knn > self.truncate_k:
+            raise ValueError(
+                f"corr_knn ({self.corr_knn}) must be <= truncate_k "
+                f"({self.truncate_k}): the kNN branch selects among the "
+                f"truncated correlation candidates"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset selection and sampling (reference train.py:12-23)."""
+
+    dataset: str = "FT3D"          # FT3D | KITTI | synthetic
+    root: str = ""                 # preprocessed dataset root
+    max_points: int = 8192         # exact-N sampling target
+    num_workers: int = 8           # host-side prefetch threads
+    synthetic_size: int = 64       # samples in the synthetic dataset
+    # Use the C++ batch assembler (pvraft_tpu/native) when the dataset
+    # supports it and the library builds; falls back to numpy otherwise.
+    native_loader: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Optimization schedule (reference train.py:40-67, tools/engine.py:57-58)."""
+
+    batch_size: int = 2
+    num_epochs: int = 20
+    lr: float = 1e-3
+    gamma: float = 0.8             # sequence-loss decay (tools/loss.py:9)
+    iters: int = 8                 # GRU iterations during training
+    eval_iters: int = 32           # GRU iterations at val/test (engine.py:198)
+    checkpoint_interval: int = 5
+    refine: bool = False           # stage-2 (frozen backbone) training
+    seed: int = 0
+    # The reference steps CosineAnnealingLR(T_max=epochs*len(dataset)) once
+    # per *epoch* (tools/engine.py:58,168) — effectively a near-constant LR.
+    # "parity" reproduces that; "cosine" is the corrected per-step schedule.
+    lr_schedule: str = "parity"
+    # When set, epoch 0 runs under jax.profiler.trace writing a
+    # TensorBoard-viewable profile here (SURVEY.md §5 tracing).
+    profile_dir: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Device-mesh layout. Replaces nn.DataParallel (tools/engine.py:63-64)."""
+
+    data_axis: int = -1            # -1: all devices on the data axis
+    seq_axis: int = 1              # sequence-parallel shards of the N2 axis
+    donate: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    exp_path: str = "experiments/default"
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def compute_dtype(cfg: ModelConfig):
+    """jnp dtype for matmul compute, or None for full float32."""
+    import jax.numpy as jnp
+
+    if cfg.compute_dtype in ("float32", "f32", None):
+        return None
+    return jnp.dtype(cfg.compute_dtype)
+
+
+def tiny_config(n_points: int = 256, truncate_k: int = 64, iters: int = 2) -> Config:
+    """A small config for tests and CI (the "FT3D tiny" slice)."""
+    return Config(
+        model=ModelConfig(truncate_k=truncate_k),
+        data=DataConfig(dataset="synthetic", max_points=n_points, synthetic_size=8),
+        train=TrainConfig(batch_size=2, num_epochs=1, iters=iters, eval_iters=iters),
+    )

@@ -1,0 +1,15 @@
+from pvraft_tpu.data.generic import SceneFlowDataset, batches, collate
+from pvraft_tpu.data.synthetic import SyntheticDataset
+from pvraft_tpu.data.flyingthings3d import FT3D
+from pvraft_tpu.data.kitti import KITTI
+from pvraft_tpu.data.loader import PrefetchLoader
+
+__all__ = [
+    "SceneFlowDataset",
+    "batches",
+    "collate",
+    "SyntheticDataset",
+    "FT3D",
+    "KITTI",
+    "PrefetchLoader",
+]

@@ -1,0 +1,103 @@
+"""Dataset base and batch collation.
+
+Host-side numpy pipeline replacing ``datasets/generic.py``. Key behavior
+preserved:
+
+  * exact-N sampling — random permutation subsample to ``nb_points``
+    (``generic.py:181-191``) and reject-and-advance when a sample has fewer
+    points (``generic.py:101-110``): walk to the next index until one with
+    at least ``nb_points`` is found. This guarantees static device shapes,
+    which is exactly what XLA wants;
+  * items are dicts of float32 arrays: ``pc1 (N,3)``, ``pc2 (M,3)``,
+    ``mask (N,)``, ``flow (N,3)``;
+  * ``collate`` stacks items along a new leading batch axis (the reference
+    ``Batch`` concatenated pre-unsqueezed tensors, ``generic.py:21-27``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+Item = Dict[str, np.ndarray]
+
+
+class SceneFlowDataset:
+    """Base class: subclasses implement ``load_sequence(idx)`` returning
+    ``(pc1, pc2, mask, flow)`` with variable point counts."""
+
+    def __init__(self, nb_points: int, seed: Optional[int] = None):
+        self.nb_points = int(nb_points)
+        self._seed = 0 if seed is None else int(seed)
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the subsample randomness between epochs. Subsampling is
+        seeded per (seed, epoch, idx) so items are deterministic and
+        thread-safe under the prefetching loader, while still being
+        resampled every epoch like the reference's stateful np.random
+        (``generic.py:183-190``)."""
+        self._epoch = int(epoch)
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def load_sequence(self, idx: int):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _subsample(self, arr: np.ndarray, n: int, perm: np.ndarray) -> np.ndarray:
+        return arr[perm[:n]]
+
+    def __getitem__(self, idx: int) -> Item:
+        # Reject-and-advance until a sample with enough points is found
+        # (generic.py:101-110 walked idx+1 on exact-size mismatch).
+        for probe in range(len(self)):
+            j = (idx + probe) % len(self)
+            pc1, pc2, mask, flow = self.load_sequence(j)
+            if pc1.shape[0] >= self.nb_points and pc2.shape[0] >= self.nb_points:
+                break
+        else:
+            raise RuntimeError("no sample with enough points")
+
+        n = self.nb_points
+        rng = np.random.default_rng((self._seed, self._epoch, j))
+        perm1 = rng.permutation(pc1.shape[0])
+        perm2 = rng.permutation(pc2.shape[0])
+        return {
+            "pc1": self._subsample(pc1, n, perm1).astype(np.float32),
+            "pc2": self._subsample(pc2, n, perm2).astype(np.float32),
+            "mask": self._subsample(mask, n, perm1).astype(np.float32),
+            "flow": self._subsample(flow, n, perm1).astype(np.float32),
+        }
+
+
+def collate(items: Sequence[Item]) -> Item:
+    """Stack items into (B, ...) arrays."""
+    return {k: np.stack([it[k] for it in items], axis=0) for k in items[0]}
+
+
+def batches(
+    dataset: SceneFlowDataset,
+    batch_size: int,
+    shuffle: bool = False,
+    drop_last: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+) -> Iterator[Item]:
+    """Lazy epoch iterator; one collated batch at a time.
+
+    ``epoch`` is folded into the shuffle seed so successive epochs see
+    different orders (the reference got this from DataLoader's per-epoch
+    reshuffle). See ``pvraft_tpu.data.loader`` for the threaded
+    prefetching version used by the Trainer.
+    """
+    dataset.set_epoch(epoch)
+    order = np.arange(len(dataset))
+    if shuffle:
+        np.random.default_rng((seed, epoch)).shuffle(order)
+    for start in range(0, len(order), batch_size):
+        idx = order[start : start + batch_size]
+        if len(idx) < batch_size and drop_last:
+            break
+        yield collate([dataset[int(i)] for i in idx])

@@ -1,0 +1,181 @@
+"""Threaded prefetching batch loader.
+
+Host-side replacement for the reference's ``DataLoader(num_workers=8)``
+(``tools/engine.py:43-48``). Three paths:
+
+  * ``num_workers=0`` — serial numpy loading;
+  * threaded — python threads release the GIL inside numpy IO;
+  * native — the C++ batch assembler (``pvraft_tpu/native/npy_loader.cc``)
+    reads and subsamples scenes with a thread pool into preallocated
+    arrays (opt-in; available for datasets exposing ``native_paths``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from pvraft_tpu.data.generic import Item, SceneFlowDataset, collate
+
+
+class PrefetchLoader:
+    """Iterate collated batches with worker threads and a bounded queue."""
+
+    def __init__(
+        self,
+        dataset: SceneFlowDataset,
+        batch_size: int,
+        shuffle: bool = False,
+        drop_last: bool = True,
+        num_workers: int = 4,
+        prefetch: int = 4,
+        seed: int = 0,
+        native: bool = False,
+        native_max_rows: int = 400_000,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.num_workers = max(0, num_workers)
+        self.prefetch = prefetch
+        self.seed = seed
+        self.native_max_rows = native_max_rows
+        self.native = False
+        if native and hasattr(dataset, "native_paths"):
+            try:
+                from pvraft_tpu import native as native_mod
+
+                self.native = native_mod.native_available()
+            except Exception:
+                self.native = False
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def epoch(self, epoch: int = 0) -> Iterator[Item]:
+        self.dataset.set_epoch(epoch)
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.default_rng((self.seed, epoch)).shuffle(order)
+        starts = list(range(0, len(order), self.batch_size))
+        if self.drop_last:
+            starts = [s for s in starts if s + self.batch_size <= len(order)]
+
+        if self.native:
+            yield from self._native_epoch(order, starts, epoch)
+        elif self.num_workers == 0:
+            for s in starts:
+                idx = order[s : s + self.batch_size]
+                yield collate([self.dataset[int(i)] for i in idx])
+        else:
+            yield from self._threaded_epoch(order, starts)
+
+    # -- native path --------------------------------------------------------
+
+    def _native_epoch(self, order, starts, epoch: int) -> Iterator[Item]:
+        """C++ batch assembly: threaded npy reads + subsampling into
+        preallocated arrays. The reject-and-advance policy
+        (``generic.py:101-110``) is applied by re-requesting undersized
+        scenes at idx+1."""
+        from pvraft_tpu import native as native_mod
+
+        ds = self.dataset
+        n_pts = ds.nb_points
+        threads = max(1, self.num_workers)
+        for s in starts:
+            idxs = [int(i) for i in order[s : s + self.batch_size]]
+            for _attempt in range(len(ds) + 1):
+                triples = [ds.native_paths(j) for j in idxs]
+                pc1, pc2, mask, flow, status = native_mod.load_scene_batch(
+                    [t[0] for t in triples],
+                    [t[1] for t in triples],
+                    idxs,
+                    n_pts,
+                    self.native_max_rows,
+                    seed=ds._seed,
+                    epoch=epoch,
+                    flip_xz=triples[0][2],
+                    n_threads=threads,
+                )
+                if np.any(status < 0):
+                    bad = int(np.argmax(status < 0))
+                    raise IOError(
+                        f"native loader failed on {triples[bad][0]} "
+                        f"(status {int(status[bad])})"
+                    )
+                if np.all(status == 1):
+                    break
+                idxs = [
+                    j if st == 1 else (j + 1) % len(ds)
+                    for j, st in zip(idxs, status)
+                ]
+            else:
+                raise RuntimeError("no scene with enough points")
+            yield {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": flow}
+
+    # -- threaded python path ------------------------------------------------
+
+    def _threaded_epoch(self, order, starts) -> Iterator[Item]:
+        todo: "queue.Queue[Optional[int]]" = queue.Queue()
+        done: "dict[int, Item]" = {}
+        done_lock = threading.Condition()
+        errors: list[BaseException] = []
+
+        for rank, _ in enumerate(starts):
+            todo.put(rank)
+        for _ in range(self.num_workers):
+            todo.put(None)
+
+        def worker():
+            while True:
+                rank = todo.get()
+                if rank is None:
+                    return
+                try:
+                    s = starts[rank]
+                    idx = order[s : s + self.batch_size]
+                    batch = collate([self.dataset[int(i)] for i in idx])
+                except BaseException as e:  # surface in the main thread
+                    with done_lock:
+                        errors.append(e)
+                        done_lock.notify_all()
+                    return
+                with done_lock:
+                    # Bounded prefetch: stall if we're too far ahead of the
+                    # consumer (next_rank tracked via popped entries).
+                    while rank - min(done.keys(), default=rank) > self.prefetch + self.num_workers:
+                        done_lock.wait(timeout=0.5)
+                    done[rank] = batch
+                    done_lock.notify_all()
+
+        workers = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(self.num_workers)
+        ]
+        for t in workers:
+            t.start()
+
+        try:
+            for rank in range(len(starts)):
+                with done_lock:
+                    while rank not in done:
+                        if errors:
+                            raise errors[0]
+                        done_lock.wait(timeout=0.5)
+                    batch = done.pop(rank)
+                    done_lock.notify_all()
+                yield batch
+        finally:
+            # Drain the work queue so threads exit promptly.
+            try:
+                while True:
+                    todo.get_nowait()
+            except queue.Empty:
+                pass
+            for _ in workers:
+                todo.put(None)

@@ -1,0 +1,10 @@
+"""Offline preprocessing: raw FlyingThings3D / KITTI -> pc1/pc2.npy scenes.
+
+Equivalents of the reference ``data_preprocess/`` scripts (run once on the
+host; pure numpy — no accelerator involvement)."""
+
+from pvraft_tpu.data.preprocess.io_formats import read_flo, read_pfm
+from pvraft_tpu.data.preprocess.flyingthings3d import process_flyingthings3d
+from pvraft_tpu.data.preprocess.kitti import process_kitti
+
+__all__ = ["read_flo", "read_pfm", "process_flyingthings3d", "process_kitti"]

@@ -1,0 +1,93 @@
+"""Readers for the raw file formats of the FT3D/KITTI pipelines.
+
+Standard formats, implemented directly from their specs (the reference
+carries similar readers in ``data_preprocess/IO.py`` / ``python_pfm.py``):
+
+  * PFM (Portable Float Map) — FT3D disparity / disparity change;
+  * Middlebury ``.flo`` — FT3D optical flow;
+  * 16-bit PNGs — KITTI disparity (uint16/256) and flow
+    ((uint16-2^15)/64 with a validity plane).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Tuple
+
+import numpy as np
+
+FLO_MAGIC = 202021.25
+
+
+def read_pfm(path: str) -> np.ndarray:
+    """Read a PFM image as float32 (H, W) or (H, W, 3), top row first."""
+    with open(path, "rb") as f:
+        header = f.readline().decode("latin-1").strip()
+        if header == "PF":
+            channels = 3
+        elif header == "Pf":
+            channels = 1
+        else:
+            raise ValueError(f"{path}: not a PFM file (header {header!r})")
+        dims = f.readline().decode("latin-1")
+        m = re.match(r"^\s*(\d+)\s+(\d+)\s*$", dims)
+        if not m:
+            raise ValueError(f"{path}: bad PFM dimensions {dims!r}")
+        width, height = int(m.group(1)), int(m.group(2))
+        scale = float(f.readline().decode("latin-1").strip())
+        endian = "<" if scale < 0 else ">"
+        data = np.frombuffer(
+            f.read(width * height * channels * 4), dtype=endian + "f4"
+        )
+    img = data.reshape(height, width, channels) if channels == 3 else data.reshape(
+        height, width
+    )
+    # PFM stores rows bottom-up.
+    return np.flipud(img).astype(np.float32).copy()
+
+
+def read_flo(path: str) -> np.ndarray:
+    """Read a Middlebury .flo optical flow file -> (H, W, 2) float32."""
+    with open(path, "rb") as f:
+        magic = np.frombuffer(f.read(4), np.float32)[0]
+        if magic != FLO_MAGIC:
+            raise ValueError(f"{path}: bad .flo magic {magic}")
+        width = int(np.frombuffer(f.read(4), np.int32)[0])
+        height = int(np.frombuffer(f.read(4), np.int32)[0])
+        data = np.frombuffer(f.read(width * height * 2 * 4), np.float32)
+    return data.reshape(height, width, 2).copy()
+
+
+def read_png16(path: str) -> np.ndarray:
+    """Read a PNG preserving 16-bit depth (PIL/imageio silently downconvert
+    16-bit RGB, so prefer cv2 when present; channel order normalized to RGB)."""
+    try:
+        import cv2
+
+        arr = cv2.imread(path, cv2.IMREAD_UNCHANGED)
+        if arr is None:
+            raise IOError(f"cv2 failed to read {path}")
+        if arr.ndim == 3:
+            arr = arr[..., ::-1]  # BGR -> RGB
+        return np.ascontiguousarray(arr)
+    except ImportError:
+        import imageio.v2 as imageio
+
+        return np.asarray(imageio.imread(path))
+
+
+def read_kitti_disparity(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """KITTI disparity PNG: uint16/256.0; 0 marks invalid."""
+    arr = read_png16(path)
+    valid = arr > 0
+    disp = arr.astype(np.float32) / 256.0
+    disp[~valid] = -1.0
+    return disp, valid
+
+
+def read_kitti_flow(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """KITTI optical-flow PNG: channels (u, v, valid); (x-2^15)/64."""
+    arr = read_png16(path)
+    valid = arr[..., -1] == 1
+    flow = (arr[..., :-1].astype(np.float32) - 2.0**15) / 64.0
+    return flow, valid

@@ -1,0 +1,29 @@
+from pvraft_tpu.engine.loss import compute_loss, sequence_loss
+from pvraft_tpu.engine.metrics import epe_train, flow_metrics
+from pvraft_tpu.engine.schedule import make_lr_schedule
+from pvraft_tpu.engine.checkpoint import (
+    import_torch_state_dict,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from pvraft_tpu.engine.steps import (
+    make_eval_step,
+    make_refine_train_step,
+    make_train_step,
+)
+
+__all__ = [
+    "compute_loss",
+    "sequence_loss",
+    "epe_train",
+    "flow_metrics",
+    "make_lr_schedule",
+    "import_torch_state_dict",
+    "latest_checkpoint",
+    "load_checkpoint",
+    "save_checkpoint",
+    "make_eval_step",
+    "make_refine_train_step",
+    "make_train_step",
+]

@@ -1,0 +1,198 @@
+"""Checkpoint save/load.
+
+Replaces ``tools/utils.py:6-29`` with flax msgpack serialization (no torch
+pickle). Same three name classes: ``last_checkpoint``, ``{epoch:03d}`` every
+``checkpoint_interval`` epochs, and ``best_checkpoint`` on val improvement;
+payload carries ``epoch`` alongside the parameter/optimizer pytrees like the
+reference's ``{'epoch', 'state_dict'}`` dict.
+
+Also provides the torch->jax converter so reference-published checkpoints
+can be imported for parity testing (SURVEY.md §5 checkpoint notes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+SUFFIX = ".msgpack"
+
+
+def _write(path: str, payload: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.msgpack_serialize(payload))
+    os.replace(tmp, path)
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    params: Any,
+    opt_state: Any,
+    epoch: int,
+    checkpoint_interval: int = 5,
+    best: bool = False,
+) -> None:
+    """Write last/NNN/best checkpoints (naming of ``tools/utils.py:7-17``)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    payload = {
+        "epoch": epoch,
+        "params": jax.tree_util.tree_map(np.asarray, params),
+        "opt_state": serialization.to_state_dict(opt_state),
+    }
+    _write(os.path.join(ckpt_dir, "last_checkpoint" + SUFFIX), payload)
+    if checkpoint_interval and (epoch + 1) % checkpoint_interval == 0:
+        _write(os.path.join(ckpt_dir, f"{epoch:03d}" + SUFFIX), payload)
+    if best:
+        _write(os.path.join(ckpt_dir, "best_checkpoint" + SUFFIX), payload)
+
+
+def load_checkpoint(
+    path: str,
+    params_template: Any,
+    opt_state_template: Any = None,
+) -> Tuple[Any, Any, int]:
+    """Restore (params, opt_state, epoch). ``opt_state_template=None`` skips
+    optimizer state (the reference's eval-only load, ``test.py:101-106``)."""
+    with open(path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    params = serialization.from_state_dict(params_template, payload["params"])
+    opt_state = None
+    if opt_state_template is not None:
+        opt_state = serialization.from_state_dict(
+            opt_state_template, payload["opt_state"]
+        )
+    return params, opt_state, int(payload["epoch"])
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    p = os.path.join(ckpt_dir, "last_checkpoint" + SUFFIX)
+    return p if os.path.exists(p) else None
+
+
+# ---------------------------------------------------------------------------
+# torch -> jax parameter import (for reference-published checkpoints).
+# ---------------------------------------------------------------------------
+
+def _split_torch_key(key: str):
+    # e.g. "feature_extractor.feat_conv1.fc1.weight"
+    return key.split(".")
+
+
+_ENCODER_CONV = {"feat_conv1": "conv1", "feat_conv2": "conv2", "feat_conv3": "conv3"}
+_REFINE_CONV = {"ref_conv1": "ref_conv1", "ref_conv2": "ref_conv2", "ref_conv3": "ref_conv3"}
+
+
+def _convert_tensor(path, t: np.ndarray) -> Tuple[str, np.ndarray]:
+    """Map one torch parameter to (flax leaf name, transposed array).
+
+    torch Conv1d/Conv2d 1x1 weights are (out, in, 1[, 1]) -> Dense kernels
+    (in, out); GroupNorm weight/bias -> scale/bias; PReLU weight stays.
+    """
+    leaf = path[-1]
+    if leaf == "weight":
+        if t.ndim >= 3:           # 1x1 convs
+            return "kernel", t.reshape(t.shape[0], t.shape[1]).T
+        if t.ndim == 2:           # Linear
+            return "kernel", t.T
+        return "scale", t          # norm weight
+    if leaf == "bias":
+        return "bias", t
+    raise ValueError(f"unhandled torch param {'.'.join(path)}")
+
+
+def import_torch_state_dict(state_dict: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Convert a reference ``RSF`` state_dict (numpy-valued) into this
+    framework's param-tree layout.
+
+    Key mapping (reference module tree -> pvraft_tpu module tree):
+      feature_extractor.feat_convN.*   -> feature_extractor.convN.*
+      context_extractor.feat_convN.*   -> context_extractor.convN.*
+      corr_block.out_conv.{0,1,2,3}    -> update_iter.corr_lookup.{out_conv1,out_gn,out_prelu,out_conv2}
+      corr_block.knn_conv.{0,1,2}      -> update_iter.corr_lookup.{knn_conv,knn_gn,knn_prelu}
+      corr_block.knn_out               -> update_iter.corr_lookup.knn_out
+      update_block.*                   -> update_iter.update_block.*
+      refine_block.*                   -> refine head (stage 2)
+    GroupNorm weights inside SetConv keep their gn1/gn2/gn3 names; fc1-3
+    likewise. PReLU single weights map to {name}.alpha.
+    """
+    out: Dict[str, Any] = {}
+
+    def put(path, name, value):
+        node = out
+        for p in path:
+            node = node.setdefault(p, {})
+        node[name] = value
+
+    seq_maps = {
+        "out_conv": {"0": ("out_conv1", "dense"), "1": ("out_gn", "gn"),
+                     "2": ("out_prelu", "prelu"), "3": ("out_conv2", "dense")},
+        "knn_conv": {"0": ("knn_conv", "dense"), "1": ("knn_gn", "gn"),
+                     "2": ("knn_prelu", "prelu")},
+        "out_conv_head": {"0": ("out_conv1", "dense"), "2": ("out_conv2", "dense")},
+    }
+
+    for key, t in state_dict.items():
+        t = np.asarray(t)
+        parts = _split_torch_key(key)
+        top = parts[0]
+        if top in ("feature_extractor", "context_extractor"):
+            conv = _ENCODER_CONV[parts[1]]
+            name, arr = _convert_tensor(parts, t)
+            put([top, conv, parts[2]], name, arr)
+        elif top == "corr_block":
+            block = parts[1]
+            if block in ("out_conv", "knn_conv"):
+                tgt, kind = seq_maps[block][parts[2]]
+                if kind == "prelu":
+                    put(["update_iter", "corr_lookup", tgt], "alpha", t.reshape(-1))
+                else:
+                    name, arr = _convert_tensor(parts, t)
+                    put(["update_iter", "corr_lookup", tgt], name, arr)
+            elif block == "knn_out":
+                name, arr = _convert_tensor(parts, t)
+                put(["update_iter", "corr_lookup", "knn_out"], name, arr)
+            else:
+                raise ValueError(f"unknown corr_block child {key}")
+        elif top == "update_block":
+            sub = parts[1]
+            if sub == "motion_encoder":
+                name, arr = _convert_tensor(parts, t)
+                put(["update_iter", "update_block", "motion_encoder", parts[2]], name, arr)
+            elif sub == "gru":
+                name, arr = _convert_tensor(parts, t)
+                put(["update_iter", "update_block", "gru", parts[2]], name, arr)
+            elif sub == "flow_head":
+                tail = parts[2]
+                if tail == "conv1":
+                    name, arr = _convert_tensor(parts, t)
+                    put(["update_iter", "update_block", "flow_head", "conv1"], name, arr)
+                elif tail == "setconv":
+                    name, arr = _convert_tensor(parts, t)
+                    put(["update_iter", "update_block", "flow_head", "setconv", parts[3]], name, arr)
+                elif tail == "out_conv":
+                    tgt, _ = seq_maps["out_conv_head"][parts[3]]
+                    name, arr = _convert_tensor(parts, t)
+                    put(["update_iter", "update_block", "flow_head", tgt], name, arr)
+                else:
+                    raise ValueError(f"unknown flow_head child {key}")
+            else:
+                raise ValueError(f"unknown update_block child {key}")
+        elif top == "refine_block":
+            sub = parts[1]
+            if sub in _REFINE_CONV:
+                name, arr = _convert_tensor(parts, t)
+                put([_REFINE_CONV[sub], parts[2]], name, arr)
+            elif sub == "fc":
+                name, arr = _convert_tensor(parts, t)
+                put(["fc"], name, arr)
+            else:
+                raise ValueError(f"unknown refine_block child {key}")
+        else:
+            raise ValueError(f"unknown top-level module {key}")
+    return out

@@ -1,0 +1,87 @@
+"""Standalone evaluation (equivalent of ``test.py:70-156``).
+
+Builds the FT3D-test or KITTI dataset, loads a checkpoint, runs the eval
+loop at 32 GRU iterations (``test.py:120``), accumulates running-mean
+metrics (``test.py:128-142``) and optionally dumps per-scene
+``pc1/pc2/flow`` arrays for visualization (the ``result/`` layout consumed
+by the reference's mayavi script, ``visual.py:14-21``)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pvraft_tpu.config import Config
+from pvraft_tpu.data import FT3D, KITTI, PrefetchLoader, SyntheticDataset
+from pvraft_tpu.engine.checkpoint import load_checkpoint
+from pvraft_tpu.engine.steps import make_eval_step
+from pvraft_tpu.models import PVRaft, PVRaftRefine
+from pvraft_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+from pvraft_tpu.utils.logging import ExperimentLog
+
+
+def build_eval_dataset(cfg: Config):
+    d = cfg.data
+    if d.dataset == "FT3D":
+        return FT3D(d.root, d.max_points, "test")
+    if d.dataset == "KITTI":
+        return KITTI(d.root, d.max_points)
+    if d.dataset == "synthetic":
+        return SyntheticDataset(size=d.synthetic_size, nb_points=d.max_points,
+                                noise=0.01, seed=2)
+    raise ValueError(f"unknown dataset {d.dataset!r}")
+
+
+class Evaluator:
+    def __init__(self, cfg: Config, mesh=None):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(n_seq=1)
+        self.log = ExperimentLog(cfg.exp_path, "TestAlone", cfg.data.dataset)
+        self.dataset = build_eval_dataset(cfg)
+        self.loader = PrefetchLoader(
+            self.dataset, 1, num_workers=min(2, cfg.data.num_workers)
+        )
+        refine = cfg.train.refine
+        self.model = (PVRaftRefine if refine else PVRaft)(cfg.model)
+        sample = next(iter(self.loader.epoch(0)))
+        b = {k: jnp.asarray(v) for k, v in sample.items()}
+        self.params = self.model.init(
+            jax.random.key(0), b["pc1"], b["pc2"], 2
+        )
+        self.eval_step = make_eval_step(
+            self.model, cfg.train.eval_iters, cfg.train.gamma, refine=refine
+        )
+
+    def load(self, path: str) -> None:
+        tmpl = jax.tree_util.tree_map(np.asarray, self.params)
+        params, _, epoch = load_checkpoint(path, tmpl, None)
+        self.params = replicate(params, self.mesh)
+        self.log.info(f"loaded checkpoint {path} (epoch {epoch})")
+
+    def run(self, dump_dir: Optional[str] = None) -> Dict[str, float]:
+        sums: Dict[str, float] = {}
+        count = 0
+        for idx, batch in enumerate(self.loader.epoch(0)):
+            b = shard_batch(
+                {k: jnp.asarray(v) for k, v in batch.items()}, self.mesh
+            )
+            metrics, flow = self.eval_step(self.params, b)
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            count += 1
+            if dump_dir is not None:
+                scene = os.path.join(dump_dir, self.cfg.data.dataset, str(idx))
+                os.makedirs(scene, exist_ok=True)
+                np.save(os.path.join(scene, "pc1.npy"), batch["pc1"][0])
+                np.save(os.path.join(scene, "pc2.npy"), batch["pc2"][0])
+                np.save(os.path.join(scene, "flow.npy"), np.asarray(flow)[0])
+        means = {k: v / max(1, count) for k, v in sums.items()}
+        self.log.info(
+            f"{self.cfg.data.dataset} ({count} scenes): "
+            + " ".join(f"{k}={v:.4f}" for k, v in sorted(means.items()))
+        )
+        return means

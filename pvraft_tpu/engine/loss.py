@@ -1,0 +1,39 @@
+"""Training losses (equivalent of ``tools/loss.py``).
+
+The reference's boolean-mask fancy indexing (``loss.py:37``) is replaced by
+masked sums with static shapes, which is required under jit. For a mask m
+and error e of shape (B, N, 3), ``mean(|e|[m>0])`` equals
+``sum(|e| * m) / (3 * sum(m))``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def compute_loss(
+    est_flow: jnp.ndarray, mask: jnp.ndarray, gt_flow: jnp.ndarray
+) -> jnp.ndarray:
+    """Masked mean-L1 flow loss (``tools/loss.py:16-40``).
+
+    est_flow/gt_flow: (B, N, 3); mask: (B, N) or (B, N, 1).
+    """
+    if mask.ndim == 3:
+        mask = mask[..., 0]
+    m = (mask > 0).astype(est_flow.dtype)
+    err = jnp.abs(est_flow - gt_flow) * m[..., None]
+    return jnp.sum(err) / (3.0 * jnp.maximum(jnp.sum(m), 1.0))
+
+
+def sequence_loss(
+    flows: jnp.ndarray, mask: jnp.ndarray, gt_flow: jnp.ndarray, gamma: float = 0.8
+) -> jnp.ndarray:
+    """RAFT exponentially-weighted sequence loss (``tools/loss.py:4-13``).
+
+    flows: (T, B, N, 3) stacked per-iteration predictions; weight of
+    iteration i is gamma**(T-1-i).
+    """
+    t = flows.shape[0]
+    weights = gamma ** jnp.arange(t - 1, -1, -1, dtype=flows.dtype)
+    per_iter = jnp.stack([compute_loss(flows[i], mask, gt_flow) for i in range(t)])
+    return jnp.sum(weights * per_iter)

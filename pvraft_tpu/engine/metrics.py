@@ -1,0 +1,61 @@
+"""Scene-flow metrics (equivalent of ``tools/metric.py``).
+
+All metrics are masked jnp reductions with static shapes so they run on
+device inside jit (the reference computes eval metrics on CPU via numpy,
+``metric.py:59-63`` — including a deprecated ``np.float`` that breaks on
+numpy>=1.24; not reproduced).
+
+Definitions (``tools/metric.py:66-78``):
+  EPE3D    = mean ||pred - gt||
+  Acc3DS   = mean[ ||err|| < 0.05  or  rel < 0.05 ]
+  Acc3DR   = mean[ ||err|| < 0.1   or  rel < 0.1  ]
+  Outliers = mean[ ||err|| > 0.3   or  rel > 0.1  ]
+  rel      = ||err|| / (||gt|| + 1e-4)
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+
+def _masked_mean(x: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(x * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def epe_train(
+    est_flow: jnp.ndarray, mask: jnp.ndarray, gt_flow: jnp.ndarray
+) -> jnp.ndarray:
+    """Masked mean end-point error (``tools/metric.py:6-31``)."""
+    if mask.ndim == 3:
+        mask = mask[..., 0]
+    m = (mask > 0).astype(est_flow.dtype)
+    err = est_flow - gt_flow
+    epe = jnp.sqrt(jnp.sum(err * err, axis=-1))
+    return _masked_mean(epe, m)
+
+
+def flow_metrics(
+    est_flow: jnp.ndarray, mask: jnp.ndarray, gt_flow: jnp.ndarray
+) -> Dict[str, jnp.ndarray]:
+    """Full eval metric set (``tools/metric.py:34-80``)."""
+    if mask.ndim == 3:
+        mask = mask[..., 0]
+    m = (mask > 0).astype(est_flow.dtype)
+    err = est_flow - gt_flow
+    l2 = jnp.sqrt(jnp.sum(err * err, axis=-1))
+    gt_norm = jnp.sqrt(jnp.sum(gt_flow * gt_flow, axis=-1))
+    rel = l2 / (gt_norm + 1e-4)
+    return {
+        "epe3d": _masked_mean(l2, m),
+        "acc3d_strict": _masked_mean(
+            jnp.logical_or(l2 < 0.05, rel < 0.05).astype(est_flow.dtype), m
+        ),
+        "acc3d_relax": _masked_mean(
+            jnp.logical_or(l2 < 0.1, rel < 0.1).astype(est_flow.dtype), m
+        ),
+        "outlier": _masked_mean(
+            jnp.logical_or(l2 > 0.3, rel > 0.1).astype(est_flow.dtype), m
+        ),
+    }

@@ -1,0 +1,42 @@
+"""Learning-rate schedules.
+
+The reference constructs ``CosineAnnealingLR(T_max=num_epochs *
+len(train_dataset))`` but steps it once per *epoch* (``tools/engine.py:58,
+168``), so the cosine argument only ever reaches ``num_epochs /
+(num_epochs * dataset_len)`` — an effectively constant LR. ``parity`` mode
+reproduces that behavior exactly; ``cosine`` is the corrected per-step
+cosine decay (SURVEY.md §7 hard-part 7).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def make_lr_schedule(
+    kind: str,
+    base_lr: float,
+    num_epochs: int,
+    steps_per_epoch: int,
+    dataset_len: int,
+):
+    """Returns lr(step) usable as an optax schedule."""
+    if kind == "parity":
+        t_max = float(num_epochs * dataset_len)
+
+        def schedule(step):
+            epoch = step // max(1, steps_per_epoch)
+            return base_lr * 0.5 * (1.0 + jnp.cos(jnp.pi * epoch / t_max))
+
+        return schedule
+    if kind == "cosine":
+        total = max(1, num_epochs * steps_per_epoch)
+
+        def schedule(step):
+            frac = jnp.clip(step / total, 0.0, 1.0)
+            return base_lr * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+
+        return schedule
+    if kind == "constant":
+        return lambda step: base_lr
+    raise ValueError(f"unknown lr schedule {kind!r}")

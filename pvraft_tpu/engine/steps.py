@@ -1,0 +1,89 @@
+"""Jitted train/eval step factories.
+
+The training step fuses forward (scan over GRU iterations), sequence loss,
+backward, and the optax update into one XLA program (the reference's
+zero_grad/forward/loss/backward/step sequence, ``tools/engine.py:135-143``).
+Data parallelism comes from input shardings: with the batch sharded over the
+mesh ``data`` axis and params replicated, XLA inserts the gradient
+all-reduce over ICI — the role ``nn.DataParallel`` plays in the reference
+(``tools/engine.py:63-64``), minus the per-step replicate/scatter/gather.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from pvraft_tpu.engine.loss import compute_loss, sequence_loss
+from pvraft_tpu.engine.metrics import epe_train, flow_metrics
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    gamma: float,
+    num_iters: int,
+    donate: bool = True,
+) -> Callable:
+    """Stage-1 training step: sequence loss over all iteration outputs
+    (``tools/engine.py:135-143``)."""
+
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            flows, _ = model.apply(p, batch["pc1"], batch["pc2"], num_iters)
+            loss = sequence_loss(flows, batch["mask"], batch["flow"], gamma)
+            return loss, flows
+
+        (loss, flows), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        epe = epe_train(flows[-1], batch["mask"], batch["flow"])
+        return params, opt_state, {"loss": loss, "epe": epe}
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_refine_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    num_iters: int,
+    donate: bool = True,
+) -> Callable:
+    """Stage-2 step: plain masked-L1 on the single refined flow
+    (``tools/engine_refine.py:142``). The backbone is frozen by the model's
+    ``stop_gradient`` (plus the optimizer mask built in the Trainer)."""
+
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            flow = model.apply(p, batch["pc1"], batch["pc2"], num_iters)
+            return compute_loss(flow, batch["mask"], batch["flow"]), flow
+
+        (loss, flow), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        epe = epe_train(flow, batch["mask"], batch["flow"])
+        return params, opt_state, {"loss": loss, "epe": epe}
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_eval_step(model, num_iters: int, gamma: float, refine: bool = False):
+    """Eval step returning loss + the full metric set
+    (``tools/engine.py:197-234``, ``test.py:117-126``)."""
+
+    def step(params, batch):
+        if refine:
+            flow = model.apply(params, batch["pc1"], batch["pc2"], num_iters)
+            loss = compute_loss(flow, batch["mask"], batch["flow"])
+        else:
+            flows, _ = model.apply(params, batch["pc1"], batch["pc2"], num_iters)
+            loss = sequence_loss(flows, batch["mask"], batch["flow"], gamma)
+            flow = flows[-1]
+        out = {"loss": loss}
+        out.update(flow_metrics(flow, batch["mask"], batch["flow"]))
+        return out, flow
+
+    return jax.jit(step)

@@ -1,0 +1,263 @@
+"""Training / evaluation engine.
+
+Equivalent of the reference ``Trainer`` / ``RefineTrainer``
+(``tools/engine.py:23-274``, ``tools/engine_refine.py:23-275``), rebuilt
+around jitted steps and a device mesh:
+
+  * datasets + prefetching loaders (train shuffled & drop_last, val/test
+    bs=1 — ``tools/engine.py:43-48``);
+  * Adam lr=1e-3 with the ``parity`` near-constant cosine quirk by default
+    (``tools/engine.py:57-58,168``; see ``engine/schedule.py``);
+  * per-epoch: train -> val at 32 GRU iters (``engine.py:197-198``), best-EPE
+    checkpointing (``engine.py:247-250``), final test reloads the best
+    checkpoint (``engine.py:191``);
+  * stage 2 (refine): stage-1 weights imported non-strictly
+    (``engine_refine.py:110``), backbone frozen via the model's
+    ``stop_gradient`` AND an optax mask (the reference's module-attribute
+    ``requires_grad=False`` froze nothing — ``engine_refine.py:51-54`` —
+    freezing actually came from forward-side ``no_grad``; here both
+    mechanisms are real), val at ``iters`` not 32
+    (``engine_refine.py:199``);
+  * TensorBoard scalars use the reference tag names
+    (``engine.py:149-158,209-234``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pvraft_tpu.config import Config
+from pvraft_tpu.data import FT3D, KITTI, PrefetchLoader, SyntheticDataset
+from pvraft_tpu.engine.checkpoint import (
+    SUFFIX,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from pvraft_tpu.engine.schedule import make_lr_schedule
+from pvraft_tpu.engine.steps import (
+    make_eval_step,
+    make_refine_train_step,
+    make_train_step,
+)
+from pvraft_tpu.models import PVRaft, PVRaftRefine
+from pvraft_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+from pvraft_tpu.utils.logging import ExperimentLog, TBWriter
+from pvraft_tpu.utils.profiling import StepTimer, trace_context
+
+
+def build_datasets(cfg: Config):
+    d = cfg.data
+    if d.dataset == "synthetic":
+        mk = lambda seed: SyntheticDataset(
+            size=d.synthetic_size, nb_points=d.max_points, noise=0.01, seed=seed
+        )
+        return mk(0), mk(1), mk(2)
+    if d.dataset == "FT3D":
+        return (
+            FT3D(d.root, d.max_points, "train"),
+            FT3D(d.root, d.max_points, "val"),
+            FT3D(d.root, d.max_points, "test"),
+        )
+    if d.dataset == "KITTI":
+        # Eval-only, like the reference (tools/engine.py:40-41).
+        raise NotImplementedError("KITTI is eval-only; use Evaluator/test.py")
+    raise ValueError(f"unknown dataset {d.dataset!r}")
+
+
+def _refine_mask(params) -> Any:
+    """optax mask: train only the refine head (everything outside
+    ``backbone``)."""
+    def mark(path, _):
+        return not any(
+            getattr(k, "key", None) == "backbone" for k in path
+        )
+    return jax.tree_util.tree_map_with_path(mark, params)
+
+
+class Trainer:
+    def __init__(self, cfg: Config, mesh=None):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(n_seq=1)
+        self.log = ExperimentLog(cfg.exp_path, "Train", cfg.data.dataset)
+        self.tb = TBWriter(os.path.join(cfg.exp_path, "logs"))
+        self.best_epe = float("inf")
+        self.begin_epoch = 0
+        self.step_count = 0
+
+        self.train_ds, self.val_ds, self.test_ds = build_datasets(cfg)
+        self.train_loader = PrefetchLoader(
+            self.train_ds,
+            cfg.train.batch_size,
+            shuffle=True,
+            drop_last=True,
+            num_workers=cfg.data.num_workers,
+            seed=cfg.train.seed,
+            native=cfg.data.native_loader,
+        )
+        self.val_loader = PrefetchLoader(
+            self.val_ds, 1, num_workers=min(2, cfg.data.num_workers)
+        )
+        self.test_loader = PrefetchLoader(
+            self.test_ds, 1, num_workers=min(2, cfg.data.num_workers)
+        )
+
+        refine = cfg.train.refine
+        self.model = (PVRaftRefine if refine else PVRaft)(cfg.model)
+        rng = jax.random.key(cfg.train.seed)
+        sample = self._device_batch(next(iter(self.train_loader.epoch(0))))
+        self.params = self.model.init(
+            rng, sample["pc1"], sample["pc2"], cfg.train.iters
+        )
+
+        steps_per_epoch = max(1, len(self.train_loader))
+        schedule = make_lr_schedule(
+            cfg.train.lr_schedule,
+            cfg.train.lr,
+            cfg.train.num_epochs,
+            steps_per_epoch,
+            len(self.train_ds),
+        )
+        tx = optax.adam(schedule)
+        if refine:
+            tx = optax.masked(tx, _refine_mask(self.params))
+        self.tx = tx
+        self.opt_state = tx.init(self.params)
+        self.params = replicate(self.params, self.mesh)
+        self.opt_state = replicate(self.opt_state, self.mesh)
+
+        if refine:
+            self.train_step = make_refine_train_step(
+                self.model, tx, cfg.train.iters, donate=cfg.parallel.donate
+            )
+            # Refine trains and evals at args.iters (engine_refine.py:199).
+            self.eval_iters = cfg.train.iters
+        else:
+            self.train_step = make_train_step(
+                self.model, tx, cfg.train.gamma, cfg.train.iters,
+                donate=cfg.parallel.donate,
+            )
+            # Stage-1 val/test run 32 iters (engine.py:197-198).
+            self.eval_iters = cfg.train.eval_iters
+        self.eval_step = make_eval_step(
+            self.model, self.eval_iters, cfg.train.gamma, refine=refine
+        )
+
+        self.ckpt_dir = os.path.join(cfg.exp_path, "checkpoints")
+
+    # -- checkpoint / resume -------------------------------------------------
+
+    def load_weights(self, path: str, resume: bool = False) -> None:
+        """Load params (and optimizer state + epoch when resuming —
+        ``tools/engine.py:100-108``)."""
+        tmpl_p = jax.tree_util.tree_map(np.asarray, self.params)
+        tmpl_o = jax.tree_util.tree_map(np.asarray, self.opt_state)
+        params, opt_state, epoch = load_checkpoint(
+            path, tmpl_p, tmpl_o if resume else None
+        )
+        self.params = replicate(params, self.mesh)
+        if resume:
+            self.opt_state = replicate(opt_state, self.mesh)
+            self.begin_epoch = epoch + 1
+        self.log.info(f"loaded weights from {path} (epoch {epoch})")
+
+    def load_stage1_weights(self, path: str) -> None:
+        """Non-strict import of stage-1 params into the refine model's
+        ``backbone`` subtree (``engine_refine.py:110`` strict=False)."""
+        params = jax.tree_util.tree_map(np.asarray, self.params)
+        backbone_tmpl = params["params"]["backbone"]
+        s1, _, epoch = load_checkpoint(path, {"params": backbone_tmpl}, None)
+        params["params"]["backbone"] = s1["params"]
+        self.params = replicate(params, self.mesh)
+        self.log.info(f"imported stage-1 weights from {path} (epoch {epoch})")
+
+    # -- loops ---------------------------------------------------------------
+
+    def _device_batch(self, batch: Dict[str, np.ndarray]):
+        return shard_batch(
+            {k: jnp.asarray(v) for k, v in batch.items()}, self.mesh
+        )
+
+    def training(self, epoch: int) -> Dict[str, float]:
+        cfg = self.cfg
+        timer = StepTimer()
+        losses, epes = [], []
+        profile = cfg.train.profile_dir if epoch == self.begin_epoch else None
+        with trace_context(profile or None):
+            for batch in self.train_loader.epoch(epoch):
+                b = self._device_batch(batch)
+                timer.start()
+                self.params, self.opt_state, m = self.train_step(
+                    self.params, self.opt_state, b
+                )
+                timer.stop(m["loss"])
+                self.step_count += 1
+                losses.append(float(m["loss"]))
+                epes.append(float(m["epe"]))
+                self.tb.add_scalar("Train/Loss", losses[-1], self.step_count)
+                self.tb.add_scalar("Train/EPE", epes[-1], self.step_count)
+        mean_loss = float(np.mean(losses)) if losses else float("nan")
+        mean_epe = float(np.mean(epes)) if epes else float("nan")
+        self.log.info(
+            f"epoch {epoch}: loss {mean_loss:.4f} epe {mean_epe:.4f} "
+            f"step {timer.mean*1e3:.1f} ms"
+        )
+        save_checkpoint(
+            self.ckpt_dir,
+            jax.tree_util.tree_map(np.asarray, self.params),
+            jax.tree_util.tree_map(np.asarray, self.opt_state),
+            epoch,
+            cfg.train.checkpoint_interval,
+        )
+        return {"loss": mean_loss, "epe": mean_epe, "step_ms": timer.mean * 1e3}
+
+    def val_test(self, epoch: int, mode: str = "val") -> Dict[str, float]:
+        loader = self.val_loader if mode == "val" else self.test_loader
+        if mode == "test":
+            best = os.path.join(self.ckpt_dir, "best_checkpoint" + SUFFIX)
+            if os.path.exists(best):
+                self.load_weights(best)  # engine.py:191
+        sums: Dict[str, float] = {}
+        count = 0
+        for batch in loader.epoch(0):
+            b = self._device_batch(batch)
+            metrics, _ = self.eval_step(self.params, b)
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            count += 1
+        means = {k: v / max(1, count) for k, v in sums.items()}
+        tag = mode.capitalize()
+        for k, t in [
+            ("loss", "Loss"), ("epe3d", "EPE"), ("outlier", "Outlier"),
+            ("acc3d_relax", "Acc3dRelax"), ("acc3d_strict", "Acc3dStrict"),
+        ]:
+            if k in means:
+                self.tb.add_scalar(f"{tag}/{t}", means[k], epoch)
+        self.log.info(f"{mode} epoch {epoch}: " + " ".join(
+            f"{k}={v:.4f}" for k, v in sorted(means.items())
+        ))
+        if mode == "val" and means.get("epe3d", float("inf")) < self.best_epe:
+            self.best_epe = means["epe3d"]
+            save_checkpoint(
+                self.ckpt_dir,
+                jax.tree_util.tree_map(np.asarray, self.params),
+                jax.tree_util.tree_map(np.asarray, self.opt_state),
+                epoch,
+                checkpoint_interval=0,
+                best=True,
+            )
+        return means
+
+    def fit(self) -> Dict[str, float]:
+        """Full schedule: train+val each epoch, test once at the end
+        (``train.py:81-84``)."""
+        for epoch in range(self.begin_epoch, self.cfg.train.num_epochs):
+            self.training(epoch)
+            self.val_test(epoch, "val")
+        return self.val_test(self.cfg.train.num_epochs - 1, "test")

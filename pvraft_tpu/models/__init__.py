@@ -1,0 +1,18 @@
+from pvraft_tpu.models.layers import PReLU, SetConv
+from pvraft_tpu.models.encoder import PointEncoder
+from pvraft_tpu.models.corr_block import CorrLookup
+from pvraft_tpu.models.update import ConvGRU, FlowHead, MotionEncoder, UpdateBlock
+from pvraft_tpu.models.raft import PVRaft, PVRaftRefine
+
+__all__ = [
+    "PReLU",
+    "SetConv",
+    "PointEncoder",
+    "CorrLookup",
+    "ConvGRU",
+    "FlowHead",
+    "MotionEncoder",
+    "UpdateBlock",
+    "PVRaft",
+    "PVRaftRefine",
+]

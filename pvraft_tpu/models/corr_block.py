@@ -1,0 +1,56 @@
+"""Learned heads over the point-voxel correlation lookup.
+
+The paper's core contribution, re-built functionally: the cached
+``CorrState`` (see ``pvraft_tpu.ops.corr``) is queried at the current
+coordinate estimate through two branches — voxel-pyramid means and a kNN
+point branch — then projected to 64 channels and summed
+(reference ``CorrBlock.__call__``/convs, ``model/corr.py:15-29,44-93``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.config import ModelConfig, compute_dtype
+from pvraft_tpu.models.layers import PReLU, group_norm
+from pvraft_tpu.ops.corr import CorrState, knn_lookup
+from pvraft_tpu.ops.voxel import voxel_bin_means
+
+
+class CorrLookup(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, state: CorrState, coords: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        dtype = compute_dtype(cfg)
+        rel = state.xyz - coords[:, :, None, :]            # (B, N, K, 3)
+
+        # Voxel branch (corr.py:47-73).
+        if cfg.use_pallas:
+            from pvraft_tpu.ops.pallas.voxel_corr import voxel_bin_means_pallas
+
+            vox = voxel_bin_means_pallas(
+                state.corr, rel, cfg.corr_levels, cfg.base_scale, cfg.resolution
+            )
+        else:
+            vox = voxel_bin_means(
+                state.corr, rel, cfg.corr_levels, cfg.base_scale, cfg.resolution
+            )
+        v = nn.Dense(128, dtype=dtype, name="out_conv1")(vox)
+        v = group_norm(v, "out_gn")
+        v = PReLU(name="out_prelu")(v)
+        v = nn.Dense(64, dtype=dtype, name="out_conv2")(v)
+
+        # kNN point branch (corr.py:75-93) — shares `rel` with the voxel branch.
+        knn_corr, rel_xyz = knn_lookup(state, rel, cfg.corr_knn)
+        kf = jnp.concatenate([knn_corr[..., None], rel_xyz], axis=-1)
+        kf = nn.Dense(64, dtype=dtype, name="knn_conv")(kf)   # (B, N, k, 64)
+        kf = group_norm(kf, "knn_gn")
+        kf = PReLU(name="knn_prelu")(kf)
+        kf = jnp.max(kf, axis=2)
+        kf = nn.Dense(64, dtype=dtype, name="knn_out")(kf)
+
+        return v + kf

@@ -1,0 +1,30 @@
+"""Point-cloud feature encoder.
+
+Equivalent of the reference ``FlotEncoder`` (``model/extractor.py:7-23``):
+one kNN graph per cloud, three stacked SetConvs widening 3 -> w -> 2w -> 4w
+(default w=32, output 128 channels).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pvraft_tpu.models.layers import SetConv
+from pvraft_tpu.ops.geometry import Graph, build_graph
+
+
+class PointEncoder(nn.Module):
+    width: int = 32
+    graph_k: int = 32
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, pc: jnp.ndarray) -> Tuple[jnp.ndarray, Graph]:
+        graph = build_graph(pc, self.graph_k)
+        x = SetConv(self.width, dtype=self.dtype, name="conv1")(pc, graph)
+        x = SetConv(2 * self.width, dtype=self.dtype, name="conv2")(x, graph)
+        x = SetConv(4 * self.width, dtype=self.dtype, name="conv3")(x, graph)
+        return x, graph

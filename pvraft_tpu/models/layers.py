@@ -1,0 +1,77 @@
+"""Shared neural building blocks.
+
+Channel-last ``(B, N, C)`` layout throughout: every 1x1 Conv1d/Conv2d of the
+reference becomes a Dense layer — one MXU matmul — and GroupNorm reduces over
+all non-batch axes with the channel axis grouped, which is exactly the torch
+semantics for the reference's ``(B, C, ..., N)`` layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.ops.geometry import Graph, gather_neighbors
+
+
+class PReLU(nn.Module):
+    """Parametric ReLU with one shared slope, init 0.25 (torch default;
+    used by the reference correlation convs ``model/corr.py:18,26``)."""
+
+    slope_init: float = 0.25
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        alpha = self.param(
+            "alpha", lambda key: jnp.asarray([self.slope_init], jnp.float32)
+        ).astype(x.dtype)
+        return jnp.where(x >= 0, x, alpha * x)
+
+
+def group_norm(x: jnp.ndarray, name: str) -> jnp.ndarray:
+    """GroupNorm(8) matching torch defaults (eps 1e-5, affine)."""
+    return nn.GroupNorm(num_groups=8, epsilon=1e-5, name=name)(x)
+
+
+class SetConv(nn.Module):
+    """DGCNN/PointNet++-style edge convolution.
+
+    Re-design of the reference ``SetConv`` (``model/flot/gconv.py:4-85``):
+    per-edge features are (neighbor_feat - center_feat, relative xyz),
+    projected, group-normalized, max-pooled over the k neighbors, then two
+    more 1x1 projections. All gathers are batched ``(B, N, k)`` index ops;
+    all projections are Dense (bias-free, as the reference's convs).
+
+    ``dtype`` (e.g. bfloat16) sets the matmul compute precision; params and
+    GroupNorm statistics stay float32.
+    """
+
+    out_ch: int
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, graph: Graph) -> jnp.ndarray:
+        b, n, c = x.shape
+        # Width rule of gconv.py:21-24.
+        mid = (self.out_ch + c) // 2 if c % 2 == 0 else self.out_ch // 2
+
+        nb = gather_neighbors(x, graph.neighbors)            # (B, N, k, C)
+        edge = nb - x[:, :, None, :]
+        h = jnp.concatenate([edge, graph.rel_pos.astype(x.dtype)], axis=-1)
+
+        h = nn.Dense(mid, use_bias=False, dtype=self.dtype, name="fc1")(h)
+        h = group_norm(h, "gn1")
+        h = jax.nn.leaky_relu(h, 0.1)
+        h = jnp.max(h, axis=2)                               # pool over k
+
+        h = nn.Dense(self.out_ch, use_bias=False, dtype=self.dtype, name="fc2")(h)
+        h = group_norm(h, "gn2")
+        h = jax.nn.leaky_relu(h, 0.1)
+
+        h = nn.Dense(self.out_ch, use_bias=False, dtype=self.dtype, name="fc3")(h)
+        h = group_norm(h, "gn3")
+        h = jax.nn.leaky_relu(h, 0.1)
+        return h

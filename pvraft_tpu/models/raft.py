@@ -1,0 +1,122 @@
+"""PV-RAFT flagship model, TPU-native.
+
+Equivalent of ``model/RAFTSceneFlow.py`` (stage 1) and
+``model/RAFTSceneFlowRefine.py`` (stage 2), with the iterative refinement
+expressed as ``nn.scan`` over a shared-parameter update step:
+
+  * per-iteration ``coords2.detach()`` (``RAFTSceneFlow.py:41``) becomes
+    ``lax.stop_gradient`` at the top of the scanned body;
+  * the correlation cache is the explicit ``CorrState`` carried as a
+    broadcast input instead of module-state mutation (``corr.py:31-42``);
+  * outputs are stacked per-iteration flows ``(T, B, N, 3)`` rather than a
+    Python list;
+  * optional ``remat`` wraps the scanned step in ``jax.checkpoint`` to trade
+    FLOPs for HBM during backprop (SURVEY.md §7 hard-part 4).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pvraft_tpu.config import ModelConfig, compute_dtype
+from pvraft_tpu.models.corr_block import CorrLookup
+from pvraft_tpu.models.encoder import PointEncoder
+from pvraft_tpu.models.layers import SetConv
+from pvraft_tpu.models.update import UpdateBlock
+from pvraft_tpu.ops.corr import CorrState, corr_init
+from pvraft_tpu.ops.geometry import Graph
+
+
+class UpdateIter(nn.Module):
+    """One GRU refinement step (body of ``RAFTSceneFlow.py:40-46``)."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, carry, state: CorrState, inp, graph: Graph):
+        net, coords2, coords1 = carry
+        coords2 = lax.stop_gradient(coords2)
+        corr = CorrLookup(self.cfg, name="corr_lookup")(state, coords2)
+        flow = coords2 - coords1
+        net, delta = UpdateBlock(
+            self.cfg.hidden_dim, dtype=compute_dtype(self.cfg), name="update_block"
+        )(net, inp, corr, flow, graph)
+        coords2 = coords2 + delta
+        return (net, coords2, coords1), coords2 - coords1
+
+
+class PVRaft(nn.Module):
+    """Stage-1 model (``model/RAFTSceneFlow.py:10-50``).
+
+    ``__call__(xyz1, xyz2, num_iters)`` returns ``(flows, graph1)`` where
+    ``flows`` is ``(num_iters, B, N, 3)`` and ``graph1`` is the pc1 feature
+    graph (consumed by the stage-2 refine head).
+    """
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self, xyz1: jnp.ndarray, xyz2: jnp.ndarray, num_iters: int = 8
+    ) -> Tuple[jnp.ndarray, Graph]:
+        cfg = self.cfg
+        dtype = compute_dtype(cfg)
+        feat = PointEncoder(
+            cfg.encoder_width, cfg.graph_k, dtype=dtype, name="feature_extractor"
+        )
+        fmap1, graph1 = feat(xyz1)
+        fmap2, _ = feat(xyz2)
+
+        state = corr_init(fmap1, fmap2, xyz2, cfg.truncate_k, cfg.corr_chunk)
+
+        fct, graph_ctx = PointEncoder(
+            cfg.encoder_width, cfg.graph_k, dtype=dtype, name="context_extractor"
+        )(xyz1)
+        net, inp = jnp.split(fct, [cfg.hidden_dim], axis=-1)
+        net = jnp.tanh(net)
+        inp = jax.nn.relu(inp)
+
+        step_cls = UpdateIter
+        if cfg.remat:
+            step_cls = nn.remat(UpdateIter, prevent_cse=False)
+        scan = nn.scan(
+            step_cls,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            out_axes=0,
+            length=num_iters,
+        )
+        carry = (net, xyz1, xyz1)
+        _, flows = scan(cfg, name="update_iter")(carry, state, inp, graph_ctx)
+        return flows, graph1
+
+
+class PVRaftRefine(nn.Module):
+    """Stage-2 model (``model/RAFTSceneFlowRefine.py:10-48``): the full
+    stage-1 pipeline under ``stop_gradient`` (its ``torch.no_grad``,
+    ``:23``), then a trainable residual SetConv head on the final flow
+    using the pc1 feature graph (``model/refine.py:6-22``)."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self, xyz1: jnp.ndarray, xyz2: jnp.ndarray, num_iters: int = 32
+    ) -> jnp.ndarray:
+        flows, graph1 = PVRaft(self.cfg, name="backbone")(xyz1, xyz2, num_iters)
+        flow = lax.stop_gradient(flows[-1])
+        graph1 = Graph(graph1.neighbors, lax.stop_gradient(graph1.rel_pos))
+
+        n = self.cfg.encoder_width
+        dtype = compute_dtype(self.cfg)
+        x = SetConv(n, dtype=dtype, name="ref_conv1")(flow, graph1)
+        x = SetConv(2 * n, dtype=dtype, name="ref_conv2")(x, graph1)
+        x = SetConv(4 * n, dtype=dtype, name="ref_conv3")(x, graph1)
+        delta = nn.Dense(3, dtype=jnp.float32, name="fc")(x)
+        return flow + delta

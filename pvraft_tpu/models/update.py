@@ -1,0 +1,89 @@
+"""GRU update block.
+
+Equivalent of ``model/update.py``: motion encoder, 1x1-conv GRU, and a flow
+head whose spatial mixing is a SetConv on the context graph. All 1x1 convs
+are Dense layers on the channel-last layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.models.layers import SetConv
+from pvraft_tpu.ops.geometry import Graph
+
+
+class MotionEncoder(nn.Module):
+    """``model/update.py:8-21``: mixes correlation features with the current
+    flow; output is 61 learned channels concatenated with the raw flow."""
+
+    hidden: int = 64
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, flow: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarray:
+        cor = jax.nn.relu(nn.Dense(self.hidden, dtype=self.dtype, name="conv_corr")(corr))
+        flo = jax.nn.relu(nn.Dense(self.hidden, dtype=self.dtype, name="conv_flow")(flow))
+        h = jnp.concatenate([cor, flo], axis=-1)
+        h = jax.nn.relu(nn.Dense(self.hidden - 3, dtype=self.dtype, name="conv")(h))
+        return jnp.concatenate([h, flow.astype(h.dtype)], axis=-1)
+
+
+class ConvGRU(nn.Module):
+    """``model/update.py:24-40``: z/r/q gates via 1x1 convs. The hidden
+    state stays float32 across iterations (gate matmuls may run in
+    ``dtype``)."""
+
+    hidden: int = 64
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+        hx = jnp.concatenate([h, x.astype(h.dtype)], axis=-1)
+        z = jax.nn.sigmoid(nn.Dense(self.hidden, dtype=self.dtype, name="convz")(hx))
+        r = jax.nn.sigmoid(nn.Dense(self.hidden, dtype=self.dtype, name="convr")(hx))
+        rhx = jnp.concatenate([(r * h.astype(r.dtype)).astype(h.dtype), x.astype(h.dtype)], axis=-1)
+        q = jnp.tanh(nn.Dense(self.hidden, dtype=self.dtype, name="convq")(rhx))
+        h32 = h.astype(jnp.float32)
+        return ((1.0 - z) * h32 + z * q).astype(jnp.float32)
+
+
+class FlowHead(nn.Module):
+    """``model/update.py:57-72``: parallel Dense + SetConv over the hidden
+    state, fused to a 3-channel flow delta (delta emitted in float32)."""
+
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, graph: Graph) -> jnp.ndarray:
+        out = nn.Dense(64, dtype=self.dtype, name="conv1")(x)
+        out_set = SetConv(64, dtype=self.dtype, name="setconv")(x, graph)
+        h = jnp.concatenate([out_set.astype(out.dtype), out], axis=-1)
+        h = jax.nn.relu(nn.Dense(64, dtype=self.dtype, name="out_conv1")(h))
+        return nn.Dense(3, dtype=jnp.float32, name="out_conv2")(h)
+
+
+class UpdateBlock(nn.Module):
+    """``model/update.py:75-87``."""
+
+    hidden: int = 64
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        net: jnp.ndarray,
+        inp: jnp.ndarray,
+        corr: jnp.ndarray,
+        flow: jnp.ndarray,
+        graph: Graph,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        motion = MotionEncoder(self.hidden, dtype=self.dtype, name="motion_encoder")(flow, corr)
+        x = jnp.concatenate([inp.astype(motion.dtype), motion], axis=-1)
+        net = ConvGRU(self.hidden, dtype=self.dtype, name="gru")(net, x)
+        delta = FlowHead(dtype=self.dtype, name="flow_head")(net, graph)
+        return net, delta

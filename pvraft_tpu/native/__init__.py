@@ -1,0 +1,122 @@
+"""ctypes bindings for the native data-plane (see ``npy_loader.cc``).
+
+``native_available()`` gates every use; all call sites fall back to the
+numpy implementations when the library has not been built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "libpvraft_native.so")
+    if not os.path.exists(path):
+        # Build on first use when a compiler is present; stay silent and
+        # fall back to numpy otherwise.
+        try:
+            from pvraft_tpu.native.build import build
+
+            path = build()
+        except Exception:
+            return None
+    lib = ctypes.CDLL(path)
+    lib.pvraft_npy_shape.restype = ctypes.c_long
+    lib.pvraft_npy_shape.argtypes = [ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_long)]
+    lib.pvraft_npy_read_f32.restype = ctypes.c_long
+    lib.pvraft_npy_read_f32.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.pvraft_load_scene_batch.restype = None
+    lib.pvraft_load_scene_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_long,
+    ]
+    _LIB = lib
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def npy_shape(path: str) -> Tuple[int, int]:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    cols = ctypes.c_long(0)
+    rows = lib.pvraft_npy_shape(path.encode(), ctypes.byref(cols))
+    if rows < 0:
+        raise IOError(f"pvraft_npy_shape({path}) failed: {rows}")
+    return int(rows), int(cols.value)
+
+
+def npy_read(path: str) -> np.ndarray:
+    """Read a float .npy as float32 via the native reader."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    rows, cols = npy_shape(path)
+    out = np.empty(rows * cols, np.float32)
+    cols_out = ctypes.c_long(0)
+    got = lib.pvraft_npy_read_f32(path.encode(), out, out.size,
+                                  ctypes.byref(cols_out))
+    if got < 0:
+        raise IOError(f"pvraft_npy_read_f32({path}) failed: {got}")
+    return out.reshape(rows, cols) if cols > 1 else out
+
+
+def load_scene_batch(
+    pc1_paths: Sequence[str],
+    pc2_paths: Sequence[str],
+    scene_indices: Sequence[int],
+    n_points: int,
+    max_rows: int,
+    seed: int,
+    epoch: int,
+    flip_xz: bool,
+    n_threads: int = 4,
+):
+    """Threaded native batch assembly. Returns (pc1, pc2, mask, flow,
+    status) — status[i]: 1 ok, 0 too-few-points, <0 error."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(pc1_paths)
+    out_pc1 = np.empty((n, n_points, 3), np.float32)
+    out_pc2 = np.empty((n, n_points, 3), np.float32)
+    out_mask = np.empty((n, n_points), np.float32)
+    out_flow = np.empty((n, n_points, 3), np.float32)
+    status = np.zeros((n,), np.int32)
+    idx = np.asarray(scene_indices, np.int64)
+    lib.pvraft_load_scene_batch(
+        b"\0".join(p.encode() for p in pc1_paths) + b"\0",
+        b"\0".join(p.encode() for p in pc2_paths) + b"\0",
+        idx, n, n_points, max_rows, seed, epoch, int(flip_xz),
+        out_pc1, out_pc2, out_mask, out_flow, status, n_threads,
+    )
+    return out_pc1, out_pc2, out_mask, out_flow, status

@@ -1,0 +1,34 @@
+"""Build the native data-plane library.
+
+``python -m pvraft_tpu.native.build`` compiles ``npy_loader.cc`` into
+``libpvraft_native.so`` next to this file. Requires g++ (baked into the
+image); everything degrades gracefully to numpy when the .so is absent.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "npy_loader.cc")
+LIB = os.path.join(HERE, "libpvraft_native.so")
+
+
+def build(force: bool = False) -> str:
+    if os.path.exists(LIB) and not force:
+        src_m = os.path.getmtime(SRC)
+        if os.path.getmtime(LIB) >= src_m:
+            return LIB
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        SRC, "-o", LIB,
+    ]
+    subprocess.run(cmd, check=True)
+    return LIB
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    print(path)

@@ -1,0 +1,22 @@
+from pvraft_tpu.ops.geometry import (
+    Graph,
+    build_graph,
+    gather_neighbors,
+    knn_indices,
+    pairwise_sqdist,
+)
+from pvraft_tpu.ops.corr import CorrState, corr_init, corr_volume, knn_lookup
+from pvraft_tpu.ops.voxel import voxel_bin_means
+
+__all__ = [
+    "Graph",
+    "build_graph",
+    "gather_neighbors",
+    "knn_indices",
+    "pairwise_sqdist",
+    "CorrState",
+    "corr_init",
+    "corr_volume",
+    "knn_lookup",
+    "voxel_bin_means",
+]

@@ -1,0 +1,116 @@
+"""Truncated all-pairs correlation volume.
+
+Functional replacement of the reference ``CorrBlock.init_module`` /
+``get_knn_feature`` state machinery (``model/corr.py:31-45,75-100``). The
+cache of per-point top-k correlation candidates becomes an explicit
+``CorrState`` pytree threaded through the update loop — no module-state
+mutation (which is also what made the reference DataParallel-hostile).
+
+Memory notes (SURVEY.md §7 hard-part 3): the reference materializes both the
+(B, N, N) correlation *and* a (B, N, N, 3) xyz expand (``corr.py:33``). We
+gather xyz only after truncation, and optionally stream the N2 axis with a
+running top-k (``corr_init`` with ``chunk``) so the N x N matrix is never
+resident — the long-context ("ring attention"-style) path for 16k+ points.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pvraft_tpu.ops.geometry import gather_neighbors
+
+
+class CorrState(NamedTuple):
+    """Per-pair correlation cache (reference ``corr.py:38-42``)."""
+
+    corr: jnp.ndarray   # (B, N1, K) top-k correlation values, descending
+    xyz: jnp.ndarray    # (B, N1, K, 3) positions of the top-k pc2 points
+
+
+def corr_volume(fmap1: jnp.ndarray, fmap2: jnp.ndarray) -> jnp.ndarray:
+    """Scaled all-pairs feature correlation.
+
+    fmap1: (B, N, D), fmap2: (B, M, D) -> (B, N, M); dot products over the
+    feature axis scaled by 1/sqrt(D) (``model/corr.py:95-100``).
+    """
+    d = fmap1.shape[-1]
+    # Accumulate in float32 even when fmaps are bfloat16 (MXU-native mode).
+    out = jnp.einsum(
+        "bnd,bmd->bnm", fmap1, fmap2, preferred_element_type=jnp.float32
+    )
+    return out / jnp.sqrt(jnp.asarray(d, out.dtype))
+
+
+def corr_init(
+    fmap1: jnp.ndarray,
+    fmap2: jnp.ndarray,
+    xyz2: jnp.ndarray,
+    truncate_k: int,
+    chunk: Optional[int] = None,
+) -> CorrState:
+    """Build the truncated correlation cache (``model/corr.py:31-42``).
+
+    fmap1: (B, N, D), fmap2: (B, M, D), xyz2: (B, M, 3).
+
+    With ``chunk=None`` the full (B, N, M) volume is formed and truncated with
+    one ``lax.top_k``. With an integer ``chunk`` the M axis is processed in
+    slices under ``lax.scan`` while a running top-k of size K is maintained —
+    peak memory O(N * (K + chunk)) instead of O(N * M).
+    """
+    if chunk is None:
+        corr = corr_volume(fmap1, fmap2)
+        vals, idx = lax.top_k(corr, truncate_k)
+        return CorrState(corr=vals, xyz=gather_neighbors(xyz2, idx))
+
+    b, m, d = fmap2.shape
+    if m % chunk != 0:
+        raise ValueError(f"chunk {chunk} must divide N2={m}")
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    n1 = fmap1.shape[1]
+    neg = jnp.asarray(-jnp.inf, jnp.float32)
+
+    fmap2_c = fmap2.reshape(b, m // chunk, chunk, d)
+    xyz2_c = xyz2.reshape(b, m // chunk, chunk, 3)
+
+    def step(carry, xs):
+        best_v, best_x = carry
+        f2, x2 = xs                                  # (B, chunk, D), (B, chunk, 3)
+        part = jnp.einsum(
+            "bnd,bcd->bnc", fmap1, f2, preferred_element_type=jnp.float32
+        ) * scale                                    # (B, N, chunk)
+        cand_v = jnp.concatenate([best_v, part], axis=-1)
+        cand_x = jnp.concatenate(
+            [best_x, jnp.broadcast_to(x2[:, None], (b, n1, chunk, 3))], axis=2
+        )
+        new_v, sel = lax.top_k(cand_v, truncate_k)
+        new_x = jnp.take_along_axis(cand_x, sel[..., None], axis=2)
+        return (new_v, new_x), None
+
+    init = (
+        jnp.full((b, n1, truncate_k), neg, jnp.float32),
+        jnp.zeros((b, n1, truncate_k, 3), xyz2.dtype),
+    )
+    (vals, xyz), _ = lax.scan(
+        step, init, (jnp.swapaxes(fmap2_c, 0, 1), jnp.swapaxes(xyz2_c, 0, 1))
+    )
+    return CorrState(corr=vals, xyz=xyz)
+
+
+def knn_lookup(state: CorrState, rel: jnp.ndarray, k: int):
+    """Point-branch lookup: pick the k truncated candidates nearest to the
+    current coordinate estimate (``model/corr.py:75-89``).
+
+    rel: (B, N, K, 3) candidate positions relative to the current coords
+    (precomputed by the caller and shared with the voxel branch). Returns:
+      knn_corr (B, N, k) — their correlation values,
+      rel_xyz  (B, N, k, 3) — their positions relative to the coords.
+    """
+    dist = jnp.sum(rel * rel, axis=-1)  # (B, N, K)
+    _, nbr = lax.top_k(-dist, k)                      # (B, N, k)
+    knn_corr = jnp.take_along_axis(state.corr, nbr, axis=-1)
+    rel_xyz = jnp.take_along_axis(rel, nbr[..., None], axis=2)
+    return knn_corr, rel_xyz

@@ -1,0 +1,81 @@
+"""Voxel-branch correlation pooling.
+
+This op plays the role of the external ``torch-scatter`` CUDA kernel in the
+reference (``model/corr.py:50,64-66``): for each query point and each pyramid
+level, average the truncated correlation values of candidate points that fall
+into each cell of a ``resolution^3`` cube centered on the current coordinate
+estimate.
+
+Semantics preserved exactly (SURVEY.md §7 hard-part 1):
+  * cell index = round((candidate - coord) / r) per axis, valid iff all three
+    components lie within +/- floor(resolution/2) (``corr.py:54-55``);
+  * invalid candidates contribute nothing: the reference multiplies both the
+    scattered values and the counts by the validity mask before scatter_add
+    (``corr.py:64-65``), so its "dump into bin 0" only ever adds zeros;
+  * counts are clamped to [1, N] before division (``corr.py:65``);
+  * output always has resolution^3 cells per level (the reference pads
+    missing trailing cells with zeros, ``corr.py:67-69`` — with a fixed
+    num_segments the pad is never needed, same result).
+
+Implementations:
+  * ``voxel_bin_means`` — pure XLA: per-cell masked reductions, fully fused
+    elementwise+reduce chains, deterministic (unlike CUDA atomics).
+  * a Pallas TPU kernel (``pvraft_tpu.ops.pallas.voxel_corr``) that keeps the
+    (TILE, K) candidate block in VMEM across all levels and cells — used when
+    ``ModelConfig.use_pallas`` is set.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def voxel_bin_means(
+    corr: jnp.ndarray,
+    rel: jnp.ndarray,
+    num_levels: int,
+    base_scale: float,
+    resolution: int = 3,
+) -> jnp.ndarray:
+    """Per-cell mean correlation over a pyramid of voxel cubes.
+
+    corr: (B, N, K) truncated correlation values.
+    rel:  (B, N, K, 3) candidate positions relative to the query coordinate.
+    Returns (B, N, num_levels * resolution**3).
+
+    The cell geometry is computed under ``stop_gradient`` mirroring the
+    reference's ``no_grad`` region (``corr.py:52-62``); gradients flow only
+    through the correlation values.
+    """
+    half = resolution // 2
+    r3 = resolution**3
+    n_pts = corr.shape[1]
+    rel = lax.stop_gradient(rel)
+
+    feats = []
+    for lvl in range(num_levels):
+        r = base_scale * (2**lvl)
+        dv = jnp.round(rel / r)
+        valid = jnp.all(jnp.abs(dv) <= half, axis=-1)          # (B, N, K)
+        cell = (
+            (dv[..., 0] + half) * (resolution**2)
+            + (dv[..., 1] + half) * resolution
+            + (dv[..., 2] + half)
+        ).astype(jnp.int32)
+        cell = jnp.where(valid, cell, 0)
+        w = corr * valid.astype(corr.dtype)
+        vf = valid.astype(corr.dtype)
+        # One masked sum per cell: elementwise compare + reduce, which XLA
+        # fuses into a handful of VPU passes over the (B, N, K) block.
+        sums = jnp.stack(
+            [jnp.sum(jnp.where(cell == j, w, 0), axis=-1) for j in range(r3)],
+            axis=-1,
+        )
+        cnts = jnp.stack(
+            [jnp.sum(jnp.where(cell == j, vf, 0), axis=-1) for j in range(r3)],
+            axis=-1,
+        )
+        feats.append(sums / jnp.clip(cnts, 1, n_pts))
+    return jnp.concatenate(feats, axis=-1)

@@ -1,0 +1,21 @@
+from pvraft_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicate,
+    replicated_sharding,
+    shard_batch,
+)
+from pvraft_tpu.parallel.ring import ring_corr_init
+
+__all__ = [
+    "DATA_AXIS",
+    "SEQ_AXIS",
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "replicate",
+    "shard_batch",
+    "ring_corr_init",
+]

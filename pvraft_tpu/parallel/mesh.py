@@ -1,0 +1,70 @@
+"""Device mesh and sharding helpers.
+
+The TPU-native replacement for the reference's single-process
+``nn.DataParallel`` (``tools/engine.py:51-55,63-64``): a ``jax.sharding.Mesh``
+with a ``data`` axis (batch sharding / gradient all-reduce over ICI) and an
+optional ``seq`` axis (sequence parallelism over the point dimension of the
+correlation volume — see ``pvraft_tpu.parallel.ring``). Multi-host extends
+the same mesh over DCN via ``jax.distributed.initialize`` — no NCCL/MPI-style
+backend code; XLA emits the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_seq: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, seq) mesh. Defaults to all devices on the data axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None or n_data < 0:
+        n_data = len(devices) // n_seq
+    if n_data * n_seq != len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_seq} does not cover {len(devices)} devices"
+        )
+    arr = np.asarray(devices).reshape(n_data, n_seq)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading axis sharded over data."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Any, mesh: Mesh) -> Any:
+    """Place every array of a batch dict with its batch axis over ``data``.
+
+    Batches whose leading axis does not divide the data axis (e.g. the
+    reference's batch-size-1 eval protocol, ``test.py:92``) are replicated
+    instead — correct, just without batch parallelism.
+    """
+    n_data = mesh.shape[DATA_AXIS]
+    sharded = batch_sharding(mesh)
+    repl = replicated_sharding(mesh)
+
+    def put(x):
+        ok = getattr(x, "ndim", 0) >= 1 and x.shape[0] % n_data == 0
+        return jax.device_put(x, sharded if ok else repl)
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    sharding = replicated_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

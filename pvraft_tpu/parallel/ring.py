@@ -1,0 +1,75 @@
+"""Ring sequence-parallel truncated correlation.
+
+Long-context path (SURVEY.md §5): the memory wall of PV-RAFT is the dense
+(N1, N2) correlation volume (``model/corr.py:96-99`` — 256 MB fp32 at 8,192
+points, 1 GB at 16,384). Here both point axes are sharded over the ``seq``
+mesh axis and the N2 chunks circulate around the ring with ``ppermute``
+(the ring-attention pattern applied to correlation): each device holds
+fmap1/N1-shard permanently, receives one fmap2/xyz2 chunk per ring step,
+folds it into a running top-k of size K, and forwards the chunk over ICI.
+Peak memory per device: O(N1/P * (K + N2/P)) — the full volume is never
+materialized anywhere.
+
+Compose with ``shard_map``: call inside a shard-mapped function whose specs
+shard fmap1 rows and fmap2/xyz2 rows over ``seq``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pvraft_tpu.ops.corr import CorrState
+
+
+def ring_corr_init(
+    fmap1: jnp.ndarray,
+    fmap2: jnp.ndarray,
+    xyz2: jnp.ndarray,
+    truncate_k: int,
+    axis_name: str,
+) -> CorrState:
+    """Per-shard truncated correlation cache via a ppermute ring.
+
+    fmap1: (B, N1/P, D) — this device's query rows (stay resident).
+    fmap2: (B, N2/P, D), xyz2: (B, N2/P, 3) — this device's candidate chunk
+    (circulates). Returns a CorrState for the local N1 rows whose top-k is
+    global over all N2 — bitwise-comparable to the single-device
+    ``corr_init`` up to top-k tie order.
+    """
+    p = lax.axis_size(axis_name)
+    b, n1, d = fmap1.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def fold(carry, chunk_f2, chunk_x2):
+        best_v, best_x = carry
+        part = jnp.einsum(
+            "bnd,bcd->bnc", fmap1, chunk_f2, preferred_element_type=jnp.float32
+        ) * scale
+        cand_v = jnp.concatenate([best_v, part], axis=-1)
+        chunk = chunk_x2.shape[1]
+        cand_x = jnp.concatenate(
+            [best_x, jnp.broadcast_to(chunk_x2[:, None], (b, n1, chunk, 3))],
+            axis=2,
+        )
+        new_v, sel = lax.top_k(cand_v, truncate_k)
+        new_x = jnp.take_along_axis(cand_x, sel[..., None], axis=2)
+        return new_v, new_x
+
+    def body(i, state):
+        best_v, best_x, f2, x2 = state
+        best_v, best_x = fold((best_v, best_x), f2, x2)
+        # Forward the chunk to the next ring neighbor over ICI; the last
+        # fold needs no send, but a uniform loop keeps the schedule static.
+        f2 = lax.ppermute(f2, axis_name, perm)
+        x2 = lax.ppermute(x2, axis_name, perm)
+        return best_v, best_x, f2, x2
+
+    init_v = jnp.full((b, n1, truncate_k), -jnp.inf, jnp.float32)
+    init_x = jnp.zeros((b, n1, truncate_k, 3), xyz2.dtype)
+    best_v, best_x, _, _ = lax.fori_loop(
+        0, p, body, (init_v, init_x, fmap2, xyz2)
+    )
+    return CorrState(corr=best_v, xyz=best_x)

@@ -1,0 +1,4 @@
+from pvraft_tpu.utils.logging import ExperimentLog, TBWriter
+from pvraft_tpu.utils.profiling import StepTimer, trace_context
+
+__all__ = ["ExperimentLog", "TBWriter", "StepTimer", "trace_context"]

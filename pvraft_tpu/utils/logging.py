@@ -1,0 +1,70 @@
+"""Experiment logging.
+
+Mirrors the reference's observability surface (``tools/engine.py:72-98,
+149-158``): ``experiments/<exp>/{logs,checkpoints}`` directories, a python
+``logging`` file per mode, and TensorBoard scalars with the same tag names
+(``Train/Loss``, ``Train/EPE``, ``Val/...``). TensorBoard is optional — if
+no writer backend is importable the scalars are kept in-memory (inspectable
+by tests) and the run proceeds.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+
+class TBWriter:
+    """TensorBoard scalar writer with a no-op/in-memory fallback."""
+
+    def __init__(self, log_dir: str):
+        self.history: Dict[str, List[Tuple[int, float]]] = defaultdict(list)
+        self._writer = None
+        try:  # torch's pure-python writer is available in this image
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._writer = SummaryWriter(log_dir=log_dir)
+        except Exception:
+            self._writer = None
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self.history[tag].append((step, float(value)))
+        if self._writer is not None:
+            self._writer.add_scalar(tag, float(value), step)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+class ExperimentLog:
+    """Experiment directory layout + per-mode log files
+    (``tools/engine.py:72-98``)."""
+
+    def __init__(self, exp_path: str, mode: str = "Train", dataset: str = ""):
+        self.root = exp_path
+        self.log_dir = os.path.join(exp_path, "logs")
+        self.ckpt_dir = os.path.join(exp_path, "checkpoints")
+        os.makedirs(self.log_dir, exist_ok=True)
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+
+        name = f"{mode}_{dataset}" if dataset else mode
+        self.logger = logging.getLogger(f"pvraft_tpu.{name}")
+        self.logger.setLevel(logging.INFO)
+        self.logger.propagate = False
+        path = os.path.join(self.log_dir, f"{name}.log")
+        if not any(
+            isinstance(h, logging.FileHandler)
+            and getattr(h, "baseFilename", None) == os.path.abspath(path)
+            for h in self.logger.handlers
+        ):
+            fh = logging.FileHandler(path)
+            fh.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+            )
+            self.logger.addHandler(fh)
+
+    def info(self, msg: str) -> None:
+        self.logger.info(msg)

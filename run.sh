@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Canonical runs (hyperparameters of the reference run.sh:2-8, device flags
+# adapted to the TPU mesh).
+set -e
+
+ROOT=${ROOT:-/data/ft3d_preprocessed}
+KITTI_ROOT=${KITTI_ROOT:-/data/kitti_preprocessed}
+EXP=${EXP:-experiments/pvraft}
+
+# Stage-1 training: FT3D, 8192 pts, 8 GRU iters, bs=2.
+python train.py --root "$ROOT" --exp_path "$EXP" --dataset FT3D \
+  --max_points 8192 --iters 8 --truncate_k 512 --corr_levels 3 \
+  --base_scales 0.25 --batch_size 2 --num_epochs 20
+
+# Stage-2 refine training: frozen backbone, 32 iters, 10 epochs.
+python train.py --root "$ROOT" --exp_path "${EXP}_refine" --dataset FT3D \
+  --max_points 8192 --iters 32 --batch_size 2 --num_epochs 10 --refine \
+  --stage1_weights "$EXP/checkpoints/best_checkpoint.msgpack"
+
+# Eval: FT3D test + zero-shot KITTI, stage-1 and refined.
+python test.py --root "$ROOT" --dataset FT3D --exp_path "$EXP" \
+  --weights "$EXP/checkpoints/best_checkpoint.msgpack"
+python test.py --root "$KITTI_ROOT" --dataset KITTI --exp_path "$EXP" \
+  --weights "$EXP/checkpoints/best_checkpoint.msgpack"
+python test.py --root "$ROOT" --dataset FT3D --exp_path "${EXP}_refine" --refine \
+  --weights "${EXP}_refine/checkpoints/best_checkpoint.msgpack"
+python test.py --root "$KITTI_ROOT" --dataset KITTI --exp_path "${EXP}_refine" --refine \
+  --weights "${EXP}_refine/checkpoints/best_checkpoint.msgpack"

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Standalone evaluation entry point (equivalent of the reference
+``test.py``): FT3D-test or zero-shot KITTI, batch size 1, 32 GRU iterations
+(``test.py:92,120``), running-mean metrics, optional flow dump for
+visualization (``visual.py`` layout)."""
+
+from __future__ import annotations
+
+import argparse
+
+from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("pvraft_tpu test")
+    p.add_argument("--root", default="")
+    p.add_argument("--exp_path", default="experiments/default")
+    p.add_argument("--dataset", default="FT3D",
+                   choices=["FT3D", "KITTI", "synthetic"])
+    p.add_argument("--max_points", type=int, default=8192)
+    p.add_argument("--corr_levels", type=int, default=3)
+    p.add_argument("--base_scales", type=float, default=0.25)
+    p.add_argument("--truncate_k", type=int, default=512)
+    p.add_argument("--eval_iters", type=int, default=32)
+    p.add_argument("--weights", required=False, default=None)
+    p.add_argument("--refine", action="store_true")
+    p.add_argument("--use_pallas", action="store_true")
+    p.add_argument("--corr_chunk", type=int, default=None)
+    p.add_argument("--num_workers", type=int, default=8)
+    p.add_argument("--dump_dir", default=None,
+                   help="write result/<ds>/<idx>/{pc1,pc2,flow}.npy for visual.py")
+    p.add_argument("--synthetic_size", type=int, default=16)
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
+                   help="force a jax platform (e.g. cpu for host debugging)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    a = parse_args(argv)
+    cfg = Config(
+        model=ModelConfig(
+            truncate_k=a.truncate_k, corr_levels=a.corr_levels,
+            base_scale=a.base_scales, use_pallas=a.use_pallas,
+            corr_chunk=a.corr_chunk,
+        ),
+        data=DataConfig(dataset=a.dataset, root=a.root,
+                        max_points=a.max_points, num_workers=a.num_workers,
+                        synthetic_size=a.synthetic_size),
+        train=TrainConfig(refine=a.refine, eval_iters=a.eval_iters),
+        exp_path=a.exp_path,
+    )
+
+    if a.platform:
+        import jax
+
+        jax.config.update("jax_platforms", a.platform)
+
+    from pvraft_tpu.engine.evaluator import Evaluator
+
+    ev = Evaluator(cfg)
+    if a.weights:
+        ev.load(a.weights)
+    means = ev.run(dump_dir=a.dump_dir)
+    print({k: round(v, 4) for k, v in sorted(means.items())})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+
+This is the JAX-idiomatic fake backend for exercising sharding/collectives
+without TPU hardware (SURVEY.md §4). Benchmarks (bench.py) run on the real
+chip instead.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# In this image jax is pre-imported at interpreter startup, so the platform
+# env var is captured before conftest runs — override through the config API
+# (this must happen before any backend is initialized, i.e. before tests run).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

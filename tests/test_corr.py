@@ -1,0 +1,68 @@
+"""Tests for the truncated correlation cache (ops/corr.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from pvraft_tpu.ops.corr import corr_init, corr_volume, knn_lookup
+
+
+def _rand(shape, seed):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def test_corr_volume_matches_numpy():
+    f1, f2 = _rand((2, 5, 8), 0), _rand((2, 7, 8), 1)
+    got = np.asarray(corr_volume(jnp.asarray(f1), jnp.asarray(f2)))
+    want = np.einsum("bnd,bmd->bnm", f1, f2) / np.sqrt(8.0)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_corr_init_topk_values_sorted_descending():
+    f1, f2 = _rand((1, 6, 4), 2), _rand((1, 32, 4), 3)
+    xyz2 = _rand((1, 32, 3), 4)
+    st = corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2), 8)
+    vals = np.asarray(st.corr)
+    assert vals.shape == (1, 6, 8)
+    assert np.all(np.diff(vals, axis=-1) <= 1e-6)
+    full = np.asarray(corr_volume(jnp.asarray(f1), jnp.asarray(f2)))
+    np.testing.assert_allclose(vals, -np.sort(-full, axis=-1)[..., :8], atol=1e-5)
+
+
+def test_corr_init_xyz_gather():
+    f1, f2 = _rand((1, 4, 4), 5), _rand((1, 16, 4), 6)
+    xyz2 = _rand((1, 16, 3), 7)
+    st = corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2), 5)
+    full = np.asarray(corr_volume(jnp.asarray(f1), jnp.asarray(f2)))
+    idx = np.argsort(-full, axis=-1)[..., :5]
+    want = xyz2[0][idx[0]]
+    np.testing.assert_allclose(np.asarray(st.xyz)[0], want, atol=1e-5)
+
+
+def test_chunked_equals_full():
+    f1, f2 = _rand((2, 8, 16), 8), _rand((2, 64, 16), 9)
+    xyz2 = _rand((2, 64, 3), 10)
+    full = corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2), 12)
+    chunked = corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2), 12, chunk=16)
+    np.testing.assert_allclose(
+        np.asarray(full.corr), np.asarray(chunked.corr), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(full.xyz), np.asarray(chunked.xyz), atol=1e-5
+    )
+
+
+def test_knn_lookup_picks_nearest():
+    f1, f2 = _rand((1, 3, 4), 11), _rand((1, 32, 4), 12)
+    xyz2 = _rand((1, 32, 3), 13)
+    st = corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2), 16)
+    coords = jnp.asarray(_rand((1, 3, 3), 14))
+    rel = st.xyz - coords[:, :, None, :]
+    knn_corr, rel_xyz = knn_lookup(st, rel, 4)
+    assert knn_corr.shape == (1, 3, 4)
+    assert rel_xyz.shape == (1, 3, 4, 3)
+    # Every selected distance must be <= every unselected distance.
+    rel_all = np.asarray(st.xyz) - np.asarray(coords)[:, :, None, :]
+    d_all = (rel_all**2).sum(-1)
+    d_sel = (np.asarray(rel_xyz) ** 2).sum(-1)
+    for ni in range(3):
+        assert d_sel[0, ni].max() <= np.sort(d_all[0, ni])[3] + 1e-5

@@ -1,0 +1,134 @@
+"""Data pipeline tests: subsampling, collation, loaders, FT3D/KITTI on a
+synthetic on-disk dataset."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pvraft_tpu.data import (
+    FT3D,
+    KITTI,
+    PrefetchLoader,
+    SyntheticDataset,
+    batches,
+    collate,
+)
+
+
+def test_item_shapes_and_exact_n():
+    ds = SyntheticDataset(size=4, nb_points=128, extra_points=64, seed=0)
+    it = ds[0]
+    assert it["pc1"].shape == (128, 3)
+    assert it["pc2"].shape == (128, 3)
+    assert it["mask"].shape == (128,)
+    assert it["flow"].shape == (128, 3)
+    assert it["pc1"].dtype == np.float32
+
+
+def test_flow_follows_pc1_permutation():
+    # With zero noise and no extra points the synthetic flow is pc2@R+t-pc1;
+    # after independent subsampling flow must still correspond to pc1's rows.
+    ds = SyntheticDataset(size=2, nb_points=64, seed=1)
+    pc1_full, pc2_full, mask, flow_full = ds.load_sequence(0)
+    it = ds[0]
+    # every sampled (pc1, flow) row pair must exist in the full set
+    full = {tuple(np.round(r, 5)) for r in np.concatenate([pc1_full, flow_full], 1)}
+    got = {tuple(np.round(r, 5)) for r in np.concatenate([it["pc1"], it["flow"]], 1)}
+    assert got <= full
+
+
+def test_collate_stacks():
+    ds = SyntheticDataset(size=4, nb_points=32, seed=2)
+    b = collate([ds[0], ds[1], ds[2]])
+    assert b["pc1"].shape == (3, 32, 3)
+    assert b["mask"].shape == (3, 32)
+
+
+def test_batches_lazy_and_epoch_reshuffle():
+    ds = SyntheticDataset(size=8, nb_points=16, seed=3)
+    b0 = [b["pc1"] for b in batches(ds, 2, shuffle=True, seed=5, epoch=0)]
+    b0_again = [b["pc1"] for b in batches(ds, 2, shuffle=True, seed=5, epoch=0)]
+    b1 = [b["pc1"] for b in batches(ds, 2, shuffle=True, seed=5, epoch=1)]
+    assert len(b0) == 4
+    np.testing.assert_allclose(np.stack(b0), np.stack(b0_again))
+    assert not np.allclose(np.stack(b0), np.stack(b1))
+
+
+def test_prefetch_loader_matches_serial():
+    ds = SyntheticDataset(size=10, nb_points=16, seed=4)
+    serial = list(batches(ds, 2, shuffle=True, seed=7, epoch=3))
+    loader = PrefetchLoader(ds, 2, shuffle=True, num_workers=3, seed=7)
+    threaded = list(loader.epoch(3))
+    assert len(serial) == len(threaded) == len(loader)
+    for a, b in zip(serial, threaded):
+        for k in a:
+            np.testing.assert_allclose(a[k], b[k])
+
+
+def test_prefetch_loader_propagates_errors():
+    class Broken(SyntheticDataset):
+        def load_sequence(self, idx):
+            raise RuntimeError("boom")
+
+    ds = Broken(size=4, nb_points=16)
+    loader = PrefetchLoader(ds, 2, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(loader.epoch(0))
+
+
+def _write_scene(path, n, rng):
+    os.makedirs(path, exist_ok=True)
+    pc1 = rng.uniform(-1, 1, (n, 3)).astype(np.float32)
+    pc2 = pc1 + rng.normal(0, 0.05, (n, 3)).astype(np.float32)
+    np.save(os.path.join(path, "pc1.npy"), pc1)
+    np.save(os.path.join(path, "pc2.npy"), pc2)
+    return pc1, pc2
+
+
+def test_ft3d_loading_and_sign_flip(tmp_path):
+    rng = np.random.default_rng(0)
+    scenes = {}
+    for i in range(10):
+        scenes[i] = _write_scene(str(tmp_path / "train" / f"{i:07d}"), 64, rng)
+    ds = FT3D(str(tmp_path), nb_points=32, mode="train", strict_sizes=False)
+    val = FT3D(str(tmp_path), nb_points=32, mode="val", strict_sizes=False)
+    assert len(ds) + len(val) == 10
+    pc1, pc2, mask, flow = ds.load_sequence(0)
+    scene_idx = int(os.path.basename(ds.filenames[0]))
+    raw1, raw2 = scenes[scene_idx]
+    np.testing.assert_allclose(pc1[:, 0], -raw1[:, 0])  # x flip
+    np.testing.assert_allclose(pc1[:, 1], raw1[:, 1])   # y kept
+    np.testing.assert_allclose(pc1[:, 2], -raw1[:, 2])  # z flip
+    np.testing.assert_allclose(flow, pc2 - pc1, atol=1e-6)
+    assert mask.min() == 1.0
+
+
+def test_ft3d_train_val_disjoint(tmp_path):
+    rng = np.random.default_rng(1)
+    for i in range(10):
+        _write_scene(str(tmp_path / "train" / f"{i:07d}"), 16, rng)
+    tr = FT3D(str(tmp_path), 8, "train", strict_sizes=False)
+    va = FT3D(str(tmp_path), 8, "val", strict_sizes=False)
+    assert set(tr.filenames).isdisjoint(va.filenames)
+
+
+def test_kitti_filters(tmp_path):
+    rng = np.random.default_rng(2)
+    # Scene dirs named by index; only some are in the 142-scene eval set.
+    for i in [2, 3, 4, 5, 7]:  # 2,3,7 in eval set; 4,5 not
+        path = str(tmp_path / f"{i:06d}")
+        os.makedirs(path)
+        n = 64
+        pc1 = rng.uniform(-1, 1, (n, 3)).astype(np.float32)
+        pc2 = pc1 + 0.01
+        # Make a few ground points (y < -1.4 in both) and far points (z>=35).
+        pc1[:4, 1] = pc2[:4, 1] = -2.0
+        pc1[4:8, 2] = 40.0
+        np.save(os.path.join(path, "pc1.npy"), pc1)
+        np.save(os.path.join(path, "pc2.npy"), pc2)
+    ds = KITTI(str(tmp_path), nb_points=16, strict_sizes=False)
+    assert [int(os.path.basename(p)) for p in ds.paths] == [2, 3, 7]
+    pc1, pc2, mask, flow = ds.load_sequence(0)
+    assert pc1.shape[0] == 64 - 8  # ground + far removed
+    assert (pc1[:, 2] < 35).all()

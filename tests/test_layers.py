@@ -1,0 +1,74 @@
+"""Layer tests: PReLU, GroupNorm parity with torch, SetConv shapes/perm-equivariance."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.models.layers import PReLU, SetConv, group_norm
+from pvraft_tpu.ops.geometry import build_graph
+
+
+def test_prelu_matches_definition():
+    x = jnp.asarray([-2.0, -0.5, 0.0, 1.5])
+    mod = PReLU()
+    params = mod.init(jax.random.key(0), x)
+    y = np.asarray(mod.apply(params, x))
+    np.testing.assert_allclose(y, [-0.5, -0.125, 0.0, 1.5], atol=1e-6)
+
+
+def test_group_norm_matches_torch():
+    import torch
+    import flax.linen as nn
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 11, 5, 16)).astype(np.float32)  # (B, N, k, C)
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return group_norm(x, "gn")
+
+    m = M()
+    params = m.init(jax.random.key(0), jnp.asarray(x))
+    got = np.asarray(m.apply(params, jnp.asarray(x)))
+
+    # torch layout (B, C, k, N); GroupNorm(8, 16) default affine=1/0 matches init.
+    tx = torch.from_numpy(x).permute(0, 3, 2, 1)
+    tg = torch.nn.GroupNorm(8, 16)
+    want = tg(tx).detach().numpy().transpose(0, 3, 2, 1)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_setconv_shapes_and_grads():
+    rng = np.random.default_rng(1)
+    pc = jnp.asarray(rng.normal(size=(2, 32, 3)).astype(np.float32))
+    graph = build_graph(pc, 8)
+    mod = SetConv(32)
+    params = mod.init(jax.random.key(0), pc, graph)
+    out = mod.apply(params, pc, graph)
+    assert out.shape == (2, 32, 32)
+
+    def loss(p):
+        return jnp.sum(mod.apply(p, pc, graph) ** 2)
+
+    g = jax.grad(loss)(params)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+    assert any(np.abs(np.asarray(l)).max() > 0 for l in leaves)
+
+
+def test_setconv_mid_width_rule():
+    """gconv.py:21-24: mid = (in+out)//2 if in even else out//2."""
+    rng = np.random.default_rng(2)
+    pc = jnp.asarray(rng.normal(size=(1, 16, 3)).astype(np.float32))
+    graph = build_graph(pc, 4)
+    mod = SetConv(32)
+    params = mod.init(jax.random.key(0), pc, graph)
+    # input 3 channels (odd) -> mid = 16
+    assert params["params"]["fc1"]["kernel"].shape == (6, 16)
+
+    feats = jnp.asarray(rng.normal(size=(1, 16, 32)).astype(np.float32))
+    mod2 = SetConv(64)
+    params2 = mod2.init(jax.random.key(0), feats, graph)
+    # input 32 (even) -> mid = (64+32)//2 = 48
+    assert params2["params"]["fc1"]["kernel"].shape == (35, 48)

@@ -1,0 +1,158 @@
+"""Native data-plane tests (built on demand with g++; skipped without it)."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip("pvraft_tpu.native")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None and not native.native_available(),
+    reason="no compiler and no prebuilt native library",
+)
+
+
+def test_build_and_available():
+    assert native.native_available()
+
+
+def test_npy_read_f32(tmp_path):
+    arr = np.random.default_rng(0).normal(size=(37, 3)).astype(np.float32)
+    p = str(tmp_path / "a.npy")
+    np.save(p, arr)
+    got = native.npy_read(p)
+    np.testing.assert_array_equal(got, arr)
+    assert native.npy_shape(p) == (37, 3)
+
+
+def test_npy_read_f64_converts(tmp_path):
+    arr = np.random.default_rng(1).normal(size=(5, 3))
+    p = str(tmp_path / "b.npy")
+    np.save(p, arr)
+    got = native.npy_read(p)
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, arr.astype(np.float32), atol=1e-6)
+
+
+def test_load_scene_batch(tmp_path):
+    rng = np.random.default_rng(2)
+    paths1, paths2 = [], []
+    fulls = []
+    for i in range(3):
+        pc1 = rng.normal(size=(50 + i * 10, 3)).astype(np.float32)
+        pc2 = pc1 + 0.5
+        p1 = str(tmp_path / f"s{i}_pc1.npy")
+        p2 = str(tmp_path / f"s{i}_pc2.npy")
+        np.save(p1, pc1)
+        np.save(p2, pc2)
+        paths1.append(p1)
+        paths2.append(p2)
+        fulls.append((pc1, pc2))
+
+    n_pts = 32
+    pc1, pc2, mask, flow, status = native.load_scene_batch(
+        paths1, paths2, [0, 1, 2], n_pts, 256, seed=7, epoch=0,
+        flip_xz=False, n_threads=2,
+    )
+    assert status.tolist() == [1, 1, 1]
+    assert pc1.shape == (3, n_pts, 3)
+    np.testing.assert_array_equal(mask, 1.0)
+    for i in range(3):
+        full1, full2 = fulls[i]
+        # every sampled pc1 row exists in the full cloud
+        full_set = {tuple(np.round(r, 5)) for r in full1}
+        got_set = {tuple(np.round(r, 5)) for r in pc1[i]}
+        assert got_set <= full_set
+        # no duplicate rows (sampling without replacement)
+        assert len(got_set) == n_pts
+        # flow is index-aligned with pc1's sampling: pc2_full - pc1_full = 0.5
+        np.testing.assert_allclose(flow[i], 0.5, atol=1e-6)
+
+
+def test_load_scene_batch_flip_and_reject(tmp_path):
+    rng = np.random.default_rng(3)
+    big = rng.normal(size=(64, 3)).astype(np.float32)
+    small = rng.normal(size=(8, 3)).astype(np.float32)
+    for name, arr in [("big_pc1", big), ("big_pc2", big + 1),
+                      ("small_pc1", small), ("small_pc2", small)]:
+        np.save(str(tmp_path / f"{name}.npy"), arr)
+
+    pc1, _, _, _, status = native.load_scene_batch(
+        [str(tmp_path / "big_pc1.npy"), str(tmp_path / "small_pc1.npy")],
+        [str(tmp_path / "big_pc2.npy"), str(tmp_path / "small_pc2.npy")],
+        [0, 1], 32, 256, seed=1, epoch=0, flip_xz=True, n_threads=1,
+    )
+    assert status.tolist() == [1, 0]  # small scene rejected
+    # flip applied to x and z, not y: the sampled rows must be in the
+    # flipped full set.
+    flipped = big.copy()
+    flipped[:, 0] *= -1
+    flipped[:, 2] *= -1
+    full_set = {tuple(np.round(r, 5)) for r in flipped}
+    got_set = {tuple(np.round(r, 5)) for r in pc1[0]}
+    assert got_set <= full_set
+
+
+def test_determinism_across_calls(tmp_path):
+    rng = np.random.default_rng(4)
+    pc = rng.normal(size=(40, 3)).astype(np.float32)
+    np.save(str(tmp_path / "pc1.npy"), pc)
+    np.save(str(tmp_path / "pc2.npy"), pc + 1)
+    args = ([str(tmp_path / "pc1.npy")], [str(tmp_path / "pc2.npy")], [5],
+            16, 64)
+    a = native.load_scene_batch(*args, seed=9, epoch=3, flip_xz=False)
+    b = native.load_scene_batch(*args, seed=9, epoch=3, flip_xz=False)
+    c = native.load_scene_batch(*args, seed=9, epoch=4, flip_xz=False)
+    np.testing.assert_array_equal(a[0], b[0])
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_ft3d_native_loader_end_to_end(tmp_path):
+    from pvraft_tpu.data import FT3D, PrefetchLoader
+
+    rng = np.random.default_rng(5)
+    fulls = {}
+    for i in range(6):
+        scene = tmp_path / "train" / f"{i:07d}"
+        scene.mkdir(parents=True)
+        n = 48 + 8 * i
+        pc1 = rng.normal(size=(n, 3)).astype(np.float32)
+        pc2 = pc1 + rng.normal(0, 0.1, size=(n, 3)).astype(np.float32)
+        np.save(scene / "pc1.npy", pc1)
+        np.save(scene / "pc2.npy", pc2)
+        fulls[str(scene)] = (pc1, pc2)
+
+    ds = FT3D(str(tmp_path), nb_points=32, mode="train", strict_sizes=False)
+    loader = PrefetchLoader(ds, 2, shuffle=True, num_workers=2, native=True)
+    assert loader.native
+    batches = list(loader.epoch(0))
+    assert len(batches) == len(ds) // 2
+    for b in batches:
+        assert b["pc1"].shape == (2, 32, 3)
+        assert b["flow"].shape == (2, 32, 3)
+        np.testing.assert_array_equal(b["mask"], 1.0)
+        # flow is index-aligned: pc1 + flow must equal the flipped full pc2
+        # at the matching row.
+        for bi in range(2):
+            warped = b["pc1"][bi] + b["flow"][bi]
+            # find which scene this came from by matching against fulls
+            matched = False
+            for scene, (f1, f2) in fulls.items():
+                flip1 = f1 * np.asarray([-1, 1, -1], np.float32)
+                flip2 = f2 * np.asarray([-1, 1, -1], np.float32)
+                rows = {tuple(np.round(r, 4)) for r in flip1}
+                if {tuple(np.round(r, 4)) for r in b["pc1"][bi]} <= rows:
+                    lookup = {
+                        tuple(np.round(flip1[j], 4)): flip2[j]
+                        for j in range(flip1.shape[0])
+                    }
+                    for r in range(32):
+                        key = tuple(np.round(b["pc1"][bi][r], 4))
+                        np.testing.assert_allclose(
+                            warped[r], lookup[key], atol=1e-4
+                        )
+                    matched = True
+                    break
+            assert matched

@@ -1,0 +1,118 @@
+"""Mesh / sharding / ring-correlation tests on the 8-device CPU mesh."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from pvraft_tpu.ops.corr import CorrState, corr_init
+from pvraft_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+from pvraft_tpu.parallel.ring import ring_corr_init
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    mesh2 = make_mesh(n_data=4, n_seq=2)
+    assert mesh2.shape == {"data": 4, "seq": 2}
+    with pytest.raises(ValueError):
+        make_mesh(n_data=3, n_seq=2)
+
+
+def test_shard_batch_and_replicate():
+    mesh = make_mesh(n_data=8)
+    batch = {"pc1": jnp.zeros((8, 16, 3)), "mask": jnp.zeros((8, 16))}
+    sharded = shard_batch(batch, mesh)
+    assert sharded["pc1"].sharding.spec == P("data")
+    params = replicate({"w": jnp.ones((4, 4))}, mesh)
+    assert params["w"].sharding.spec == P()
+
+
+def test_ring_corr_matches_single_device():
+    mesh = make_mesh(n_data=1, n_seq=8)
+    rng = np.random.default_rng(0)
+    b, n1, n2, d, k = 2, 16, 64, 8, 8
+    f1 = jnp.asarray(rng.normal(size=(b, n1, d)).astype(np.float32))
+    f2 = jnp.asarray(rng.normal(size=(b, n2, d)).astype(np.float32))
+    x2 = jnp.asarray(rng.normal(size=(b, n2, 3)).astype(np.float32))
+
+    ref = corr_init(f1, f2, x2, k)
+
+    ring = shard_map(
+        lambda a, bb, c: ring_corr_init(a, bb, c, k, "seq"),
+        mesh=mesh,
+        in_specs=(P(None, "seq", None), P(None, "seq", None), P(None, "seq", None)),
+        out_specs=CorrState(
+            corr=P(None, "seq", None), xyz=P(None, "seq", None, None)
+        ),
+        check_rep=False,
+    )
+    got = ring(f1, f2, x2)
+    np.testing.assert_allclose(np.asarray(got.corr), np.asarray(ref.corr), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got.xyz), np.asarray(ref.xyz), atol=1e-5)
+
+
+def test_dp_train_step_matches_single_device():
+    """Gradient all-reduce over the mesh must equal single-device training."""
+    import optax
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.models import PVRaft
+
+    cfg = ModelConfig(truncate_k=8, corr_knn=4, graph_k=4)
+    model = PVRaft(cfg)
+    rng = np.random.default_rng(1)
+    b, n = 8, 32
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (b, n, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (b, n, 3)).astype(np.float32))
+    mask = jnp.ones((b, n), jnp.float32)
+    gt = pc2 - pc1
+
+    params = model.init(jax.random.key(0), pc1, pc2, 2)
+    tx = optax.sgd(1e-2)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, pc1, pc2, mask, gt):
+        def loss_fn(p):
+            flows, _ = model.apply(p, pc1, pc2, 2)
+            return sequence_loss(flows, mask, gt, 0.8)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # Single device.
+    p1, _, loss1 = jax.jit(step)(params, opt_state, pc1, pc2, mask, gt)
+
+    # 8-way data parallel via shardings.
+    mesh = make_mesh(n_data=8)
+    pr = replicate(params, mesh)
+    opr = replicate(opt_state, mesh)
+    batch = shard_batch({"pc1": pc1, "pc2": pc2, "mask": mask, "gt": gt}, mesh)
+    p2, _, loss2 = jax.jit(step)(
+        pr, opr, batch["pc1"], batch["pc2"], batch["mask"], batch["gt"]
+    )
+    np.testing.assert_allclose(float(loss1), float(loss2), atol=1e-5)
+    l1 = jax.tree_util.tree_leaves(p1)
+    l2 = jax.tree_util.tree_leaves(p2)
+    for a, b_ in zip(l1, l2):
+        # Cross-device gradient accumulation reorders fp32 sums; observed
+        # max |diff| ~1e-4 after one sgd step on this tiny model.
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4)
+
+
+def test_graft_entry_dryrun():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+
+
+def test_graft_entry_compiles():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (1, 1024, 3)
+    assert np.all(np.isfinite(np.asarray(out)))

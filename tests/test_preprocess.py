@@ -1,0 +1,152 @@
+"""Preprocessing tests: format readers (round-trip against written files)
+and back-projection geometry."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from pvraft_tpu.data.preprocess.io_formats import (
+    read_flo,
+    read_kitti_disparity,
+    read_kitti_flow,
+    read_pfm,
+)
+from pvraft_tpu.data.preprocess.flyingthings3d import backproject
+from pvraft_tpu.data.preprocess.kitti import (
+    backproject_kitti,
+    disparity_to_depth,
+    read_calib,
+)
+
+
+def _write_pfm(path, img, scale=-1.0):
+    h, w = img.shape
+    with open(path, "wb") as f:
+        f.write(b"Pf\n")
+        f.write(f"{w} {h}\n".encode())
+        f.write(f"{scale}\n".encode())
+        f.write(np.flipud(img).astype("<f4").tobytes())
+
+
+def _write_flo(path, flow):
+    h, w, _ = flow.shape
+    with open(path, "wb") as f:
+        f.write(struct.pack("<f", 202021.25))
+        f.write(struct.pack("<i", w))
+        f.write(struct.pack("<i", h))
+        f.write(flow.astype("<f4").tobytes())
+
+
+def test_pfm_roundtrip(tmp_path):
+    img = np.random.default_rng(0).normal(size=(6, 9)).astype(np.float32)
+    p = str(tmp_path / "x.pfm")
+    _write_pfm(p, img)
+    np.testing.assert_allclose(read_pfm(p), img, atol=1e-6)
+
+
+def test_flo_roundtrip(tmp_path):
+    flow = np.random.default_rng(1).normal(size=(5, 7, 2)).astype(np.float32)
+    p = str(tmp_path / "x.flo")
+    _write_flo(p, flow)
+    np.testing.assert_allclose(read_flo(p), flow, atol=1e-6)
+
+
+def test_kitti_png_decoding(tmp_path):
+    import imageio.v2 as imageio
+
+    disp = np.zeros((4, 6), np.uint16)
+    disp[1, 2] = 256 * 10  # 10 px disparity
+    p = str(tmp_path / "d.png")
+    imageio.imwrite(p, disp)
+    d, valid = read_kitti_disparity(p)
+    assert d[1, 2] == pytest.approx(10.0)
+    assert valid[1, 2] and not valid[0, 0]
+    assert d[0, 0] == -1.0
+
+    import cv2
+
+    fl = np.zeros((4, 6, 3), np.uint16)
+    fl[2, 3, 0] = 2**15 + 64 * 3  # u = +3 px
+    fl[2, 3, 1] = 2**15 - 64 * 2  # v = -2 px
+    fl[2, 3, 2] = 1
+    pf = str(tmp_path / "f.png")
+    cv2.imwrite(pf, fl[..., ::-1])  # cv2 writes BGR -> file stores RGB
+    flow, vmask = read_kitti_flow(pf)
+    assert flow[2, 3, 0] == pytest.approx(3.0)
+    assert flow[2, 3, 1] == pytest.approx(-2.0)
+    assert vmask[2, 3] and not vmask[0, 0]
+
+
+def test_ft3d_backprojection_geometry():
+    # A pixel at the principal point with disparity d: x=y=0, z=1050/d.
+    disp = np.full((540, 960), 10.0, np.float32)
+    pc = backproject(disp)
+    cy, cx = 269, 479  # just left/above the principal point (cx=479.5)
+    assert pc[cy, cx, 2] == pytest.approx(-(-1050.0) / 10.0)
+    assert abs(pc[cy, cx, 0]) < 0.06  # 0.5 px / 10 disparity
+    assert abs(pc[cy, cx, 1]) < 0.06
+    # Flow advects the projected pixel.
+    flow = np.zeros((540, 960, 2), np.float32)
+    flow[..., 0] = 10.0
+    pc2 = backproject(disp, flow)
+    np.testing.assert_allclose(pc2[..., 0], pc[..., 0] - 1.0, atol=1e-5)
+
+
+def test_kitti_calib_and_backprojection(tmp_path):
+    calib = tmp_path / "000000.txt"
+    f = 721.5377
+    calib.write_text(
+        "P_rect_02: "
+        f"{f} 0.0 609.5593 44.85728 0.0 {f} 172.854 0.2163791 0.0 0.0 1.0 0.002745884\n"
+    )
+    p = read_calib(str(calib))
+    assert p[0, 0] == pytest.approx(f)
+
+    disp = np.full((8, 10), 2.0, np.float32)
+    valid = np.ones((8, 10), bool)
+    depth = disparity_to_depth(disp, valid, p[0, 0])
+    assert depth[0, 0] == pytest.approx(f * 0.54 / 2.0, rel=1e-4)
+    pc = backproject_kitti(depth, p)
+    assert pc.shape == (8, 10, 3)
+    assert np.all(pc[..., 2] == depth)
+
+
+def test_ft3d_process_scene_end_to_end(tmp_path):
+    """Synthesize a miniature raw FT3D tree and check the written scene."""
+    import imageio.v2 as imageio
+    from pvraft_tpu.data.preprocess.flyingthings3d import process_scene
+
+    raw = tmp_path / "raw"
+    h, w = 12, 16
+    rng = np.random.default_rng(3)
+    disp = rng.uniform(5, 20, (h, w)).astype(np.float32)
+    dchange = rng.uniform(-1, 1, (h, w)).astype(np.float32)
+    flow = rng.uniform(-2, 2, (h, w, 2)).astype(np.float32)
+    occ = np.zeros((h, w), np.uint8)
+    occ[0, :] = 255  # first row occluded
+
+    base = raw / "train"
+    for sub in [
+        "disparity/left", "disparity_occlusions/left",
+        "disparity_change/left/into_future", "flow/left/into_future",
+        "flow_occlusions/left/into_future",
+    ]:
+        (base / sub).mkdir(parents=True)
+    _write_pfm(str(base / "disparity/left/0000000.pfm"), disp)
+    _write_pfm(str(base / "disparity_change/left/into_future/0000000.pfm"), dchange)
+    _write_flo(str(base / "flow/left/into_future/0000000.flo"), flow)
+    imageio.imwrite(str(base / "disparity_occlusions/left/0000000.png"), occ)
+    imageio.imwrite(
+        str(base / "flow_occlusions/left/into_future/0000000.png"),
+        np.zeros((h, w), np.uint8),
+    )
+
+    out = tmp_path / "out"
+    n1, n2 = process_scene(str(raw), str(out), "train", "0000000")
+    assert n1 == n2 == (h - 1) * w  # occluded row dropped
+    pc1 = np.load(out / "train" / "0000000" / "pc1.npy")
+    pc2 = np.load(out / "train" / "0000000" / "pc2.npy")
+    assert pc1.shape == pc2.shape == ((h - 1) * w, 3)
+    assert np.all(np.isfinite(pc1)) and np.all(np.isfinite(pc2))

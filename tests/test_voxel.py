@@ -1,0 +1,64 @@
+"""Voxel binning vs a direct numpy oracle (semantics of model/corr.py:47-69)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from pvraft_tpu.ops.voxel import voxel_bin_means
+
+
+def _oracle(corr, rel, num_levels, base_scale, resolution):
+    b, n, k = corr.shape
+    half = resolution // 2
+    r3 = resolution**3
+    out = np.zeros((b, n, num_levels * r3), np.float32)
+    for lvl in range(num_levels):
+        r = base_scale * (2**lvl)
+        for bi in range(b):
+            for ni in range(n):
+                sums = np.zeros(r3)
+                cnts = np.zeros(r3)
+                for ki in range(k):
+                    dv = np.round(rel[bi, ni, ki] / r)
+                    if np.all(np.abs(dv) <= half):
+                        ix = int(
+                            (dv[0] + half) * resolution**2
+                            + (dv[1] + half) * resolution
+                            + (dv[2] + half)
+                        )
+                        sums[ix] += corr[bi, ni, ki]
+                        cnts[ix] += 1.0
+                out[bi, ni, lvl * r3 : (lvl + 1) * r3] = sums / np.clip(cnts, 1, n)
+    return out
+
+
+def test_voxel_bin_means_matches_oracle():
+    rng = np.random.default_rng(0)
+    b, n, k = 2, 6, 40
+    corr = rng.normal(size=(b, n, k)).astype(np.float32)
+    rel = rng.uniform(-2.0, 2.0, size=(b, n, k, 3)).astype(np.float32)
+    got = np.asarray(
+        voxel_bin_means(jnp.asarray(corr), jnp.asarray(rel), 3, 0.25, 3)
+    )
+    want = _oracle(corr, rel, 3, 0.25, 3)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_voxel_all_invalid_gives_zeros():
+    # Candidates far outside every cube level: means must be exactly zero.
+    corr = jnp.ones((1, 3, 8), jnp.float32)
+    rel = jnp.full((1, 3, 8, 3), 100.0, jnp.float32)
+    out = np.asarray(voxel_bin_means(corr, rel, 2, 0.25, 3))
+    np.testing.assert_array_equal(out, 0.0)
+
+
+def test_voxel_single_cell_mean():
+    # All candidates at the query point -> center cell mean = mean(corr).
+    # N >= K so the count clamp (corr.py:65 semantics: clip to [1, N]) is inert.
+    rng = np.random.default_rng(1)
+    corr = rng.normal(size=(1, 32, 16)).astype(np.float32)
+    rel = np.zeros((1, 32, 16, 3), np.float32)
+    out = np.asarray(voxel_bin_means(jnp.asarray(corr), jnp.asarray(rel), 1, 0.25, 3))
+    center = 13  # (1,1,1) of a 3x3x3 cube
+    np.testing.assert_allclose(out[:, :, center], corr.mean(-1), atol=1e-5)
+    rest = np.delete(out, center, axis=-1)
+    np.testing.assert_array_equal(rest, 0.0)

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Training entry point.
+
+Same CLI surface as the reference (``train.py:8-71``): dataset/root/
+max_points/corr_levels/base_scales/truncate_k/iters/gamma/batch_size/
+num_epochs/weights/checkpoint_interval/refine, plus TPU-specific mesh flags
+replacing ``--gpus`` (``train.py:89`` set CUDA_VISIBLE_DEVICES; here the
+device mesh is chosen explicitly). Epoch loop: train -> val each epoch,
+test once at the end (``train.py:81-84``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from pvraft_tpu.config import Config, DataConfig, ModelConfig, ParallelConfig, TrainConfig
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("pvraft_tpu train")
+    p.add_argument("--root", default="", help="preprocessed dataset root")
+    p.add_argument("--exp_path", default="experiments/default")
+    p.add_argument("--dataset", default="FT3D",
+                   choices=["FT3D", "synthetic"])
+    p.add_argument("--max_points", type=int, default=8192)
+    p.add_argument("--corr_levels", type=int, default=3)
+    p.add_argument("--base_scales", type=float, default=0.25)
+    p.add_argument("--truncate_k", type=int, default=512)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--eval_iters", type=int, default=32,
+                   help="GRU iterations at val/test (reference hardcodes 32)")
+    p.add_argument("--gamma", type=float, default=0.8)
+    p.add_argument("--batch_size", type=int, default=2)
+    p.add_argument("--num_epochs", type=int, default=20)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--lr_schedule", default="parity",
+                   choices=["parity", "cosine", "constant"])
+    p.add_argument("--weights", default=None,
+                   help="checkpoint to resume from (restores epoch+optimizer)")
+    p.add_argument("--stage1_weights", default=None,
+                   help="stage-1 checkpoint to import when --refine")
+    p.add_argument("--checkpoint_interval", type=int, default=5)
+    p.add_argument("--refine", action="store_true")
+    p.add_argument("--num_workers", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--data_parallel", type=int, default=-1,
+                   help="devices on the data mesh axis (-1: all)")
+    p.add_argument("--seq_parallel", type=int, default=1,
+                   help="devices on the sequence mesh axis")
+    p.add_argument("--use_pallas", action="store_true",
+                   help="Pallas voxel kernel instead of the XLA fallback")
+    p.add_argument("--corr_chunk", type=int, default=None,
+                   help="streaming top-k chunk over N2 (memory saver)")
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--synthetic_size", type=int, default=64)
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
+                   help="force a jax platform (e.g. cpu for host debugging)")
+    p.add_argument("--profile_dir", default="",
+                   help="write a jax profiler trace of the first epoch here")
+    return p.parse_args(argv)
+
+
+def config_from_args(a: argparse.Namespace) -> Config:
+    return Config(
+        model=ModelConfig(
+            truncate_k=a.truncate_k,
+            corr_levels=a.corr_levels,
+            base_scale=a.base_scales,
+            compute_dtype="bfloat16" if a.bf16 else "float32",
+            use_pallas=a.use_pallas,
+            corr_chunk=a.corr_chunk,
+            remat=a.remat,
+        ),
+        data=DataConfig(
+            dataset=a.dataset, root=a.root, max_points=a.max_points,
+            num_workers=a.num_workers, synthetic_size=a.synthetic_size,
+        ),
+        train=TrainConfig(
+            batch_size=a.batch_size, num_epochs=a.num_epochs, lr=a.lr,
+            gamma=a.gamma, iters=a.iters, eval_iters=a.eval_iters,
+            checkpoint_interval=a.checkpoint_interval, refine=a.refine,
+            seed=a.seed, lr_schedule=a.lr_schedule, profile_dir=a.profile_dir,
+        ),
+        parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel),
+        exp_path=a.exp_path,
+    )
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    cfg = config_from_args(args)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from pvraft_tpu.engine.trainer import Trainer
+    from pvraft_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(
+        n_data=None if args.data_parallel < 0 else args.data_parallel,
+        n_seq=args.seq_parallel,
+    )
+    trainer = Trainer(cfg, mesh=mesh)
+    if args.refine and args.stage1_weights:
+        trainer.load_stage1_weights(args.stage1_weights)
+    if args.weights:
+        trainer.load_weights(args.weights, resume=True)
+    final = trainer.fit()
+    print({k: round(v, 4) for k, v in final.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Visualize a dumped scene-flow result.
+
+Equivalent of the reference ``visual.py`` (mayavi 3-cloud render of
+``result/<dataset>/<idx>/{pc1,pc2,flow}.npy``, ``visual.py:11-30``) using
+matplotlib (headless-friendly): pc1 red, pc2 green, pc1+flow blue, written
+to a PNG. Produce the inputs with ``test.py --dump_dir result``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def render(scene_dir: str, out_path: str, point_size: float = 0.5) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    pc1 = np.load(os.path.join(scene_dir, "pc1.npy"))
+    pc2 = np.load(os.path.join(scene_dir, "pc2.npy"))
+    flow = np.load(os.path.join(scene_dir, "flow.npy"))
+
+    fig = plt.figure(figsize=(10, 8))
+    ax = fig.add_subplot(111, projection="3d")
+    ax.scatter(*pc1.T, s=point_size, c="#d62728", label="pc1 (t)")
+    ax.scatter(*pc2.T, s=point_size, c="#2ca02c", label="pc2 (t+1)")
+    warped = pc1 + flow
+    ax.scatter(*warped.T, s=point_size, c="#1f77b4", label="pc1 + flow")
+    ax.legend(loc="upper right")
+    ax.set_box_aspect((1, 1, 1))
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=150)
+    plt.close(fig)
+    return out_path
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("pvraft_tpu visual")
+    p.add_argument("--result_root", default="result")
+    p.add_argument("--dataset", default="FT3D")
+    p.add_argument("--index", type=int, default=0)
+    p.add_argument("--out", default=None)
+    a = p.parse_args(argv)
+    scene = os.path.join(a.result_root, a.dataset, str(a.index))
+    out = a.out or os.path.join(scene, "render.png")
+    print(render(scene, out))
+
+
+if __name__ == "__main__":
+    main()
