@@ -22,7 +22,43 @@ import numpy as np
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 17640 * 20 / (53 * 3600) / 2 * 8192  # ~7575
 
 
+def _devices_with_watchdog(timeout_s: float = 600.0):
+    """Initialize the backend with a timeout: a wedged remote TPU claim
+    (observed when a client dies mid-compile) would otherwise hang forever."""
+    import threading
+
+    import jax
+
+    result = {}
+
+    def probe():
+        try:
+            result["devices"] = jax.devices()
+        except Exception as e:  # pragma: no cover
+            result["error"] = str(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" not in result:
+        print(
+            json.dumps(
+                {
+                    "metric": "train_point_pairs_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "point-pairs/s/chip (8192 pts, 8 iters, bs=2, fwd+bwd+adam)",
+                    "vs_baseline": 0.0,
+                    "note": f"backend init failed/hung ({result.get('error', 'timeout')})",
+                }
+            )
+        )
+        raise SystemExit(0)
+    return result["devices"]
+
+
 def main() -> None:
+    _devices_with_watchdog()
+
     import jax
     import jax.numpy as jnp
     import optax

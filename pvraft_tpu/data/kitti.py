@@ -73,6 +73,9 @@ class KITTI(SceneFlowDataset):
     def __len__(self) -> int:
         return len(self.paths)
 
+    # NOTE: no native_paths here — the KITTI load path applies ground/depth
+    # filtering (below) that the native assembler does not implement.
+
     def load_sequence(self, idx: int):
         scene = self.paths[idx]
         pc1 = np.load(os.path.join(scene, "pc1.npy")).astype(np.float32)

@@ -72,7 +72,10 @@ class PVRaft(nn.Module):
         fmap1, graph1 = feat(xyz1)
         fmap2, _ = feat(xyz2)
 
-        state = corr_init(fmap1, fmap2, xyz2, cfg.truncate_k, cfg.corr_chunk)
+        state = corr_init(
+            fmap1, fmap2, xyz2, cfg.truncate_k, cfg.corr_chunk,
+            approx=cfg.approx_topk,
+        )
 
         fct, graph_ctx = PointEncoder(
             cfg.encoder_width, cfg.graph_k, dtype=dtype, name="context_extractor"

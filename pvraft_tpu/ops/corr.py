@@ -51,6 +51,7 @@ def corr_init(
     xyz2: jnp.ndarray,
     truncate_k: int,
     chunk: Optional[int] = None,
+    approx: bool = False,
 ) -> CorrState:
     """Build the truncated correlation cache (``model/corr.py:31-42``).
 
@@ -63,7 +64,12 @@ def corr_init(
     """
     if chunk is None:
         corr = corr_volume(fmap1, fmap2)
-        vals, idx = lax.top_k(corr, truncate_k)
+        if approx:
+            # TPU-native approximate top-k (recall ~0.95): substantially
+            # cheaper than the sort-based exact path at N=8192, K=512.
+            vals, idx = lax.approx_max_k(corr, truncate_k)
+        else:
+            vals, idx = lax.top_k(corr, truncate_k)
         return CorrState(corr=vals, xyz=gather_neighbors(xyz2, idx))
 
     b, m, d = fmap2.shape

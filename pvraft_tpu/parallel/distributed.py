@@ -1,0 +1,64 @@
+"""Multi-host distributed initialization.
+
+The reference's only scaling mechanism is single-process DataParallel
+(``tools/engine.py:63-64``); there is no multi-node path at all. Here
+multi-host is the same code path as single-host: initialize the JAX
+distributed runtime (one process per host, all devices join one global
+mesh), then build the ``(data, seq)`` mesh over ``jax.devices()`` as usual —
+XLA routes collectives over ICI within a slice and DCN across slices.
+No NCCL/MPI-style backend code exists anywhere in this framework; the
+"communication backend" is the XLA runtime itself.
+
+Typical launch (per host)::
+
+    python -c "from pvraft_tpu.parallel.distributed import initialize;
+               initialize()"  # env-driven on TPU pods
+
+or explicitly::
+
+    initialize(coordinator_address="host0:1234", num_processes=4,
+               process_id=rank)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize ``jax.distributed`` (idempotent; no-op on single host).
+
+    With no arguments, relies on the TPU pod environment variables that
+    JAX reads natively. Returns True when the distributed runtime is
+    (already) initialized, False when running single-process.
+    """
+    import jax
+
+    if num_processes is None and coordinator_address is None:
+        # Single-host unless the environment advertises a multi-host pod.
+        import os
+
+        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        multi = len([h for h in hosts.split(",") if h.strip()]) > 1
+        if "JAX_COORDINATOR_ADDRESS" not in os.environ and not multi:
+            return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already" in msg:
+            return True
+        if "must be called before" in msg:
+            # Backend already initialized single-process (e.g. interactive
+            # use); not fatal — collectives stay single-host.
+            return False
+        raise

@@ -1,0 +1,43 @@
+"""Golden-value regression test.
+
+Pins the full forward pass (fixed seeds, tiny config) to values captured on
+the CPU backend. Catches unintended numerical drift anywhere in the
+ops/model stack — the role the reference delegates to re-running published
+checkpoints (SURVEY.md §4). Tolerances absorb backend differences (CPU vs
+TPU matmul order), not semantic changes.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.config import ModelConfig
+from pvraft_tpu.models.raft import PVRaft
+
+GOLDEN_SUM = -214.65081787109375
+GOLDEN_ABSMEAN = 0.5731257200241089
+GOLDEN_LAST5 = np.asarray(
+    [
+        [-1.6915783882141113, 0.825812816619873, 0.03206080198287964],
+        [-0.8794500827789307, -1.0033411979675293, -0.4174124002456665],
+        [-1.8202546834945679, -0.9756306409835815, 0.33336758613586426],
+        [-1.4932647943496704, -1.61688232421875, 0.23034626245498657],
+        [-1.9090666770935059, -1.4565377235412598, 0.2609832286834717],
+    ],
+    np.float32,
+)
+
+
+def test_forward_matches_golden():
+    cfg = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8)
+    rng = np.random.default_rng(42)
+    xyz1 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 3)).astype(np.float32))
+    xyz2 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 3)).astype(np.float32))
+    model = PVRaft(cfg)
+    params = model.init(jax.random.key(7), xyz1, xyz2, 2)
+    flows, _ = model.apply(params, xyz1, xyz2, num_iters=3)
+    f = np.asarray(flows)
+    assert f.shape == (3, 1, 64, 3)
+    np.testing.assert_allclose(float(f.sum()), GOLDEN_SUM, rtol=1e-4)
+    np.testing.assert_allclose(float(np.abs(f).mean()), GOLDEN_ABSMEAN, rtol=1e-4)
+    np.testing.assert_allclose(f[-1, 0, :5, :], GOLDEN_LAST5, atol=1e-3)

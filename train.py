@@ -52,6 +52,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--corr_chunk", type=int, default=None,
                    help="streaming top-k chunk over N2 (memory saver)")
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--approx_topk", action="store_true",
+                   help="approximate correlation truncation (faster on TPU)")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--synthetic_size", type=int, default=64)
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
@@ -71,6 +73,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
             use_pallas=a.use_pallas,
             corr_chunk=a.corr_chunk,
             remat=a.remat,
+            approx_topk=a.approx_topk,
         ),
         data=DataConfig(
             dataset=a.dataset, root=a.root, max_points=a.max_points,
