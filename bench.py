@@ -10,16 +10,36 @@ Baseline (BASELINE.md): the reference trains 20 epochs x 17,640 samples in
 ~53 h on 2x RTX 2080 Ti => 1.849 samples/s total, 0.925 samples/s per GPU
 = 7,575 point-pairs/s per GPU at 8,192 points/sample. vs_baseline is our
 per-chip rate over that per-GPU rate.
+
+Tries the fastest numerics first (bf16 + Pallas voxel kernel + approximate
+top-k) and falls back to progressively safer configurations if a variant
+fails to compile/run, so a kernel regression can never zero the benchmark.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
+import traceback
 
 import numpy as np
 
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 17640 * 20 / (53 * 3600) / 2 * 8192  # ~7575
+
+# Env overrides are for smoke-testing the bench itself on small hosts; the
+# driver runs the defaults (the reference training configuration).
+N_POINTS = int(os.environ.get("PVRAFT_BENCH_POINTS", 8192))
+ITERS = int(os.environ.get("PVRAFT_BENCH_ITERS", 8))
+BATCH = int(os.environ.get("PVRAFT_BENCH_BATCH", 2))  # reference run.sh bs
+TRUNCATE_K = int(os.environ.get("PVRAFT_BENCH_K", 512))
+
+
+def _unit() -> str:
+    return (
+        f"point-pairs/s/chip ({N_POINTS} pts, {ITERS} iters, "
+        f"bs={BATCH}, fwd+bwd+adam)"
+    )
 
 
 def _devices_with_watchdog(timeout_s: float = 600.0):
@@ -46,7 +66,7 @@ def _devices_with_watchdog(timeout_s: float = 600.0):
                 {
                     "metric": "train_point_pairs_per_sec_per_chip",
                     "value": 0.0,
-                    "unit": "point-pairs/s/chip (8192 pts, 8 iters, bs=2, fwd+bwd+adam)",
+                    "unit": _unit(),
                     "vs_baseline": 0.0,
                     "note": f"backend init failed/hung ({result.get('error', 'timeout')})",
                 }
@@ -56,9 +76,8 @@ def _devices_with_watchdog(timeout_s: float = 600.0):
     return result["devices"]
 
 
-def main() -> None:
-    _devices_with_watchdog()
-
+def _run_variant(model_kwargs: dict) -> float:
+    """Steady-state seconds per train step for one model configuration."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -67,18 +86,14 @@ def main() -> None:
     from pvraft_tpu.engine.loss import sequence_loss
     from pvraft_tpu.models import PVRaft
 
-    n_points = 8192
-    iters = 8
-    batch = 2  # reference run.sh batch size
-
-    cfg = ModelConfig(truncate_k=512)
+    cfg = ModelConfig(truncate_k=TRUNCATE_K, **model_kwargs)
     model = PVRaft(cfg)
 
     rng = np.random.default_rng(0)
-    pc1 = jnp.asarray(rng.uniform(-1, 1, (batch, n_points, 3)).astype(np.float32))
-    pc2 = jnp.asarray(rng.uniform(-1, 1, (batch, n_points, 3)).astype(np.float32))
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (BATCH, N_POINTS, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (BATCH, N_POINTS, 3)).astype(np.float32))
     gt = pc2 - pc1
-    mask = jnp.ones((batch, n_points), jnp.float32)
+    mask = jnp.ones((BATCH, N_POINTS), jnp.float32)
 
     params = model.init(jax.random.key(0), pc1[:, :256], pc2[:, :256], 2)
     tx = optax.adam(1e-3)
@@ -87,7 +102,7 @@ def main() -> None:
     @jax.jit
     def step(params, opt_state, pc1, pc2, mask, gt):
         def loss_fn(p):
-            flows, _ = model.apply(p, pc1, pc2, iters)
+            flows, _ = model.apply(p, pc1, pc2, ITERS)
             return sequence_loss(flows, mask, gt, 0.8)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -97,27 +112,69 @@ def main() -> None:
     # Warmup / compile.
     params, opt_state, loss = step(params, opt_state, pc1, pc2, mask, gt)
     jax.block_until_ready(loss)
+    if not np.isfinite(float(loss)):
+        raise FloatingPointError("non-finite loss")
 
     n_steps = 10
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt_state, loss = step(params, opt_state, pc1, pc2, mask, gt)
     jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / n_steps
+    return (time.perf_counter() - t0) / n_steps
 
-    pairs_per_sec = batch * n_points / dt
-    print(
-        json.dumps(
-            {
-                "metric": "train_point_pairs_per_sec_per_chip",
-                "value": round(pairs_per_sec, 1),
-                "unit": "point-pairs/s/chip (8192 pts, 8 iters, bs=2, fwd+bwd+adam)",
-                "vs_baseline": round(
-                    pairs_per_sec / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3
-                ),
-            }
+
+VARIANTS = [
+    ("bf16+pallas+approx", dict(compute_dtype="bfloat16", use_pallas=True,
+                                approx_topk=True)),
+    ("bf16+approx", dict(compute_dtype="bfloat16", approx_topk=True)),
+    ("bf16", dict(compute_dtype="bfloat16")),
+    ("fp32", dict()),
+]
+
+
+def main() -> None:
+    _devices_with_watchdog()
+
+    # First success wins: variants are ordered fastest-expected first, so
+    # benching later ones would only add compile time.
+    best = None
+    note = []
+    for name, kwargs in VARIANTS:
+        try:
+            dt = _run_variant(kwargs)
+            note.append(f"{name}:{dt*1e3:.0f}ms")
+            best = (name, dt)
+            break
+        except Exception:
+            note.append(f"{name}:failed")
+            traceback.print_exc()
+
+    if best is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "train_point_pairs_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": _unit(),
+                    "vs_baseline": 0.0,
+                    "note": "all variants failed: " + ",".join(note),
+                }
+            )
         )
-    )
+        return
+
+    name, dt = best
+    pairs_per_sec = BATCH * N_POINTS / dt
+    out = {
+        "metric": "train_point_pairs_per_sec_per_chip",
+        "value": round(pairs_per_sec, 1),
+        "unit": _unit(),
+        "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
+        "variant": name,
+    }
+    if len(note) > 1:  # earlier variants failed — surface the degradation
+        out["note"] = ",".join(note)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

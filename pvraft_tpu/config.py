@@ -41,6 +41,9 @@ class ModelConfig:
     # lax.approx_max_k for the correlation truncation: much faster on TPU
     # (recall ~0.95 by default); exact sort-based top-k when False.
     approx_topk: bool = False
+    # Stream the kNN graph construction over point chunks (avoids the
+    # (N, N) distance matrix; needed for 16k+ point clouds).
+    graph_chunk: Optional[int] = None
 
     def __post_init__(self):
         if self.corr_knn > self.truncate_k:

@@ -26,26 +26,30 @@ class CorrLookup(nn.Module):
     def __call__(self, state: CorrState, coords: jnp.ndarray) -> jnp.ndarray:
         cfg = self.cfg
         dtype = compute_dtype(cfg)
-        rel = state.xyz - coords[:, :, None, :]            # (B, N, K, 3)
 
-        # Voxel branch (corr.py:47-73).
         if cfg.use_pallas:
-            from pvraft_tpu.ops.pallas.voxel_corr import voxel_bin_means_pallas
+            # Fused kernel: one VMEM pass produces both branches; the
+            # (B, N, K, 3) rel tensor never hits HBM.
+            from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
 
-            vox = voxel_bin_means_pallas(
-                state.corr, rel, cfg.corr_levels, cfg.base_scale, cfg.resolution
+            vox, knn_corr, rel_xyz = fused_corr_lookup(
+                state.corr, state.xyz, coords,
+                cfg.corr_levels, cfg.base_scale, cfg.resolution, cfg.corr_knn,
             )
         else:
+            rel = state.xyz - coords[:, :, None, :]        # (B, N, K, 3)
             vox = voxel_bin_means(
                 state.corr, rel, cfg.corr_levels, cfg.base_scale, cfg.resolution
             )
+            knn_corr, rel_xyz = knn_lookup(state, rel, cfg.corr_knn)
+
+        # Voxel head (corr.py:15-20).
         v = nn.Dense(128, dtype=dtype, name="out_conv1")(vox)
         v = group_norm(v, "out_gn")
         v = PReLU(name="out_prelu")(v)
         v = nn.Dense(64, dtype=dtype, name="out_conv2")(v)
 
-        # kNN point branch (corr.py:75-93) — shares `rel` with the voxel branch.
-        knn_corr, rel_xyz = knn_lookup(state, rel, cfg.corr_knn)
+        # kNN head (corr.py:23-29).
         kf = jnp.concatenate([knn_corr[..., None], rel_xyz], axis=-1)
         kf = nn.Dense(64, dtype=dtype, name="knn_conv")(kf)   # (B, N, k, 64)
         kf = group_norm(kf, "knn_gn")

@@ -20,10 +20,11 @@ class PointEncoder(nn.Module):
     width: int = 32
     graph_k: int = 32
     dtype: Optional[jnp.dtype] = None
+    graph_chunk: Optional[int] = None
 
     @nn.compact
     def __call__(self, pc: jnp.ndarray) -> Tuple[jnp.ndarray, Graph]:
-        graph = build_graph(pc, self.graph_k)
+        graph = build_graph(pc, self.graph_k, chunk=self.graph_chunk)
         x = SetConv(self.width, dtype=self.dtype, name="conv1")(pc, graph)
         x = SetConv(2 * self.width, dtype=self.dtype, name="conv2")(x, graph)
         x = SetConv(4 * self.width, dtype=self.dtype, name="conv3")(x, graph)

@@ -67,7 +67,8 @@ class PVRaft(nn.Module):
         cfg = self.cfg
         dtype = compute_dtype(cfg)
         feat = PointEncoder(
-            cfg.encoder_width, cfg.graph_k, dtype=dtype, name="feature_extractor"
+            cfg.encoder_width, cfg.graph_k, dtype=dtype,
+            graph_chunk=cfg.graph_chunk, name="feature_extractor"
         )
         fmap1, graph1 = feat(xyz1)
         fmap2, _ = feat(xyz2)
@@ -78,7 +79,8 @@ class PVRaft(nn.Module):
         )
 
         fct, graph_ctx = PointEncoder(
-            cfg.encoder_width, cfg.graph_k, dtype=dtype, name="context_extractor"
+            cfg.encoder_width, cfg.graph_k, dtype=dtype,
+            graph_chunk=cfg.graph_chunk, name="context_extractor"
         )(xyz1)
         net, inp = jnp.split(fct, [cfg.hidden_dim], axis=-1)
         net = jnp.tanh(net)

@@ -72,6 +72,11 @@ def corr_init(
             vals, idx = lax.top_k(corr, truncate_k)
         return CorrState(corr=vals, xyz=gather_neighbors(xyz2, idx))
 
+    if approx:
+        raise ValueError(
+            "approx_topk is not supported with corr_chunk: the chunked "
+            "scan keeps an exact running top-k (use one or the other)"
+        )
     b, m, d = fmap2.shape
     if m % chunk != 0:
         raise ValueError(f"chunk {chunk} must divide N2={m}")

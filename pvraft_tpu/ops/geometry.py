@@ -18,7 +18,7 @@ this affects bit-level parity only (SURVEY.md §7 hard-part 2).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -40,16 +40,57 @@ def pairwise_sqdist(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return a2 + jnp.swapaxes(b2, -1, -2) - 2.0 * cross
 
 
-def knn_indices(query: jnp.ndarray, points: jnp.ndarray, k: int) -> jnp.ndarray:
+def knn_indices(
+    query: jnp.ndarray,
+    points: jnp.ndarray,
+    k: int,
+    chunk: Optional[int] = None,
+) -> jnp.ndarray:
     """Indices of the k nearest ``points`` for each ``query`` point.
 
     query: (B, N, 3), points: (B, M, 3) -> (B, N, k) int32, nearest first.
     When query is points itself, each point's first neighbor is itself
     (distance 0), matching ``model/flot/graph.py:60``.
+
+    With ``chunk`` set, the M axis is streamed with a running top-k so the
+    full (N, M) distance matrix is never materialized — the memory lever
+    for 16k+ point graphs (1 GB fp32 at 16,384^2), mirroring the chunked
+    correlation truncation (SURVEY.md §5 long-context note).
     """
-    d = pairwise_sqdist(query, points)
-    _, idx = lax.top_k(-d, k)
-    return idx.astype(jnp.int32)
+    if chunk is None:
+        d = pairwise_sqdist(query, points)
+        _, idx = lax.top_k(-d, k)
+        return idx.astype(jnp.int32)
+
+    b, m, _ = points.shape
+    if m % chunk != 0:
+        raise ValueError(f"chunk {chunk} must divide M={m}")
+    q2 = jnp.sum(query * query, axis=-1, keepdims=True)      # (B, N, 1)
+    points_c = jnp.swapaxes(points.reshape(b, m // chunk, chunk, 3), 0, 1)
+    offsets = jnp.arange(m // chunk, dtype=jnp.int32) * chunk
+
+    def step(carry, xs):
+        best_negd, best_idx = carry
+        pts, off = xs                                        # (B, chunk, 3)
+        p2 = jnp.sum(pts * pts, axis=-1)[:, None, :]         # (B, 1, chunk)
+        cross = jnp.einsum("bnc,bmc->bnm", query, pts)
+        negd = -(q2 + p2 - 2.0 * cross)                      # (B, N, chunk)
+        idx = jnp.broadcast_to(
+            (jnp.arange(chunk, dtype=jnp.int32) + off)[None, None, :],
+            negd.shape,
+        )
+        cand_v = jnp.concatenate([best_negd, negd], axis=-1)
+        cand_i = jnp.concatenate([best_idx, idx], axis=-1)
+        new_v, sel = lax.top_k(cand_v, k)
+        new_i = jnp.take_along_axis(cand_i, sel, axis=-1)
+        return (new_v, new_i), None
+
+    init = (
+        jnp.full((b, query.shape[1], k), -jnp.inf, query.dtype),
+        jnp.zeros((b, query.shape[1], k), jnp.int32),
+    )
+    (_, idx), _ = lax.scan(step, init, (points_c, offsets))
+    return idx
 
 
 def gather_neighbors(feats: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
@@ -76,12 +117,12 @@ class Graph(NamedTuple):
         return self.neighbors.shape[-1]
 
 
-def build_graph(pc: jnp.ndarray, k: int) -> Graph:
+def build_graph(pc: jnp.ndarray, k: int, chunk: Optional[int] = None) -> Graph:
     """Construct the kNN graph of a cloud with itself.
 
     pc: (B, N, 3). Mirrors ``Graph.construct_graph`` (``graph.py:27-89``)
     with batched tensors instead of flat edge lists.
     """
-    idx = knn_indices(pc, pc, k)
+    idx = knn_indices(pc, pc, k, chunk=chunk)
     nb = gather_neighbors(pc, idx)
     return Graph(neighbors=idx, rel_pos=nb - pc[:, :, None, :])

@@ -1,0 +1,172 @@
+"""Fused point-voxel correlation lookup — Pallas TPU kernel.
+
+One kernel pass per tile of query points computes BOTH branches of the
+paper's correlation lookup (reference ``CorrBlock.__call__``,
+``model/corr.py:44-93``) from VMEM-resident candidates:
+
+  * voxel branch: per-cell mean correlation over ``num_levels`` cube
+    pyramids (the torch-scatter role, ``corr.py:47-73``);
+  * point branch: the 32 candidates nearest to the current coordinate,
+    their correlation values and relative offsets (``corr.py:75-89``).
+
+Versus the unfused path this reads the (N, K) candidate block once per GRU
+iteration instead of: once for rel, once for the voxel masks, once for the
+kNN distances — the lookup is HBM-bound, so fewer passes is the win. The
+relative offsets are computed in-kernel from the iteration-invariant
+candidate positions and the per-iteration coords, so the (B, N, K, 3)
+``rel`` tensor never exists in HBM at all.
+
+kNN selection is 32 rounds of (min, first-argmin-by-iota, mask-out) on the
+VMEM tile — O(k·K) VPU work, no sort. Tie-breaking: lowest candidate index
+wins (torch ``topk`` tie order differs; bit-level only, SURVEY.md §7).
+
+Gradients flow to ``corr`` only (geometry is under ``no_grad`` in the
+reference, and the model stop-gradients coords before the lookup);
+backward recomputes selections with XLA ops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from pvraft_tpu.ops.pallas.voxel_corr import (
+    _pick_tile,
+    _voxel_bwd,
+    voxel_level_means,
+)
+
+
+def _fused_kernel(
+    corr_ref, x2x_ref, x2y_ref, x2z_ref, cx_ref, cy_ref, cz_ref,
+    vox_ref, kcorr_ref, krx_ref, kry_ref, krz_ref,
+    *, scales: Sequence[float], resolution: int, count_cap: float, knn: int,
+):
+    corr = corr_ref[0]                     # (TILE, K)
+    relx = x2x_ref[0] - cx_ref[0]          # coords broadcast: (TILE, 1)
+    rely = x2y_ref[0] - cy_ref[0]
+    relz = x2z_ref[0] - cz_ref[0]
+    r3 = resolution**3
+    k_cand = corr.shape[-1]
+
+    # --- voxel branch (shared binning semantics, voxel_corr.py) -----------
+    for lvl, r in enumerate(scales):
+        vox_ref[0, :, lvl * r3 : (lvl + 1) * r3] = voxel_level_means(
+            corr, relx, rely, relz, r, resolution, count_cap
+        )
+
+    # --- kNN branch -------------------------------------------------------
+    dist = relx * relx + rely * rely + relz * relz     # (TILE, K)
+    iota = lax.broadcasted_iota(jnp.int32, dist.shape, 1)
+    big = jnp.asarray(jnp.inf, dist.dtype)
+    for j in range(knn):
+        m = jnp.min(dist, axis=-1, keepdims=True)             # (TILE, 1)
+        eq = dist == m
+        first = iota == jnp.min(
+            jnp.where(eq, iota, k_cand), axis=-1, keepdims=True
+        )
+        sel = first.astype(corr.dtype)
+        kcorr_ref[0, :, j] = jnp.sum(corr * sel, axis=-1)
+        krx_ref[0, :, j] = jnp.sum(relx * sel, axis=-1)
+        kry_ref[0, :, j] = jnp.sum(rely * sel, axis=-1)
+        krz_ref[0, :, j] = jnp.sum(relz * sel, axis=-1)
+        dist = jnp.where(first, big, dist)
+
+
+def _fused_forward(
+    corr: jnp.ndarray, xyz: jnp.ndarray, coords: jnp.ndarray,
+    num_levels: int, base_scale: float, resolution: int, knn: int,
+):
+    b, n, k = corr.shape
+    tile = _pick_tile(n)
+    r3 = resolution**3
+    scales = tuple(base_scale * (2**i) for i in range(num_levels))
+    kernel = functools.partial(
+        _fused_kernel,
+        scales=scales, resolution=resolution, count_cap=float(n), knn=knn,
+    )
+    cand_spec = pl.BlockSpec((1, tile, k), lambda bi, ni: (bi, ni, 0))
+    coord_spec = pl.BlockSpec((1, tile, 1), lambda bi, ni: (bi, ni, 0))
+    out_shapes = (
+        jax.ShapeDtypeStruct((b, n, num_levels * r3), corr.dtype),
+        jax.ShapeDtypeStruct((b, n, knn), corr.dtype),
+        jax.ShapeDtypeStruct((b, n, knn), corr.dtype),
+        jax.ShapeDtypeStruct((b, n, knn), corr.dtype),
+        jax.ShapeDtypeStruct((b, n, knn), corr.dtype),
+    )
+    out_spec = pl.BlockSpec(
+        (1, tile, num_levels * r3), lambda bi, ni: (bi, ni, 0)
+    )
+    knn_spec = pl.BlockSpec((1, tile, knn), lambda bi, ni: (bi, ni, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(b, n // tile),
+        in_specs=[cand_spec] * 4 + [coord_spec] * 3,
+        out_specs=(out_spec, knn_spec, knn_spec, knn_spec, knn_spec),
+        out_shape=out_shapes,
+        interpret=jax.default_backend() not in ("tpu",),
+    )(
+        corr,
+        xyz[..., 0], xyz[..., 1], xyz[..., 2],
+        coords[..., 0:1], coords[..., 1:2], coords[..., 2:3],
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def fused_corr_lookup(
+    corr: jnp.ndarray,
+    xyz: jnp.ndarray,
+    coords: jnp.ndarray,
+    num_levels: int,
+    base_scale: float,
+    resolution: int,
+    knn: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused lookup.
+
+    corr: (B, N, K); xyz: (B, N, K, 3) candidate positions; coords: (B, N, 3)
+    current estimates. Returns:
+      vox      (B, N, num_levels * resolution^3) per-cell means,
+      knn_corr (B, N, knn),
+      knn_rel  (B, N, knn, 3).
+    """
+    xyz = lax.stop_gradient(xyz)
+    coords = lax.stop_gradient(coords)
+    vox, kcorr, krx, kry, krz = _fused_forward(
+        corr, xyz, coords, num_levels, base_scale, resolution, knn
+    )
+    return vox, kcorr, jnp.stack([krx, kry, krz], axis=-1)
+
+
+def _fused_fwd(corr, xyz, coords, num_levels, base_scale, resolution, knn):
+    out = fused_corr_lookup(
+        corr, xyz, coords, num_levels, base_scale, resolution, knn
+    )
+    return out, (corr, xyz, coords)
+
+
+def _fused_bwd(num_levels, base_scale, resolution, knn, res, grads):
+    corr, xyz, coords = res
+    g_vox, g_kcorr, _g_krel = grads
+    rel = lax.stop_gradient(xyz - coords[:, :, None, :])
+
+    # Voxel branch: shared with the voxel-only kernel's VJP.
+    dcorr, _ = _voxel_bwd(num_levels, base_scale, resolution, (corr, rel), g_vox)
+
+    # kNN branch: scatter the selected-candidate grads back. Selection is
+    # recomputed with lax.top_k (identical up to tie order).
+    dist = jnp.sum(rel * rel, axis=-1)
+    _, nbr = lax.top_k(-dist, knn)                       # (B, N, knn)
+    dsel = jnp.zeros_like(corr)
+    dsel = jax.vmap(
+        jax.vmap(lambda d, i, g: d.at[i].add(g))
+    )(dsel, nbr, g_kcorr)
+    return dcorr + dsel, None, None
+
+
+fused_corr_lookup.defvjp(_fused_fwd, _fused_bwd)

@@ -41,6 +41,37 @@ def _pick_tile(n: int, target: int = 64) -> int:
     return best
 
 
+def voxel_level_means(
+    corr, relx, rely, relz, scale: float, resolution: int, count_cap: float
+):
+    """Per-cell mean correlation of ONE pyramid level for a VMEM tile.
+
+    The single source of the parity-critical binning semantics
+    (round/valid/cell-index/count-clamp, reference ``corr.py:52-69``) —
+    shared by the voxel-only and fused kernels. Inputs are (TILE, K)
+    values; returns (TILE, resolution**3).
+    """
+    half = resolution // 2
+    r3 = resolution**3
+    inv = 1.0 / scale
+    dvx = jnp.round(relx * inv)
+    dvy = jnp.round(rely * inv)
+    dvz = jnp.round(relz * inv)
+    valid = (
+        (jnp.abs(dvx) <= half) & (jnp.abs(dvy) <= half) & (jnp.abs(dvz) <= half)
+    )
+    cell = (dvx + half) * (resolution**2) + (dvy + half) * resolution + (dvz + half)
+    w = jnp.where(valid, corr, 0.0)
+    vf = valid.astype(corr.dtype)
+    cols = []
+    for j in range(r3):
+        hit = (cell == j).astype(corr.dtype) * vf     # (TILE, K)
+        s = jnp.sum(w * hit, axis=-1)                  # (TILE,)
+        c = jnp.sum(hit, axis=-1)
+        cols.append(s / jnp.clip(c, 1.0, count_cap))
+    return jnp.stack(cols, axis=-1)
+
+
 def _voxel_kernel(
     corr_ref,
     relx_ref,
@@ -56,27 +87,11 @@ def _voxel_kernel(
     relx = relx_ref[0]
     rely = rely_ref[0]
     relz = relz_ref[0]
-    half = resolution // 2
     r3 = resolution**3
-
     for lvl, r in enumerate(scales):
-        inv = 1.0 / r
-        dvx = jnp.round(relx * inv)
-        dvy = jnp.round(rely * inv)
-        dvz = jnp.round(relz * inv)
-        valid = (
-            (jnp.abs(dvx) <= half) & (jnp.abs(dvy) <= half) & (jnp.abs(dvz) <= half)
+        out_ref[0, :, lvl * r3 : (lvl + 1) * r3] = voxel_level_means(
+            corr, relx, rely, relz, r, resolution, count_cap
         )
-        cell = (dvx + half) * (resolution**2) + (dvy + half) * resolution + (dvz + half)
-        w = jnp.where(valid, corr, 0.0)
-        vf = valid.astype(corr.dtype)
-        cols = []
-        for j in range(r3):
-            hit = (cell == j).astype(corr.dtype) * vf     # (TILE_N, K)
-            s = jnp.sum(w * hit, axis=-1)                  # (TILE_N,)
-            c = jnp.sum(hit, axis=-1)
-            cols.append(s / jnp.clip(c, 1.0, count_cap))
-        out_ref[0, :, lvl * r3 : (lvl + 1) * r3] = jnp.stack(cols, axis=-1)
 
 
 def _voxel_forward_pallas(

@@ -9,15 +9,14 @@ XLA routes collectives over ICI within a slice and DCN across slices.
 No NCCL/MPI-style backend code exists anywhere in this framework; the
 "communication backend" is the XLA runtime itself.
 
-Typical launch (per host)::
+``train.py`` calls :func:`initialize` at startup (before any other JAX
+use), so a pod launch is just ``python train.py ...`` on every host; for
+custom drivers call it yourself first — in the SAME process that will run
+the computation::
 
-    python -c "from pvraft_tpu.parallel.distributed import initialize;
-               initialize()"  # env-driven on TPU pods
-
-or explicitly::
-
-    initialize(coordinator_address="host0:1234", num_processes=4,
-               process_id=rank)
+    initialize()                                   # env-driven on TPU pods
+    initialize(coordinator_address="host0:1234",   # or explicit
+               num_processes=4, process_id=rank)
 """
 
 from __future__ import annotations

@@ -21,6 +21,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--corr_levels", type=int, default=3)
     p.add_argument("--base_scales", type=float, default=0.25)
     p.add_argument("--truncate_k", type=int, default=512)
+    p.add_argument("--corr_knn", type=int, default=32)
     p.add_argument("--eval_iters", type=int, default=32)
     p.add_argument("--weights", required=False, default=None)
     p.add_argument("--refine", action="store_true")
@@ -39,7 +40,8 @@ def main(argv=None) -> None:
     a = parse_args(argv)
     cfg = Config(
         model=ModelConfig(
-            truncate_k=a.truncate_k, corr_levels=a.corr_levels,
+            truncate_k=a.truncate_k, corr_knn=a.corr_knn,
+            corr_levels=a.corr_levels,
             base_scale=a.base_scales, use_pallas=a.use_pallas,
             corr_chunk=a.corr_chunk,
         ),

@@ -67,3 +67,30 @@ def test_graph_rel_pos_consistency():
             np.testing.assert_allclose(
                 rel[0, i, kk], pc[0, nb[0, i, kk]] - pc[0, i], atol=1e-6
             )
+
+
+def test_chunked_knn_matches_full():
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(2, 24, 3)).astype(np.float32))
+    p = jnp.asarray(rng.normal(size=(2, 48, 3)).astype(np.float32))
+    full = np.asarray(knn_indices(q, p, 6))
+    chunked = np.asarray(knn_indices(q, p, 6, chunk=16))
+    # Same neighbor sets and (no ties in random data) same order.
+    np.testing.assert_array_equal(full, chunked)
+
+
+def test_chunked_graph_in_model():
+    import jax
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models.raft import PVRaft
+
+    rng = np.random.default_rng(8)
+    xyz1 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 3)).astype(np.float32))
+    xyz2 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 3)).astype(np.float32))
+    cfg = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8)
+    cfgc = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8,
+                       graph_chunk=16, corr_chunk=16)
+    params = PVRaft(cfg).init(jax.random.key(0), xyz1, xyz2, 2)
+    f1, _ = PVRaft(cfg).apply(params, xyz1, xyz2, num_iters=2)
+    f2, _ = PVRaft(cfgc).apply(params, xyz1, xyz2, num_iters=2)
+    np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), atol=1e-4)

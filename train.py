@@ -26,6 +26,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--corr_levels", type=int, default=3)
     p.add_argument("--base_scales", type=float, default=0.25)
     p.add_argument("--truncate_k", type=int, default=512)
+    p.add_argument("--corr_knn", type=int, default=32,
+                   help="k of the correlation point branch (reference hardcodes 32)")
     p.add_argument("--iters", type=int, default=8)
     p.add_argument("--eval_iters", type=int, default=32,
                    help="GRU iterations at val/test (reference hardcodes 32)")
@@ -51,6 +53,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="Pallas voxel kernel instead of the XLA fallback")
     p.add_argument("--corr_chunk", type=int, default=None,
                    help="streaming top-k chunk over N2 (memory saver)")
+    p.add_argument("--graph_chunk", type=int, default=None,
+                   help="streaming kNN graph chunk (memory saver for 16k+ pts)")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--approx_topk", action="store_true",
                    help="approximate correlation truncation (faster on TPU)")
@@ -67,6 +71,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
     return Config(
         model=ModelConfig(
             truncate_k=a.truncate_k,
+            corr_knn=a.corr_knn,
             corr_levels=a.corr_levels,
             base_scale=a.base_scales,
             compute_dtype="bfloat16" if a.bf16 else "float32",
@@ -74,6 +79,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
             corr_chunk=a.corr_chunk,
             remat=a.remat,
             approx_topk=a.approx_topk,
+            graph_chunk=a.graph_chunk,
         ),
         data=DataConfig(
             dataset=a.dataset, root=a.root, max_points=a.max_points,
@@ -98,6 +104,13 @@ def main(argv=None) -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    # Must run before any backend-initializing JAX call: joins this process
+    # into the multi-host pod when the environment advertises one (no-op on
+    # a single host).
+    from pvraft_tpu.parallel.distributed import initialize as dist_init
+
+    dist_init()
 
     from pvraft_tpu.engine.trainer import Trainer
     from pvraft_tpu.parallel.mesh import make_mesh
