@@ -79,6 +79,38 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
 # torch -> jax parameter import (for reference-published checkpoints).
 # ---------------------------------------------------------------------------
 
+_REFINE_HEAD_KEYS = ("ref_conv1", "ref_conv2", "ref_conv3", "fc")
+
+
+def load_torch_checkpoint(
+    path: str, refine: bool = False
+) -> Tuple[Dict[str, Any], int]:
+    """Read a reference ``.params`` file (torch pickle of
+    ``{'epoch', 'state_dict'}``, ``tools/utils.py:14-17``) and convert the
+    state dict into this framework's param tree. Returns (tree, epoch).
+
+    ``refine=True`` reshapes an ``RSF_refine`` checkpoint into the
+    ``PVRaftRefine`` layout (stage-1 modules under ``backbone``, the
+    refine head at top level). DataParallel-era ``module.``-prefixed keys
+    are accepted (the reference unwraps them on save,
+    ``tools/utils.py:19-28``, but published files may predate that)."""
+    import torch
+
+    payload = torch.load(path, map_location="cpu", weights_only=True)
+    state_dict = payload.get("state_dict", payload)
+    epoch = int(payload.get("epoch", -1)) if isinstance(payload, dict) else -1
+    as_numpy = {
+        (k[len("module."):] if k.startswith("module.") else k): v.numpy()
+        for k, v in state_dict.items()
+    }
+    tree = import_torch_state_dict(as_numpy)
+    if refine:
+        backbone = {k: v for k, v in tree.items() if k not in _REFINE_HEAD_KEYS}
+        head = {k: v for k, v in tree.items() if k in _REFINE_HEAD_KEYS}
+        tree = {"backbone": backbone, **head}
+    return tree, epoch
+
+
 def _split_torch_key(key: str):
     # e.g. "feature_extractor.feat_conv1.fc1.weight"
     return key.split(".")

@@ -17,7 +17,7 @@ import numpy as np
 
 from pvraft_tpu.config import Config
 from pvraft_tpu.data import FT3D, KITTI, PrefetchLoader, SyntheticDataset
-from pvraft_tpu.engine.checkpoint import load_checkpoint
+from pvraft_tpu.engine.checkpoint import load_checkpoint, load_torch_checkpoint
 from pvraft_tpu.engine.steps import make_eval_step
 from pvraft_tpu.models import PVRaft, PVRaftRefine
 from pvraft_tpu.parallel.mesh import make_mesh, replicate, shard_batch
@@ -61,6 +61,13 @@ class Evaluator:
         params, _, epoch = load_checkpoint(path, tmpl, None)
         self.params = replicate(params, self.mesh)
         self.log.info(f"loaded checkpoint {path} (epoch {epoch})")
+
+    def load_torch(self, path: str) -> None:
+        """Load a reference-published torch ``.params`` checkpoint
+        (``test.py:101-106`` role) for eval parity."""
+        tree, epoch = load_torch_checkpoint(path, refine=self.cfg.train.refine)
+        self.params = replicate({"params": tree}, self.mesh)
+        self.log.info(f"imported torch checkpoint {path} (epoch {epoch})")
 
     def run(self, dump_dir: Optional[str] = None) -> Dict[str, float]:
         sums: Dict[str, float] = {}

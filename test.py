@@ -24,6 +24,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--corr_knn", type=int, default=32)
     p.add_argument("--eval_iters", type=int, default=32)
     p.add_argument("--weights", required=False, default=None)
+    p.add_argument("--torch_weights", default=None,
+                   help="reference-published torch .params checkpoint")
     p.add_argument("--refine", action="store_true")
     p.add_argument("--use_pallas", action="store_true")
     p.add_argument("--corr_chunk", type=int, default=None)
@@ -62,6 +64,8 @@ def main(argv=None) -> None:
     ev = Evaluator(cfg)
     if a.weights:
         ev.load(a.weights)
+    if a.torch_weights:
+        ev.load_torch(a.torch_weights)
     means = ev.run(dump_dir=a.dump_dir)
     print({k: round(v, 4) for k, v in sorted(means.items())})
 

@@ -148,3 +148,18 @@ def test_evaluator_runs_and_dumps(tmp_path):
     assert (scene0 / "pc1.npy").exists()
     assert (scene0 / "flow.npy").exists()
     assert np.load(scene0 / "flow.npy").shape == (64, 3)
+
+
+def test_trace_context_writes_profile(tmp_path):
+    import jax.numpy as jnp
+    from pvraft_tpu.utils.profiling import StepTimer, trace_context
+
+    with trace_context(str(tmp_path / "prof")):
+        _ = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+    assert any((tmp_path / "prof").rglob("*"))  # trace events written
+
+    t = StepTimer()
+    t.start()
+    x = jnp.ones((4,)) * 2
+    dt = t.stop(x)
+    assert dt >= 0 and t.mean >= 0

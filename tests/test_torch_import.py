@@ -110,3 +110,74 @@ def test_imported_params_run_and_match_values():
     flows, _ = model.apply({"params": tree}, xyz1, xyz2, num_iters=2)
     assert flows.shape == (2, 1, 48, 3)
     assert np.all(np.isfinite(np.asarray(flows)))
+
+
+def test_load_torch_checkpoint_file(tmp_path):
+    """Round-trip through an actual torch-pickled .params file, including
+    the DataParallel 'module.' prefix."""
+    import torch
+
+    from pvraft_tpu.engine.checkpoint import load_torch_checkpoint
+
+    rng = np.random.default_rng(2)
+    sd = _torch_style_state_dict(rng)
+    prefixed = {"module." + k: torch.from_numpy(v) for k, v in sd.items()}
+    path = str(tmp_path / "best_checkpoint.params")
+    torch.save({"epoch": 11, "state_dict": prefixed}, path)
+
+    tree, epoch = load_torch_checkpoint(path)
+    assert epoch == 11
+    w = sd["update_block.gru.convz.weight"]
+    k = tree["update_iter"]["update_block"]["gru"]["convz"]["kernel"]
+    np.testing.assert_allclose(np.asarray(k), w[..., 0].T)
+
+
+def test_refine_checkpoint_import_and_eval(tmp_path):
+    """RSF_refine-layout torch checkpoint -> PVRaftRefine params via the
+    Evaluator (zero-shot eval parity path)."""
+    import torch
+
+    from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from pvraft_tpu.engine.evaluator import Evaluator
+
+    rng = np.random.default_rng(3)
+    sd = _torch_style_state_dict(rng)
+    # Add the refine head (model/refine.py:11-14): SetConvs 3->32->64->128 + fc.
+    def gn(name, ch):
+        sd[name + ".weight"] = rng.normal(size=(ch,)).astype(np.float32)
+        sd[name + ".bias"] = rng.normal(size=(ch,)).astype(np.float32)
+
+    def conv(name, cin, cout, dims, bias):
+        shape = (cout, cin) + (1,) * dims
+        sd[name + ".weight"] = rng.normal(size=shape).astype(np.float32)
+        if bias:
+            sd[name + ".bias"] = rng.normal(size=(cout,)).astype(np.float32)
+
+    for prefix, cin, cout in [("refine_block.ref_conv1", 3, 32),
+                              ("refine_block.ref_conv2", 32, 64),
+                              ("refine_block.ref_conv3", 64, 128)]:
+        mid = (cout + cin) // 2 if cin % 2 == 0 else cout // 2
+        conv(prefix + ".fc1", cin + 3, mid, 2, False)
+        gn(prefix + ".gn1", mid)
+        conv(prefix + ".fc2", mid, cout, 1, False)
+        gn(prefix + ".gn2", cout)
+        conv(prefix + ".fc3", cout, cout, 1, False)
+        gn(prefix + ".gn3", cout)
+    sd["refine_block.fc.weight"] = rng.normal(size=(3, 128)).astype(np.float32)
+    sd["refine_block.fc.bias"] = rng.normal(size=(3,)).astype(np.float32)
+
+    path = str(tmp_path / "refine.params")
+    torch.save({"epoch": 5, "state_dict":
+                {k: torch.from_numpy(v) for k, v in sd.items()}}, path)
+
+    cfg = Config(
+        model=ModelConfig(truncate_k=16, corr_knn=8, graph_k=8),
+        data=DataConfig(dataset="synthetic", max_points=48, synthetic_size=2,
+                        num_workers=0),
+        train=TrainConfig(refine=True, eval_iters=2),
+        exp_path=str(tmp_path / "exp"),
+    )
+    ev = Evaluator(cfg)
+    ev.load_torch(path)
+    means = ev.run()
+    assert np.isfinite(means["epe3d"])
