@@ -12,7 +12,6 @@ can be imported for parity testing (SURVEY.md §5 checkpoint notes).
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Any, Dict, Optional, Tuple
 

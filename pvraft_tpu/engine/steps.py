@@ -11,7 +11,7 @@ all-reduce over ICI — the role ``nn.DataParallel`` plays in the reference
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,6 @@ point branch — then projected to 64 channels and summed
 from __future__ import annotations
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from pvraft_tpu.config import ModelConfig, compute_dtype

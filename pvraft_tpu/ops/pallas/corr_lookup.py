@@ -109,7 +109,7 @@ def _fused_forward(
         in_specs=[cand_spec] * 4 + [coord_spec] * 3,
         out_specs=(out_spec, knn_spec, knn_spec, knn_spec, knn_spec),
         out_shape=out_shapes,
-        interpret=jax.default_backend() not in ("tpu",),
+        interpret=jax.default_backend() == "cpu",
     )(
         corr,
         xyz[..., 0], xyz[..., 1], xyz[..., 2],

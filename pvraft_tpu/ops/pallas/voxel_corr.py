@@ -122,7 +122,7 @@ def _voxel_forward_pallas(
             (1, tile, num_levels * r3), lambda bi, ni: (bi, ni, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((b, n, num_levels * r3), corr.dtype),
-        interpret=jax.default_backend() not in ("tpu",),
+        interpret=jax.default_backend() == "cpu",
     )(corr, relx, rely, relz)
 
 
