@@ -66,6 +66,9 @@ class DataConfig:
     # Use the C++ batch assembler (pvraft_tpu/native) when the dataset
     # supports it and the library builds; falls back to numpy otherwise.
     native_loader: bool = True
+    # Enforce the reference's dataset-size integrity asserts (19,640 FT3D
+    # train scenes etc.); disable for subset/smoke runs.
+    strict_sizes: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

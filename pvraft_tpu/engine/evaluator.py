@@ -27,9 +27,9 @@ from pvraft_tpu.utils.logging import ExperimentLog
 def build_eval_dataset(cfg: Config):
     d = cfg.data
     if d.dataset == "FT3D":
-        return FT3D(d.root, d.max_points, "test")
+        return FT3D(d.root, d.max_points, "test", strict_sizes=d.strict_sizes)
     if d.dataset == "KITTI":
-        return KITTI(d.root, d.max_points)
+        return KITTI(d.root, d.max_points, strict_sizes=d.strict_sizes)
     if d.dataset == "synthetic":
         return SyntheticDataset(size=d.synthetic_size, nb_points=d.max_points,
                                 noise=0.01, seed=2)

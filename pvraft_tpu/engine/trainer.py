@@ -61,9 +61,9 @@ def build_datasets(cfg: Config):
         return mk(0), mk(1), mk(2)
     if d.dataset == "FT3D":
         return (
-            FT3D(d.root, d.max_points, "train"),
-            FT3D(d.root, d.max_points, "val"),
-            FT3D(d.root, d.max_points, "test"),
+            FT3D(d.root, d.max_points, "train", strict_sizes=d.strict_sizes),
+            FT3D(d.root, d.max_points, "val", strict_sizes=d.strict_sizes),
+            FT3D(d.root, d.max_points, "test", strict_sizes=d.strict_sizes),
         )
     if d.dataset == "KITTI":
         # Eval-only, like the reference (tools/engine.py:40-41).

@@ -30,6 +30,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--use_pallas", action="store_true")
     p.add_argument("--corr_chunk", type=int, default=None)
     p.add_argument("--num_workers", type=int, default=8)
+    p.add_argument("--no_strict_sizes", action="store_true",
+                   help="allow dataset subsets (skip the reference's size asserts)")
     p.add_argument("--dump_dir", default=None,
                    help="write result/<ds>/<idx>/{pc1,pc2,flow}.npy for visual.py")
     p.add_argument("--synthetic_size", type=int, default=16)
@@ -49,7 +51,8 @@ def main(argv=None) -> None:
         ),
         data=DataConfig(dataset=a.dataset, root=a.root,
                         max_points=a.max_points, num_workers=a.num_workers,
-                        synthetic_size=a.synthetic_size),
+                        synthetic_size=a.synthetic_size,
+                        strict_sizes=not a.no_strict_sizes),
         train=TrainConfig(refine=a.refine, eval_iters=a.eval_iters),
         exp_path=a.exp_path,
     )

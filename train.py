@@ -44,6 +44,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--checkpoint_interval", type=int, default=5)
     p.add_argument("--refine", action="store_true")
     p.add_argument("--num_workers", type=int, default=8)
+    p.add_argument("--no_strict_sizes", action="store_true",
+                   help="allow dataset subsets (skip the reference's size asserts)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--data_parallel", type=int, default=-1,
                    help="devices on the data mesh axis (-1: all)")
@@ -84,6 +86,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
         data=DataConfig(
             dataset=a.dataset, root=a.root, max_points=a.max_points,
             num_workers=a.num_workers, synthetic_size=a.synthetic_size,
+            strict_sizes=not a.no_strict_sizes,
         ),
         train=TrainConfig(
             batch_size=a.batch_size, num_epochs=a.num_epochs, lr=a.lr,
