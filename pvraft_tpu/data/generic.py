@@ -85,19 +85,18 @@ def batches(
     seed: int = 0,
     epoch: int = 0,
 ) -> Iterator[Item]:
-    """Lazy epoch iterator; one collated batch at a time.
+    """Lazy serial epoch iterator; one collated batch at a time.
 
     ``epoch`` is folded into the shuffle seed so successive epochs see
     different orders (the reference got this from DataLoader's per-epoch
-    reshuffle). See ``pvraft_tpu.data.loader`` for the threaded
-    prefetching version used by the Trainer.
+    reshuffle). Thin wrapper over the serial path of
+    ``pvraft_tpu.data.loader.PrefetchLoader`` so the order/shuffle logic
+    has a single implementation.
     """
-    dataset.set_epoch(epoch)
-    order = np.arange(len(dataset))
-    if shuffle:
-        np.random.default_rng((seed, epoch)).shuffle(order)
-    for start in range(0, len(order), batch_size):
-        idx = order[start : start + batch_size]
-        if len(idx) < batch_size and drop_last:
-            break
-        yield collate([dataset[int(i)] for i in idx])
+    from pvraft_tpu.data.loader import PrefetchLoader
+
+    loader = PrefetchLoader(
+        dataset, batch_size, shuffle=shuffle, drop_last=drop_last,
+        num_workers=0, seed=seed,
+    )
+    yield from loader.epoch(epoch)

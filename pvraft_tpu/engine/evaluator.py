@@ -20,7 +20,7 @@ from pvraft_tpu.data import FT3D, KITTI, PrefetchLoader, SyntheticDataset
 from pvraft_tpu.engine.checkpoint import load_checkpoint, load_torch_checkpoint
 from pvraft_tpu.engine.steps import make_eval_step
 from pvraft_tpu.models import PVRaft, PVRaftRefine
-from pvraft_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+from pvraft_tpu.parallel.mesh import device_batch, make_mesh, replicate
 from pvraft_tpu.utils.logging import ExperimentLog
 
 
@@ -73,9 +73,7 @@ class Evaluator:
         sums: Dict[str, float] = {}
         count = 0
         for idx, batch in enumerate(self.loader.epoch(0)):
-            b = shard_batch(
-                {k: jnp.asarray(v) for k, v in batch.items()}, self.mesh
-            )
+            b = device_batch(batch, self.mesh)
             metrics, flow = self.eval_step(self.params, b)
             for k, v in metrics.items():
                 sums[k] = sums.get(k, 0.0) + float(v)

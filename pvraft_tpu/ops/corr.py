@@ -45,6 +45,17 @@ def corr_volume(fmap1: jnp.ndarray, fmap2: jnp.ndarray) -> jnp.ndarray:
     return out / jnp.sqrt(jnp.asarray(d, out.dtype))
 
 
+def merge_topk_xyz(best_v, best_x, part_v, part_x, truncate_k: int):
+    """Fold candidate (corr, xyz) blocks into a running top-k over the last
+    value axis. Shared by the chunked scan below and the ring
+    sequence-parallel path (``parallel/ring.py``)."""
+    cand_v = jnp.concatenate([best_v, part_v], axis=-1)
+    cand_x = jnp.concatenate([best_x, part_x], axis=2)
+    new_v, sel = lax.top_k(cand_v, truncate_k)
+    new_x = jnp.take_along_axis(cand_x, sel[..., None], axis=2)
+    return new_v, new_x
+
+
 def corr_init(
     fmap1: jnp.ndarray,
     fmap2: jnp.ndarray,
@@ -93,13 +104,8 @@ def corr_init(
         part = jnp.einsum(
             "bnd,bcd->bnc", fmap1, f2, preferred_element_type=jnp.float32
         ) * scale                                    # (B, N, chunk)
-        cand_v = jnp.concatenate([best_v, part], axis=-1)
-        cand_x = jnp.concatenate(
-            [best_x, jnp.broadcast_to(x2[:, None], (b, n1, chunk, 3))], axis=2
-        )
-        new_v, sel = lax.top_k(cand_v, truncate_k)
-        new_x = jnp.take_along_axis(cand_x, sel[..., None], axis=2)
-        return (new_v, new_x), None
+        part_x = jnp.broadcast_to(x2[:, None], (b, n1, chunk, 3))
+        return merge_topk_xyz(best_v, best_x, part, part_x, truncate_k), None
 
     init = (
         jnp.full((b, n1, truncate_k), neg, jnp.float32),

@@ -65,6 +65,15 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map(put, batch)
 
 
+def device_batch(batch: Any, mesh: Mesh) -> Any:
+    """Host batch dict (numpy) -> device arrays with batch-axis sharding."""
+    import jax.numpy as jnp
+
+    return shard_batch(
+        {k: jnp.asarray(v) for k, v in batch.items()}, mesh
+    )
+
+
 def replicate(tree: Any, mesh: Mesh) -> Any:
     sharding = replicated_sharding(mesh)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from pvraft_tpu.ops.corr import CorrState
+from pvraft_tpu.ops.corr import CorrState, merge_topk_xyz
 
 
 def ring_corr_init(
@@ -48,15 +48,9 @@ def ring_corr_init(
         part = jnp.einsum(
             "bnd,bcd->bnc", fmap1, chunk_f2, preferred_element_type=jnp.float32
         ) * scale
-        cand_v = jnp.concatenate([best_v, part], axis=-1)
         chunk = chunk_x2.shape[1]
-        cand_x = jnp.concatenate(
-            [best_x, jnp.broadcast_to(chunk_x2[:, None], (b, n1, chunk, 3))],
-            axis=2,
-        )
-        new_v, sel = lax.top_k(cand_v, truncate_k)
-        new_x = jnp.take_along_axis(cand_x, sel[..., None], axis=2)
-        return new_v, new_x
+        part_x = jnp.broadcast_to(chunk_x2[:, None], (b, n1, chunk, 3))
+        return merge_topk_xyz(best_v, best_x, part, part_x, truncate_k)
 
     def body(i, state):
         best_v, best_x, f2, x2 = state
