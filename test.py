@@ -29,6 +29,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--refine", action="store_true")
     p.add_argument("--use_pallas", action="store_true")
     p.add_argument("--corr_chunk", type=int, default=None)
+    p.add_argument("--graph_chunk", type=int, default=None)
+    p.add_argument("--approx_topk", action="store_true")
+    p.add_argument("--bf16", action="store_true")
     p.add_argument("--num_workers", type=int, default=8)
     p.add_argument("--no_strict_sizes", action="store_true",
                    help="allow dataset subsets (skip the reference's size asserts)")
@@ -47,7 +50,9 @@ def main(argv=None) -> None:
             truncate_k=a.truncate_k, corr_knn=a.corr_knn,
             corr_levels=a.corr_levels,
             base_scale=a.base_scales, use_pallas=a.use_pallas,
-            corr_chunk=a.corr_chunk,
+            corr_chunk=a.corr_chunk, graph_chunk=a.graph_chunk,
+            approx_topk=a.approx_topk,
+            compute_dtype="bfloat16" if a.bf16 else "float32",
         ),
         data=DataConfig(dataset=a.dataset, root=a.root,
                         max_points=a.max_points, num_workers=a.num_workers,

@@ -39,6 +39,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=["parity", "cosine", "constant"])
     p.add_argument("--weights", default=None,
                    help="checkpoint to resume from (restores epoch+optimizer)")
+    p.add_argument("--resume", action="store_true",
+                   help="auto-resume from <exp_path>/checkpoints/last_checkpoint")
     p.add_argument("--stage1_weights", default=None,
                    help="stage-1 checkpoint to import when --refine")
     p.add_argument("--checkpoint_interval", type=int, default=5)
@@ -127,6 +129,12 @@ def main(argv=None) -> None:
         trainer.load_stage1_weights(args.stage1_weights)
     if args.weights:
         trainer.load_weights(args.weights, resume=True)
+    elif args.resume:
+        from pvraft_tpu.engine.checkpoint import latest_checkpoint
+
+        last = latest_checkpoint(trainer.ckpt_dir)
+        if last:
+            trainer.load_weights(last, resume=True)
     final = trainer.fit()
     print({k: round(v, 4) for k, v in final.items()})
 
