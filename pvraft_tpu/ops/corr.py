@@ -73,6 +73,13 @@ def corr_init(
     slices under ``lax.scan`` while a running top-k of size K is maintained —
     peak memory O(N * (K + chunk)) instead of O(N * M).
     """
+    if truncate_k > fmap2.shape[1]:
+        raise ValueError(
+            f"truncate_k ({truncate_k}) must be <= the number of candidate "
+            f"points N2 ({fmap2.shape[1]})"
+        )
+    if chunk is not None and chunk >= fmap2.shape[1]:
+        chunk = None   # one chunk would cover everything: use the dense path
     if chunk is None:
         corr = corr_volume(fmap1, fmap2)
         if approx:

@@ -57,6 +57,8 @@ def knn_indices(
     for 16k+ point graphs (1 GB fp32 at 16,384^2), mirroring the chunked
     correlation truncation (SURVEY.md §5 long-context note).
     """
+    if chunk is not None and chunk >= points.shape[1]:
+        chunk = None   # one chunk would cover everything: use the dense path
     if chunk is None:
         d = pairwise_sqdist(query, points)
         _, idx = lax.top_k(-d, k)

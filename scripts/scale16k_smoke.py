@@ -1,0 +1,26 @@
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from pvraft_tpu.config import ModelConfig
+from pvraft_tpu.models import PVRaft
+
+# The BASELINE.json scale-up config shape (16,384 points) with every
+# streaming option on; CPU, 2 GRU iters, forward only.
+cfg = ModelConfig(truncate_k=512, corr_chunk=2048, graph_chunk=2048,
+                  remat=True)
+model = PVRaft(cfg)
+rng = np.random.default_rng(0)
+n = 16384
+pc1 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+pc2 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+t0 = time.time()
+params = model.init(jax.random.key(0), pc1[:, :1024], pc2[:, :1024], 2)
+print(f"init {time.time()-t0:.0f}s", flush=True)
+t0 = time.time()
+flows, _ = jax.jit(lambda p, a, b: model.apply(p, a, b, 2))(params, pc1, pc2)
+jax.block_until_ready(flows)
+print(f"16k fwd ok: {flows.shape} finite={bool(np.isfinite(np.asarray(flows)).all())} {time.time()-t0:.0f}s")

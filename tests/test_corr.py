@@ -66,3 +66,12 @@ def test_knn_lookup_picks_nearest():
     d_sel = (np.asarray(rel_xyz) ** 2).sum(-1)
     for ni in range(3):
         assert d_sel[0, ni].max() <= np.sort(d_all[0, ni])[3] + 1e-5
+
+
+def test_chunk_larger_than_points_falls_back():
+    f1, f2 = _rand((1, 6, 8), 20), _rand((1, 16, 8), 21)
+    xyz2 = _rand((1, 16, 3), 22)
+    a = corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2), 4,
+                  chunk=64)
+    b = corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2), 4)
+    np.testing.assert_allclose(np.asarray(a.corr), np.asarray(b.corr), atol=1e-6)
