@@ -69,7 +69,9 @@ class Evaluator:
         self.params = replicate({"params": tree}, self.mesh)
         self.log.info(f"imported torch checkpoint {path} (epoch {epoch})")
 
-    def run(self, dump_dir: Optional[str] = None) -> Dict[str, float]:
+    def run(
+        self, dump_dir: Optional[str] = None, log_every: int = 50
+    ) -> Dict[str, float]:
         sums: Dict[str, float] = {}
         count = 0
         for idx, batch in enumerate(self.loader.epoch(0)):
@@ -78,6 +80,15 @@ class Evaluator:
             for k, v in metrics.items():
                 sums[k] = sums.get(k, 0.0) + float(v)
             count += 1
+            if log_every and count % log_every == 0:
+                # Running means, the reference's tqdm-style feedback
+                # (test.py:128-142).
+                self.log.info(
+                    f"[{count}/{len(self.loader)}] "
+                    + " ".join(
+                        f"{k}={v / count:.4f}" for k, v in sorted(sums.items())
+                    )
+                )
             if dump_dir is not None:
                 scene = os.path.join(dump_dir, self.cfg.data.dataset, str(idx))
                 os.makedirs(scene, exist_ok=True)

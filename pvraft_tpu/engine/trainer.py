@@ -165,6 +165,9 @@ class Trainer:
         if resume:
             self.opt_state = replicate(opt_state, self.mesh)
             self.begin_epoch = epoch + 1
+            # Keep the TB x-axis continuous across restarts (the optax
+            # schedule itself continues from the restored optimizer count).
+            self.step_count = self.begin_epoch * max(1, len(self.train_loader))
         self.log.info(f"loaded weights from {path} (epoch {epoch})")
 
     def load_stage1_weights(self, path: str) -> None:

@@ -78,6 +78,13 @@ def corr_init(
             f"truncate_k ({truncate_k}) must be <= the number of candidate "
             f"points N2 ({fmap2.shape[1]})"
         )
+    if approx and chunk is not None:
+        # Checked before the size-based fallback so the config error does
+        # not depend on the input size.
+        raise ValueError(
+            "approx_topk is not supported with corr_chunk: the chunked "
+            "scan keeps an exact running top-k (use one or the other)"
+        )
     if chunk is not None and chunk >= fmap2.shape[1]:
         chunk = None   # one chunk would cover everything: use the dense path
     if chunk is None:
@@ -90,11 +97,6 @@ def corr_init(
             vals, idx = lax.top_k(corr, truncate_k)
         return CorrState(corr=vals, xyz=gather_neighbors(xyz2, idx))
 
-    if approx:
-        raise ValueError(
-            "approx_topk is not supported with corr_chunk: the chunked "
-            "scan keeps an exact running top-k (use one or the other)"
-        )
     b, m, d = fmap2.shape
     if m % chunk != 0:
         raise ValueError(f"chunk {chunk} must divide N2={m}")

@@ -1,6 +1,7 @@
 """Tests for the truncated correlation cache (ops/corr.py)."""
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from pvraft_tpu.ops.corr import corr_init, corr_volume, knn_lookup
@@ -75,3 +76,12 @@ def test_chunk_larger_than_points_falls_back():
                   chunk=64)
     b = corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2), 4)
     np.testing.assert_allclose(np.asarray(a.corr), np.asarray(b.corr), atol=1e-6)
+
+
+def test_approx_with_chunk_rejected_regardless_of_size():
+    f1, f2 = _rand((1, 4, 4), 30), _rand((1, 16, 4), 31)
+    xyz2 = _rand((1, 16, 3), 32)
+    for chunk in (8, 64):  # smaller and larger than N2
+        with pytest.raises(ValueError, match="approx_topk"):
+            corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2),
+                      4, chunk=chunk, approx=True)
