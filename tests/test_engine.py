@@ -163,3 +163,16 @@ def test_trace_context_writes_profile(tmp_path):
     x = jnp.ones((4,)) * 2
     dt = t.stop(x)
     assert dt >= 0 and t.mean >= 0
+
+
+def test_visual_render(tmp_path):
+    import visual
+
+    rng = np.random.default_rng(0)
+    scene = tmp_path / "result" / "FT3D" / "0"
+    scene.mkdir(parents=True)
+    np.save(scene / "pc1.npy", rng.normal(size=(50, 3)).astype(np.float32))
+    np.save(scene / "pc2.npy", rng.normal(size=(50, 3)).astype(np.float32))
+    np.save(scene / "flow.npy", rng.normal(size=(50, 3)).astype(np.float32))
+    out = visual.render(str(scene), str(scene / "render.png"))
+    assert os.path.exists(out) and os.path.getsize(out) > 1000
