@@ -64,6 +64,9 @@ def _fused_kernel(
     dist = relx * relx + rely * rely + relz * relz     # (TILE, K)
     iota = lax.broadcasted_iota(jnp.int32, dist.shape, 1)
     big = jnp.asarray(jnp.inf, dist.dtype)
+    # Collect the knn columns and store each output once, contiguously
+    # (per-lane stores in the loop lower poorly on TPU).
+    c_corr, c_rx, c_ry, c_rz = [], [], [], []
     for j in range(knn):
         m = jnp.min(dist, axis=-1, keepdims=True)             # (TILE, 1)
         eq = dist == m
@@ -71,11 +74,15 @@ def _fused_kernel(
             jnp.where(eq, iota, k_cand), axis=-1, keepdims=True
         )
         sel = first.astype(corr.dtype)
-        kcorr_ref[0, :, j] = jnp.sum(corr * sel, axis=-1)
-        krx_ref[0, :, j] = jnp.sum(relx * sel, axis=-1)
-        kry_ref[0, :, j] = jnp.sum(rely * sel, axis=-1)
-        krz_ref[0, :, j] = jnp.sum(relz * sel, axis=-1)
+        c_corr.append(jnp.sum(corr * sel, axis=-1))
+        c_rx.append(jnp.sum(relx * sel, axis=-1))
+        c_ry.append(jnp.sum(rely * sel, axis=-1))
+        c_rz.append(jnp.sum(relz * sel, axis=-1))
         dist = jnp.where(first, big, dist)
+    kcorr_ref[0] = jnp.stack(c_corr, axis=-1)
+    krx_ref[0] = jnp.stack(c_rx, axis=-1)
+    kry_ref[0] = jnp.stack(c_ry, axis=-1)
+    krz_ref[0] = jnp.stack(c_rz, axis=-1)
 
 
 def _fused_forward(
