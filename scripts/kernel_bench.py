@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Micro-benchmarks for the hot ops, for kernel tuning on real hardware.
+
+Times (steady-state, jitted):
+  * correlation truncation: dense top-k vs chunked scan vs approx_max_k;
+  * the per-iteration lookup: XLA fallback vs Pallas voxel-only vs fused;
+  * graph construction: dense vs chunked.
+
+Usage: python scripts/kernel_bench.py [--points 8192] [--k 512] [--cpu]
+Prints one line per variant: name, ms/call.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(fn, *args, iters=20):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--points", type=int, default=8192)
+    p.add_argument("--k", type=int, default=512)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--cpu", action="store_true")
+    a = p.parse_args()
+
+    import jax
+
+    if a.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pvraft_tpu.ops.corr import CorrState, corr_init, knn_lookup
+    from pvraft_tpu.ops.geometry import knn_indices
+    from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
+    from pvraft_tpu.ops.pallas.voxel_corr import voxel_bin_means_pallas
+    from pvraft_tpu.ops.voxel import voxel_bin_means
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    b, n, k, d = a.batch, a.points, a.k, 128
+    rng = np.random.default_rng(0)
+    f1 = jnp.asarray(rng.normal(size=(b, n, d)).astype(np.float32))
+    f2 = jnp.asarray(rng.normal(size=(b, n, d)).astype(np.float32))
+    x2 = jnp.asarray(rng.uniform(-1, 1, (b, n, 3)).astype(np.float32))
+    coords = jnp.asarray(rng.uniform(-1, 1, (b, n, 3)).astype(np.float32))
+
+    # Correlation truncation.
+    dense = jax.jit(lambda u, v, w: corr_init(u, v, w, k))
+    chunked = jax.jit(lambda u, v, w: corr_init(u, v, w, k, chunk=max(k, n // 8)))
+    approx = jax.jit(lambda u, v, w: corr_init(u, v, w, k, approx=True))
+    print(f"corr_init dense   {timeit(dense, f1, f2, x2):8.2f} ms")
+    print(f"corr_init chunked {timeit(chunked, f1, f2, x2):8.2f} ms")
+    print(f"corr_init approx  {timeit(approx, f1, f2, x2):8.2f} ms")
+
+    state = dense(f1, f2, x2)
+
+    # Per-iteration lookup.
+    def lookup_xla(st, c):
+        rel = st.xyz - c[:, :, None, :]
+        vox = voxel_bin_means(st.corr, rel, 3, 0.25, 3)
+        kc, kr = knn_lookup(st, rel, 32)
+        return vox, kc, kr
+
+    def lookup_pallas_vox(st, c):
+        rel = st.xyz - c[:, :, None, :]
+        vox = voxel_bin_means_pallas(st.corr, rel, 3, 0.25, 3)
+        kc, kr = knn_lookup(st, rel, 32)
+        return vox, kc, kr
+
+    def lookup_fused(st, c):
+        return fused_corr_lookup(st.corr, st.xyz, c, 3, 0.25, 3, 32)
+
+    print(f"lookup xla        {timeit(jax.jit(lookup_xla), state, coords):8.2f} ms")
+    print(f"lookup pallas-vox {timeit(jax.jit(lookup_pallas_vox), state, coords):8.2f} ms")
+    print(f"lookup fused      {timeit(jax.jit(lookup_fused), state, coords):8.2f} ms")
+
+    # Graph construction.
+    g_dense = jax.jit(lambda pc: knn_indices(pc, pc, 32))
+    g_chunk = jax.jit(lambda pc: knn_indices(pc, pc, 32, chunk=max(512, n // 8)))
+    print(f"knn graph dense   {timeit(g_dense, x2):8.2f} ms")
+    print(f"knn graph chunked {timeit(g_chunk, x2):8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
