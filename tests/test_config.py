@@ -1,0 +1,60 @@
+"""Config validation and CLI argument plumbing."""
+
+import pytest
+
+from pvraft_tpu.config import Config, ModelConfig, compute_dtype, tiny_config
+
+
+def test_corr_knn_validation():
+    with pytest.raises(ValueError, match="corr_knn"):
+        ModelConfig(truncate_k=16, corr_knn=32)
+    ModelConfig(truncate_k=32, corr_knn=32)  # boundary OK
+
+
+def test_compute_dtype_mapping():
+    import jax.numpy as jnp
+
+    assert compute_dtype(ModelConfig()) is None
+    assert compute_dtype(ModelConfig(compute_dtype="bfloat16")) == jnp.bfloat16
+
+
+def test_tiny_config_valid():
+    cfg = tiny_config()
+    assert cfg.data.dataset == "synthetic"
+    assert cfg.model.corr_knn <= cfg.model.truncate_k
+
+
+def test_cli_config_roundtrip():
+    import train as train_cli
+
+    args = train_cli.parse_args(
+        ["--dataset", "synthetic", "--truncate_k", "64", "--corr_knn", "16",
+         "--bf16", "--use_pallas", "--approx_topk", "--corr_chunk", "128",
+         "--graph_chunk", "256", "--remat", "--lr_schedule", "cosine",
+         "--no_strict_sizes"]
+    )
+    cfg = train_cli.config_from_args(args)
+    assert cfg.model.truncate_k == 64
+    assert cfg.model.corr_knn == 16
+    assert cfg.model.compute_dtype == "bfloat16"
+    assert cfg.model.use_pallas and cfg.model.approx_topk and cfg.model.remat
+    assert cfg.model.corr_chunk == 128 and cfg.model.graph_chunk == 256
+    assert cfg.train.lr_schedule == "cosine"
+    assert not cfg.data.strict_sizes
+
+
+def test_cli_test_config_roundtrip():
+    import test as test_cli
+
+    args = test_cli.parse_args(
+        ["--dataset", "KITTI", "--truncate_k", "32", "--corr_knn", "8",
+         "--eval_iters", "4", "--refine", "--bf16"]
+    )
+    # The config is built inside main(); replicate the construction here by
+    # checking the parsed namespace drives ModelConfig without error.
+    cfg = ModelConfig(
+        truncate_k=args.truncate_k, corr_knn=args.corr_knn,
+        compute_dtype="bfloat16" if args.bf16 else "float32",
+    )
+    assert cfg.truncate_k == 32 and cfg.corr_knn == 8
+    assert args.refine and args.eval_iters == 4

@@ -41,3 +41,24 @@ def test_forward_matches_golden():
     np.testing.assert_allclose(float(f.sum()), GOLDEN_SUM, rtol=1e-4)
     np.testing.assert_allclose(float(np.abs(f).mean()), GOLDEN_ABSMEAN, rtol=1e-4)
     np.testing.assert_allclose(f[-1, 0, :5, :], GOLDEN_LAST5, atol=1e-3)
+
+
+GOLDEN_REFINE_SUM = 61.69562530517578
+GOLDEN_REFINE_ABSMEAN = 0.5893515944480896
+
+
+def test_refine_forward_matches_golden():
+    from pvraft_tpu.models.raft import PVRaftRefine
+
+    cfg = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8)
+    rng = np.random.default_rng(123)
+    xyz1 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 3)).astype(np.float32))
+    xyz2 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 3)).astype(np.float32))
+    model = PVRaftRefine(cfg)
+    params = model.init(jax.random.key(9), xyz1, xyz2, 2)
+    out = np.asarray(model.apply(params, xyz1, xyz2, num_iters=2))
+    assert out.shape == (1, 64, 3)
+    np.testing.assert_allclose(float(out.sum()), GOLDEN_REFINE_SUM, rtol=1e-4)
+    np.testing.assert_allclose(
+        float(np.abs(out).mean()), GOLDEN_REFINE_ABSMEAN, rtol=1e-4
+    )
