@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Benchmark: training throughput of the flagship config on real hardware.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Metric: point-pairs/sec/chip for the reference training configuration
 (8,192 points, 8 GRU iterations, full train step incl. backward+Adam).
@@ -11,19 +11,24 @@ Baseline (BASELINE.md): the reference trains 20 epochs x 17,640 samples in
 = 7,575 point-pairs/s per GPU at 8,192 points/sample. vs_baseline is our
 per-chip rate over that per-GPU rate.
 
-Tries the fastest numerics first (bf16 + Pallas voxel kernel + approximate
-top-k) and falls back to progressively safer configurations if a variant
-fails to compile/run, so a kernel regression can never zero the benchmark.
+Structure: the parent process NEVER imports jax. Every backend probe and
+every measured variant runs in its own child process with a hard timeout;
+a wedged TPU claim (observed round 1: backend init hung past a 600 s
+watchdog) dies with its child and the parent retries in a fresh process.
+Variants are ordered fastest-expected first (bf16 + Pallas voxel kernel +
+approximate top-k) and fall back to progressively safer configurations, so
+a kernel regression can never zero the benchmark. If the accelerator stays
+unreachable after genuine retries, a CPU-backend measurement is reported
+(clearly labeled) rather than a zero.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
-import traceback
-
-import numpy as np
 
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 17640 * 20 / (53 * 3600) / 2 * 8192  # ~7575
 
@@ -34,6 +39,20 @@ ITERS = int(os.environ.get("PVRAFT_BENCH_ITERS", 8))
 BATCH = int(os.environ.get("PVRAFT_BENCH_BATCH", 2))  # reference run.sh bs
 TRUNCATE_K = int(os.environ.get("PVRAFT_BENCH_K", 512))
 
+# Global wall-clock budget for the whole bench (probes + all retries).
+DEADLINE = time.monotonic() + float(os.environ.get("PVRAFT_BENCH_BUDGET_S", 2700))
+
+PROBE_TIMEOUT_S = float(os.environ.get("PVRAFT_BENCH_PROBE_TIMEOUT_S", 240))
+VARIANT_TIMEOUT_S = float(os.environ.get("PVRAFT_BENCH_VARIANT_TIMEOUT_S", 900))
+
+VARIANTS = [
+    ("bf16+pallas+approx", dict(compute_dtype="bfloat16", use_pallas=True,
+                                approx_topk=True)),
+    ("bf16+approx", dict(compute_dtype="bfloat16", approx_topk=True)),
+    ("bf16", dict(compute_dtype="bfloat16")),
+    ("fp32", dict()),
+]
+
 
 def _unit() -> str:
     return (
@@ -42,42 +61,24 @@ def _unit() -> str:
     )
 
 
-def _devices_with_watchdog(timeout_s: float = 600.0):
-    """Initialize the backend with a timeout: a wedged remote TPU claim
-    (observed when a client dies mid-compile) would otherwise hang forever."""
-    import threading
+# ---------------------------------------------------------------- child ----
 
+
+def _child_probe() -> None:
+    """Initialize the backend and report the platform. Hangs die with us."""
     import jax
 
-    result = {}
-
-    def probe():
-        try:
-            result["devices"] = jax.devices()
-        except Exception as e:  # pragma: no cover
-            result["error"] = str(e)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "devices" not in result:
-        print(
-            json.dumps(
-                {
-                    "metric": "train_point_pairs_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": _unit(),
-                    "vs_baseline": 0.0,
-                    "note": f"backend init failed/hung ({result.get('error', 'timeout')})",
-                }
-            )
-        )
-        raise SystemExit(0)
-    return result["devices"]
+    devices = jax.devices()
+    print(json.dumps({"ok": True, "platform": devices[0].platform,
+                      "n_devices": len(devices)}))
 
 
-def _run_variant(model_kwargs: dict) -> float:
-    """Steady-state seconds per train step for one model configuration."""
+def _child_variant(name: str) -> None:
+    """Measure steady-state seconds/step for one variant; print one line."""
+    kwargs = dict(VARIANTS)[name]
+
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -86,7 +87,8 @@ def _run_variant(model_kwargs: dict) -> float:
     from pvraft_tpu.engine.loss import sequence_loss
     from pvraft_tpu.models import PVRaft
 
-    cfg = ModelConfig(truncate_k=TRUNCATE_K, **model_kwargs)
+    platform = jax.devices()[0].platform
+    cfg = ModelConfig(truncate_k=TRUNCATE_K, **kwargs)
     model = PVRaft(cfg)
 
     rng = np.random.default_rng(0)
@@ -120,62 +122,178 @@ def _run_variant(model_kwargs: dict) -> float:
     for _ in range(n_steps):
         params, opt_state, loss = step(params, opt_state, pc1, pc2, mask, gt)
     jax.block_until_ready(loss)
-    return (time.perf_counter() - t0) / n_steps
+    dt = (time.perf_counter() - t0) / n_steps
+    print(json.dumps({"ok": True, "dt": dt, "platform": platform}))
 
 
-VARIANTS = [
-    ("bf16+pallas+approx", dict(compute_dtype="bfloat16", use_pallas=True,
-                                approx_topk=True)),
-    ("bf16+approx", dict(compute_dtype="bfloat16", approx_topk=True)),
-    ("bf16", dict(compute_dtype="bfloat16")),
-    ("fp32", dict()),
-]
+def _child_eval(name: str) -> None:
+    """Eval-protocol throughput: scenes/s at bs=1, 32 GRU iters
+    (``test.py:92,120``) — the other half of the capability story."""
+    kwargs = dict(VARIANTS)[name]
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.steps import make_eval_step
+    from pvraft_tpu.models import PVRaft
+
+    platform = jax.devices()[0].platform
+    cfg = ModelConfig(truncate_k=TRUNCATE_K, **kwargs)
+    model = PVRaft(cfg)
+    eval_iters = int(os.environ.get("PVRAFT_BENCH_EVAL_ITERS", 32))
+
+    rng = np.random.default_rng(0)
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (1, N_POINTS, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (1, N_POINTS, 3)).astype(np.float32))
+    batch = {"pc1": pc1, "pc2": pc2, "mask": jnp.ones((1, N_POINTS), jnp.float32),
+             "flow": pc2 - pc1}
+
+    params = model.init(jax.random.key(0), pc1[:, :256], pc2[:, :256], 2)
+    step = make_eval_step(model, eval_iters, 0.8)
+
+    metrics, flow = step(params, batch)  # warmup/compile
+    jax.block_until_ready(flow)
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        metrics, flow = step(params, batch)
+    jax.block_until_ready(flow)
+    dt = (time.perf_counter() - t0) / n_steps
+    print(json.dumps({"ok": True, "dt": dt, "platform": platform}))
 
 
-def main() -> None:
-    _devices_with_watchdog()
+# --------------------------------------------------------------- parent ----
 
-    # First success wins: variants are ordered fastest-expected first, so
-    # benching later ones would only add compile time.
-    best = None
-    note = []
-    for name, kwargs in VARIANTS:
-        try:
-            dt = _run_variant(kwargs)
-            note.append(f"{name}:{dt*1e3:.0f}ms")
-            best = (name, dt)
-            break
-        except Exception:
-            note.append(f"{name}:failed")
-            traceback.print_exc()
 
-    if best is None:
-        print(
-            json.dumps(
-                {
-                    "metric": "train_point_pairs_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": _unit(),
-                    "vs_baseline": 0.0,
-                    "note": "all variants failed: " + ",".join(note),
-                }
-            )
+def _spawn(child_args: list, timeout_s: float, cpu: bool = False):
+    """Run a bench child; return its parsed JSON line or None on failure."""
+    env = dict(os.environ)
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *child_args],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-        return
+    except subprocess.TimeoutExpired:
+        return None, True
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-2000:])
+        return None, False
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if parsed.get("ok"):
+            return parsed, False
+    return None, False
 
-    name, dt = best
-    pairs_per_sec = BATCH * N_POINTS / dt
+
+def _remaining() -> float:
+    return DEADLINE - time.monotonic()
+
+
+def _emit(value: float, extra: dict) -> None:
     out = {
         "metric": "train_point_pairs_per_sec_per_chip",
-        "value": round(pairs_per_sec, 1),
+        "value": round(value, 1),
         "unit": _unit(),
-        "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
-        "variant": name,
+        "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
     }
-    if len(note) > 1:  # earlier variants failed — surface the degradation
-        out["note"] = ",".join(note)
+    out.update(extra)
     print(json.dumps(out))
 
 
+def main() -> None:
+    notes = []
+
+    # 1. Backend probe, retried in fresh processes: a hung claim dies with
+    #    its child and the next attempt gets a clean client.
+    probe = None
+    for attempt in range(3):
+        budget = min(PROBE_TIMEOUT_S, max(_remaining(), 30.0))
+        probe, _ = _spawn(["--child-probe"], budget)
+        if probe is not None:
+            break
+        notes.append(f"probe{attempt + 1}:failed")
+        if _remaining() < 120:
+            break
+    use_cpu_fallback = probe is None
+
+    # 2. Measure variants, fastest-expected first; first success wins.
+    #    A timed-out child (wedged claim / slow remote compile) earns one
+    #    retry; a fast nonzero exit is deterministic — move on immediately.
+    best = None
+    if not use_cpu_fallback:
+        for name, _ in VARIANTS:
+            if _remaining() < 60:
+                notes.append("deadline")
+                break
+            for attempt in range(2):
+                budget = min(VARIANT_TIMEOUT_S, max(_remaining(), 60.0))
+                res, timed_out = _spawn(["--child-variant", name], budget)
+                if res is not None or not timed_out or _remaining() < 120:
+                    break
+                notes.append(f"{name}:timeout")
+            if res is not None:
+                notes.append(f"{name}:{res['dt'] * 1e3:.0f}ms")
+                best = (name, res)
+                break
+            notes.append(f"{name}:failed")
+        if best is None:
+            use_cpu_fallback = True
+
+    # 3. Last resort: a real measurement on the CPU backend — an honest
+    #    (clearly labeled) number beats a zeroed benchmark.
+    if use_cpu_fallback and best is None:
+        notes.append("accelerator unreachable after retries; cpu fallback")
+        for name in ("bf16", "fp32"):
+            budget = min(VARIANT_TIMEOUT_S, max(_remaining(), 60.0))
+            res, _ = _spawn(["--child-variant", name], budget, cpu=True)
+            if res is not None:
+                best = (name, res)
+                break
+            notes.append(f"cpu/{name}:failed")
+
+    if best is None:
+        _emit(0.0, {"note": "all variants failed: " + ",".join(notes)})
+        return
+
+    name, res = best
+    pairs_per_sec = BATCH * N_POINTS / res["dt"]
+    extra = {"variant": name, "platform": res.get("platform", "unknown")}
+
+    # Secondary metric: eval-protocol throughput (bs=1, 32 iters).
+    if _remaining() > 120:
+        ev, _ = _spawn(
+            ["--child-eval", name],
+            min(VARIANT_TIMEOUT_S, _remaining()),
+            cpu=res.get("platform") == "cpu",
+        )
+        if ev is not None:
+            extra["eval_scenes_per_sec"] = round(1.0 / ev["dt"], 3)
+        else:
+            notes.append("eval:failed")
+
+    if len(notes) > 1 or res.get("platform") == "cpu":
+        extra["note"] = ",".join(notes)
+    _emit(pairs_per_sec, extra)
+
+
 if __name__ == "__main__":
-    main()
+    if "--child-probe" in sys.argv:
+        _child_probe()
+    elif "--child-variant" in sys.argv:
+        _child_variant(sys.argv[sys.argv.index("--child-variant") + 1])
+    elif "--child-eval" in sys.argv:
+        _child_eval(sys.argv[sys.argv.index("--child-eval") + 1])
+    else:
+        main()

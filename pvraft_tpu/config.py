@@ -44,6 +44,13 @@ class ModelConfig:
     # Stream the kNN graph construction over point chunks (avoids the
     # (N, N) distance matrix; needed for 16k+ point clouds).
     graph_chunk: Optional[int] = None
+    # Sequence-parallel correlation: shard both point axes of the
+    # correlation volume over the mesh "seq" axis and build the truncated
+    # cache with a ppermute ring (parallel/ring.py) instead of the dense
+    # (N, N) volume. Requires the model to be constructed with a mesh whose
+    # seq axis > 1; the long-context path for 16k+ points across chips
+    # (memory wall: reference model/corr.py:96-99).
+    seq_shard: bool = False
 
     def __post_init__(self):
         if self.corr_knn > self.truncate_k:

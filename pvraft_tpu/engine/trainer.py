@@ -92,9 +92,25 @@ class Trainer:
         self.step_count = 0
 
         self.train_ds, self.val_ds, self.test_ds = build_datasets(cfg)
+        # batch_size is PER-DEVICE (the reference's DataParallel splits its
+        # global bs=2 across 2 GPUs, tools/engine.py:63-64; here each chip
+        # of the mesh data axis gets cfg.train.batch_size samples).
+        n_data = self.mesh.shape["data"]
+        self.global_batch = cfg.train.batch_size * n_data
+        self.log.info(
+            f"mesh {dict(self.mesh.shape)}: per-device batch "
+            f"{cfg.train.batch_size} -> global batch {self.global_batch}"
+        )
+        if self.global_batch > len(self.train_ds):
+            raise ValueError(
+                f"global batch {self.global_batch} "
+                f"(= {cfg.train.batch_size}/device x {n_data} devices) "
+                f"exceeds dataset size {len(self.train_ds)}; use a smaller "
+                f"mesh or per-device batch"
+            )
         self.train_loader = PrefetchLoader(
             self.train_ds,
-            cfg.train.batch_size,
+            self.global_batch,
             shuffle=True,
             drop_last=True,
             num_workers=cfg.data.num_workers,
@@ -109,7 +125,9 @@ class Trainer:
         )
 
         refine = cfg.train.refine
-        self.model = (PVRaftRefine if refine else PVRaft)(cfg.model)
+        self.model = (PVRaftRefine if refine else PVRaft)(
+            cfg.model, mesh=self.mesh if cfg.model.seq_shard else None
+        )
         rng = jax.random.key(cfg.train.seed)
         sample = self._device_batch(next(iter(self.train_loader.epoch(0))))
         self.params = self.model.init(
@@ -182,8 +200,8 @@ class Trainer:
 
     # -- loops ---------------------------------------------------------------
 
-    def _device_batch(self, batch: Dict[str, np.ndarray]):
-        return device_batch(batch, self.mesh)
+    def _device_batch(self, batch: Dict[str, np.ndarray], on_indivisible="error"):
+        return device_batch(batch, self.mesh, on_indivisible)
 
     def training(self, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
@@ -233,15 +251,22 @@ class Trainer:
             best = os.path.join(self.ckpt_dir, "best_checkpoint" + SUFFIX)
             if os.path.exists(best):
                 self.load_weights(best)  # engine.py:191
-        sums: Dict[str, float] = {}
+        # Metric sums stay on device across the whole loop — a float() per
+        # batch would stall dispatch once per scene (3,824 times on FT3D
+        # test); one device->host transfer per epoch instead.
+        dev_sums = None
         count = 0
         for batch in loader.epoch(0):
-            b = self._device_batch(batch)
+            # bs=1 protocol (test.py:92): replication is intended here.
+            b = self._device_batch(batch, on_indivisible="replicate")
             metrics, _ = self.eval_step(self.params, b)
-            for k, v in metrics.items():
-                sums[k] = sums.get(k, 0.0) + float(v)
+            dev_sums = metrics if dev_sums is None else jax.tree_util.tree_map(
+                jnp.add, dev_sums, metrics
+            )
             count += 1
-        means = {k: v / max(1, count) for k, v in sums.items()}
+        means = {
+            k: float(v) / max(1, count) for k, v in (dev_sums or {}).items()
+        }
         tag = mode.capitalize()
         for k, t in [
             ("loss", "Loss"), ("epe3d", "EPE"), ("outlier", "Outlier"),

@@ -16,7 +16,7 @@ expressed as ``nn.scan`` over a shared-parameter update step:
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -56,9 +56,52 @@ class PVRaft(nn.Module):
     ``__call__(xyz1, xyz2, num_iters)`` returns ``(flows, graph1)`` where
     ``flows`` is ``(num_iters, B, N, 3)`` and ``graph1`` is the pc1 feature
     graph (consumed by the stage-2 refine head).
+
+    When ``cfg.seq_shard`` is set and a ``mesh`` with a >1 ``seq`` axis is
+    attached, the correlation cache is built sequence-parallel: both point
+    axes shard over ``seq`` and the truncated top-k is assembled with a
+    ppermute ring (``parallel/ring.py``) under ``jax.shard_map`` — the
+    (N, N) volume (256 MB fp32 at 8,192 pts, ``model/corr.py:96-99``) is
+    never resident on any one chip.
     """
 
     cfg: ModelConfig
+    mesh: Optional[jax.sharding.Mesh] = None
+
+    def _corr_init(self, fmap1, fmap2, xyz2):
+        cfg = self.cfg
+        mesh = self.mesh
+        seq = mesh.shape.get("seq", 1) if mesh is not None else 1
+        if not (cfg.seq_shard and seq > 1):
+            return corr_init(
+                fmap1, fmap2, xyz2, cfg.truncate_k, cfg.corr_chunk,
+                approx=cfg.approx_topk,
+            )
+        from jax.sharding import PartitionSpec as P
+
+        from pvraft_tpu.parallel.ring import ring_corr_init
+
+        n1, n2 = fmap1.shape[1], fmap2.shape[1]
+        if n1 % seq or n2 % seq:
+            raise ValueError(
+                f"seq_shard: the mesh seq axis ({seq}) must divide the "
+                f"point counts ({n1}, {n2})"
+            )
+        # Keep the batch axis on "data" when that axis is real AND the
+        # actual batch divides it (bs=1 eval batches are replicated —
+        # test.py:92 protocol — and must not be force-split).
+        n_data = mesh.shape.get("data", 1)
+        bspec = "data" if n_data > 1 and fmap1.shape[0] % n_data == 0 else None
+        ring = jax.shard_map(
+            lambda a, b, c: ring_corr_init(a, b, c, cfg.truncate_k, "seq"),
+            mesh=mesh,
+            in_specs=(P(bspec, "seq", None),) * 2 + (P(bspec, "seq", None),),
+            out_specs=CorrState(
+                corr=P(bspec, "seq", None), xyz=P(bspec, "seq", None, None)
+            ),
+            check_vma=False,
+        )
+        return ring(fmap1, fmap2, xyz2)
 
     @nn.compact
     def __call__(
@@ -73,10 +116,7 @@ class PVRaft(nn.Module):
         fmap1, graph1 = feat(xyz1)
         fmap2, _ = feat(xyz2)
 
-        state = corr_init(
-            fmap1, fmap2, xyz2, cfg.truncate_k, cfg.corr_chunk,
-            approx=cfg.approx_topk,
-        )
+        state = self._corr_init(fmap1, fmap2, xyz2)
 
         fct, graph_ctx = PointEncoder(
             cfg.encoder_width, cfg.graph_k, dtype=dtype,
@@ -109,12 +149,15 @@ class PVRaftRefine(nn.Module):
     using the pc1 feature graph (``model/refine.py:6-22``)."""
 
     cfg: ModelConfig
+    mesh: Optional[jax.sharding.Mesh] = None
 
     @nn.compact
     def __call__(
         self, xyz1: jnp.ndarray, xyz2: jnp.ndarray, num_iters: int = 32
     ) -> jnp.ndarray:
-        flows, graph1 = PVRaft(self.cfg, name="backbone")(xyz1, xyz2, num_iters)
+        flows, graph1 = PVRaft(self.cfg, mesh=self.mesh, name="backbone")(
+            xyz1, xyz2, num_iters
+        )
         flow = lax.stop_gradient(flows[-1])
         graph1 = Graph(graph1.neighbors, lax.stop_gradient(graph1.rel_pos))
 

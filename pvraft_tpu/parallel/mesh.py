@@ -26,14 +26,25 @@ def make_mesh(
     n_seq: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (data, seq) mesh. Defaults to all devices on the data axis."""
-    devices = list(devices if devices is not None else jax.devices())
+    """Build a (data, seq) mesh. Defaults to all devices on the data axis.
+
+    With the default device list, a mesh smaller than the host's device
+    count takes the first ``n_data * n_seq`` devices (handy for tests and
+    single-chip runs); an explicit ``devices`` list must match exactly.
+    """
+    explicit = devices is not None
+    devices = list(devices if explicit else jax.devices())
     if n_data is None or n_data < 0:
         n_data = len(devices) // n_seq
-    if n_data * n_seq != len(devices):
-        raise ValueError(
-            f"mesh {n_data}x{n_seq} does not cover {len(devices)} devices"
-        )
+    want = n_data * n_seq
+    if want <= 0:
+        raise ValueError(f"mesh {n_data}x{n_seq} must have >= 1 device")
+    if want != len(devices):
+        if explicit or want > len(devices):
+            raise ValueError(
+                f"mesh {n_data}x{n_seq} does not cover {len(devices)} devices"
+            )
+        devices = devices[:want]
     arr = np.asarray(devices).reshape(n_data, n_seq)
     return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
 
@@ -47,12 +58,18 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch: Any, mesh: Mesh) -> Any:
+def shard_batch(batch: Any, mesh: Mesh, on_indivisible: str = "warn") -> Any:
     """Place every array of a batch dict with its batch axis over ``data``.
 
-    Batches whose leading axis does not divide the data axis (e.g. the
-    reference's batch-size-1 eval protocol, ``test.py:92``) are replicated
-    instead — correct, just without batch parallelism.
+    A leading axis that does not divide the data axis cannot be sharded.
+    ``on_indivisible`` controls what happens then:
+
+      * ``"error"``     — raise (the training path: silent replication would
+        run the full batch on every chip — correct but N× the FLOPs, the
+        worst failure mode on a throughput-scored project);
+      * ``"warn"``      — replicate and ``warnings.warn`` (default);
+      * ``"replicate"`` — replicate silently (the reference's batch-size-1
+        eval protocol, ``test.py:92``, where replication is intended).
     """
     n_data = mesh.shape[DATA_AXIS]
     sharded = batch_sharding(mesh)
@@ -60,17 +77,29 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
 
     def put(x):
         ok = getattr(x, "ndim", 0) >= 1 and x.shape[0] % n_data == 0
+        if not ok and n_data > 1:
+            msg = (
+                f"batch leading axis {getattr(x, 'shape', ())} does not "
+                f"divide mesh data axis ({n_data}); replicating instead of "
+                f"sharding — no batch parallelism"
+            )
+            if on_indivisible == "error":
+                raise ValueError(msg)
+            if on_indivisible == "warn":
+                import warnings
+
+                warnings.warn(msg, stacklevel=3)
         return jax.device_put(x, sharded if ok else repl)
 
     return jax.tree_util.tree_map(put, batch)
 
 
-def device_batch(batch: Any, mesh: Mesh) -> Any:
+def device_batch(batch: Any, mesh: Mesh, on_indivisible: str = "warn") -> Any:
     """Host batch dict (numpy) -> device arrays with batch-axis sharding."""
     import jax.numpy as jnp
 
     return shard_batch(
-        {k: jnp.asarray(v) for k, v in batch.items()}, mesh
+        {k: jnp.asarray(v) for k, v in batch.items()}, mesh, on_indivisible
     )
 
 

@@ -10,6 +10,7 @@ import pytest
 
 from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
 from pvraft_tpu.engine.schedule import make_lr_schedule
+from pvraft_tpu.parallel.mesh import make_mesh
 
 
 def _tiny_cfg(tmp_path, refine=False, epochs=1):
@@ -21,6 +22,14 @@ def _tiny_cfg(tmp_path, refine=False, epochs=1):
                           eval_iters=2, refine=refine, checkpoint_interval=1),
         exp_path=str(tmp_path / "exp"),
     )
+
+
+def _tiny_trainer(cfg):
+    """batch_size is per-device: 4-sample synthetic datasets need a 1-device
+    mesh (the 8-device default would ask for a global batch of 16)."""
+    from pvraft_tpu.engine.trainer import Trainer
+
+    return Trainer(cfg, mesh=make_mesh(n_data=1))
 
 
 def test_parity_schedule_is_near_constant():
@@ -61,10 +70,8 @@ def test_checkpoint_roundtrip(tmp_path):
 
 
 def test_trainer_end_to_end(tmp_path):
-    from pvraft_tpu.engine.trainer import Trainer
-
     cfg = _tiny_cfg(tmp_path, epochs=2)
-    tr = Trainer(cfg)
+    tr = _tiny_trainer(cfg)
     m0 = tr.training(0)
     v0 = tr.val_test(0, "val")
     m1 = tr.training(1)
@@ -80,14 +87,12 @@ def test_trainer_end_to_end(tmp_path):
 
 
 def test_trainer_resume(tmp_path):
-    from pvraft_tpu.engine.trainer import Trainer
-
     cfg = _tiny_cfg(tmp_path, epochs=2)
-    tr = Trainer(cfg)
+    tr = _tiny_trainer(cfg)
     tr.training(0)
     last = os.path.join(cfg.exp_path, "checkpoints", "last_checkpoint.msgpack")
 
-    tr2 = Trainer(cfg)
+    tr2 = _tiny_trainer(cfg)
     tr2.load_weights(last, resume=True)
     assert tr2.begin_epoch == 1
     for a, b in zip(
@@ -97,10 +102,8 @@ def test_trainer_resume(tmp_path):
 
 
 def test_refine_trainer_freezes_backbone(tmp_path):
-    from pvraft_tpu.engine.trainer import Trainer
-
     cfg = _tiny_cfg(tmp_path, refine=True)
-    tr = Trainer(cfg)
+    tr = _tiny_trainer(cfg)
     before = jax.tree_util.tree_map(np.asarray, tr.params)
     tr.training(0)
     after = jax.tree_util.tree_map(np.asarray, tr.params)
@@ -120,20 +123,47 @@ def test_refine_trainer_freezes_backbone(tmp_path):
 
 
 def test_stage1_weight_import(tmp_path):
-    from pvraft_tpu.engine.trainer import Trainer
-
     cfg1 = _tiny_cfg(tmp_path)
-    tr1 = Trainer(cfg1)
+    tr1 = _tiny_trainer(cfg1)
     tr1.training(0)
     last = os.path.join(cfg1.exp_path, "checkpoints", "last_checkpoint.msgpack")
 
     cfg2 = _tiny_cfg(tmp_path / "r", refine=True)
-    tr2 = Trainer(cfg2)
+    tr2 = _tiny_trainer(cfg2)
     tr2.load_stage1_weights(last)
     s1 = jax.tree_util.tree_map(np.asarray, tr1.params)["params"]
     s2 = jax.tree_util.tree_map(np.asarray, tr2.params)["params"]["backbone"]
     for x, y in zip(jax.tree_util.tree_leaves(s1), jax.tree_util.tree_leaves(s2)):
         np.testing.assert_array_equal(x, y)
+
+
+def test_trainer_per_device_batch_scales_with_mesh(tmp_path):
+    """bs is per-device: an 8-way data mesh trains 8x the samples per step
+    (the role DataParallel's split plays at tools/engine.py:63-64)."""
+    from pvraft_tpu.engine.trainer import Trainer
+
+    cfg = _tiny_cfg(tmp_path)
+    cfg = cfg.replace(
+        data=cfg.data.__class__(dataset="synthetic", max_points=64,
+                                synthetic_size=16, num_workers=0),
+        train=cfg.train.__class__(batch_size=1, num_epochs=1, iters=2,
+                                  eval_iters=2, checkpoint_interval=1),
+    )
+    tr = Trainer(cfg, mesh=make_mesh(n_data=8))
+    assert tr.global_batch == 8
+    assert len(tr.train_loader) == 2  # 16 samples / (1 per device * 8)
+    m = tr.training(0)
+    assert np.isfinite(m["loss"])
+
+
+def test_trainer_rejects_oversized_global_batch(tmp_path):
+    """A mesh asking for more samples per step than the dataset holds must
+    fail loudly, not silently produce zero steps."""
+    from pvraft_tpu.engine.trainer import Trainer
+
+    cfg = _tiny_cfg(tmp_path)  # synthetic_size=4, bs=2/device
+    with pytest.raises(ValueError, match="global batch"):
+        Trainer(cfg, mesh=make_mesh(n_data=8))  # wants 16 > 4
 
 
 def test_evaluator_runs_and_dumps(tmp_path):

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from pvraft_tpu.ops.corr import CorrState, corr_init
 from pvraft_tpu.parallel.mesh import make_mesh, replicate, shard_batch
@@ -17,8 +17,12 @@ def test_make_mesh_shapes():
     assert mesh.devices.size == 8
     mesh2 = make_mesh(n_data=4, n_seq=2)
     assert mesh2.shape == {"data": 4, "seq": 2}
+    # Smaller-than-host meshes take a device prefix (tests, single chip).
+    assert make_mesh(n_data=1).devices.size == 1
     with pytest.raises(ValueError):
-        make_mesh(n_data=3, n_seq=2)
+        make_mesh(n_data=5, n_seq=2)  # 10 > 8 devices
+    with pytest.raises(ValueError):
+        make_mesh(n_data=3, n_seq=2, devices=jax.devices())  # explicit: exact
 
 
 def test_shard_batch_and_replicate():
@@ -28,6 +32,21 @@ def test_shard_batch_and_replicate():
     assert sharded["pc1"].sharding.spec == P("data")
     params = replicate({"w": jnp.ones((4, 4))}, mesh)
     assert params["w"].sharding.spec == P()
+
+
+def test_shard_batch_indivisible_modes():
+    """A batch that can't split over the data axis must never replicate
+    silently on the training path (VERDICT r1: silent 8x-FLOPs DP fallback)."""
+    mesh = make_mesh(n_data=8)
+    batch = {"pc1": jnp.zeros((2, 16, 3))}  # 2 % 8 != 0
+    with pytest.raises(ValueError, match="does not divide"):
+        shard_batch(batch, mesh, on_indivisible="error")
+    with pytest.warns(UserWarning, match="does not divide"):
+        out = shard_batch(batch, mesh, on_indivisible="warn")
+    assert out["pc1"].sharding.spec == P()
+    # Explicit replicate mode (bs=1 eval protocol) stays silent.
+    out = shard_batch(batch, mesh, on_indivisible="replicate")
+    assert out["pc1"].sharding.spec == P()
 
 
 def test_ring_corr_matches_single_device():
@@ -47,7 +66,7 @@ def test_ring_corr_matches_single_device():
         out_specs=CorrState(
             corr=P(None, "seq", None), xyz=P(None, "seq", None, None)
         ),
-        check_rep=False,
+        check_vma=False,
     )
     got = ring(f1, f2, x2)
     np.testing.assert_allclose(np.asarray(got.corr), np.asarray(ref.corr), atol=1e-5)
@@ -101,6 +120,108 @@ def test_dp_train_step_matches_single_device():
         # Cross-device gradient accumulation reorders fp32 sums; observed
         # max |diff| ~1e-4 after one sgd step on this tiny model.
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4)
+
+
+def test_seq_shard_model_matches_dense():
+    """cfg.seq_shard routes the model's corr_init through the ppermute ring
+    (VERDICT r1 item 6): a 1x8 seq mesh forward must match the dense
+    single-device forward."""
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models import PVRaft
+
+    rng = np.random.default_rng(3)
+    n = 64
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+
+    cfg = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8)
+    dense = PVRaft(cfg)
+    params = dense.init(jax.random.key(0), pc1, pc2, 2)
+    ref, _ = jax.jit(lambda p: dense.apply(p, pc1, pc2, 2))(params)
+
+    mesh = make_mesh(n_data=1, n_seq=8)
+    import dataclasses
+    sharded = PVRaft(dataclasses.replace(cfg, seq_shard=True), mesh=mesh)
+    got, _ = jax.jit(lambda p: sharded.apply(p, pc1, pc2, 2))(params)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_seq_shard_train_step_2x4_matches_8x1():
+    """A 2x4 (data x seq) mesh training step must match the 8x1 pure-DP
+    result: batch parallelism and the correlation ring compose."""
+    import optax
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.models import PVRaft
+
+    rng = np.random.default_rng(4)
+    b, n = 8, 32
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (b, n, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (b, n, 3)).astype(np.float32))
+    mask = jnp.ones((b, n), jnp.float32)
+    gt = pc2 - pc1
+    base = ModelConfig(truncate_k=8, corr_knn=4, graph_k=4)
+
+    def run(mesh, cfg):
+        model = PVRaft(cfg, mesh=mesh if cfg.seq_shard else None)
+        params = model.init(jax.random.key(0), pc1, pc2, 2)
+        tx = optax.sgd(1e-2)
+
+        def step(params, opt_state, pc1, pc2, mask, gt):
+            def loss_fn(p):
+                flows, _ = model.apply(p, pc1, pc2, 2)
+                return sequence_loss(flows, mask, gt, 0.8)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        pr = replicate(params, mesh)
+        opr = replicate(tx.init(params), mesh)
+        batch = shard_batch({"pc1": pc1, "pc2": pc2, "mask": mask, "gt": gt},
+                            mesh)
+        p, _, loss = jax.jit(step)(
+            pr, opr, batch["pc1"], batch["pc2"], batch["mask"], batch["gt"]
+        )
+        return p, float(loss)
+
+    p_dp, loss_dp = run(make_mesh(n_data=8), base)
+    import dataclasses
+    p_sp, loss_sp = run(
+        make_mesh(n_data=2, n_seq=4),
+        dataclasses.replace(base, seq_shard=True),
+    )
+    np.testing.assert_allclose(loss_sp, loss_dp, atol=1e-5)
+    for a, b_ in zip(jax.tree_util.tree_leaves(p_dp),
+                     jax.tree_util.tree_leaves(p_sp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4)
+
+
+def test_seq_shard_bs1_eval_replicates_batch():
+    """bs=1 eval on a data>1 mesh must not try to split the batch axis:
+    the ring spec keeps the batch replicated when it doesn't divide the
+    data axis (the reference's bs=1 protocol, test.py:92)."""
+    import dataclasses
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models import PVRaft
+
+    rng = np.random.default_rng(5)
+    n = 32
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+    cfg = dataclasses.replace(
+        ModelConfig(truncate_k=8, corr_knn=4, graph_k=4), seq_shard=True
+    )
+    mesh = make_mesh(n_data=2, n_seq=4)
+    model = PVRaft(cfg, mesh=mesh)
+    params = model.init(jax.random.key(0), pc1, pc2, 2)
+    flows, _ = jax.jit(lambda p: model.apply(p, pc1, pc2, 2))(params)
+    assert np.all(np.isfinite(np.asarray(flows)))
+
+
+def test_make_mesh_rejects_zero():
+    with pytest.raises(ValueError, match=">= 1 device"):
+        make_mesh(n_data=0)
 
 
 def test_graft_entry_dryrun():

@@ -46,7 +46,9 @@ def test_ft3d_trainer_end_to_end(tmp_path):
                           checkpoint_interval=1),
         exp_path=str(tmp_path / "exp"),
     )
-    tr = Trainer(cfg)
+    from pvraft_tpu.parallel.mesh import make_mesh
+
+    tr = Trainer(cfg, mesh=make_mesh(n_data=1))  # 6-sample tree: 1-device mesh
     # The FT3D train loader must be on the native C++ path when available.
     from pvraft_tpu import native
 

@@ -1,0 +1,140 @@
+"""True reference parity: run the ACTUAL reference torch model
+(``/root/reference/model/RAFTSceneFlow.py``) on CPU — with a numpy/torch
+``scatter_add`` shim standing in for the torch-scatter CUDA extension at
+``model/corr.py:50`` — export its randomly-initialized state_dict, import it
+through ``import_torch_state_dict``, and assert per-iteration flows of
+``PVRaft`` match the reference within float tolerance.
+
+This certifies the converter and every op's semantics against reality
+instead of self-written oracles (``RAFTSceneFlow.py:22-50``,
+``corr.py:31-100``, ``update.py:75-87``, ``gconv.py:38-85``,
+``graph.py:27-89``). Skipped when the reference checkout is absent.
+"""
+
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REF_ROOT = "/root/reference"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REF_ROOT, "model")),
+    reason="reference checkout not available",
+)
+
+
+@pytest.fixture(scope="module")
+def ref_rsf():
+    """Import the reference RSF with a torch_scatter shim installed."""
+    import torch
+
+    if "torch_scatter" not in sys.modules:
+        shim = types.ModuleType("torch_scatter")
+
+        def scatter_add(src, index, dim=-1, dim_size=None):
+            # Same contract as torch_scatter.scatter_add for the reference's
+            # call sites (model/corr.py:64-65): out[..., i] = sum of src
+            # where index == i, output sized to index.max()+1.
+            n = int(index.max()) + 1 if dim_size is None else dim_size
+            shape = list(src.shape)
+            shape[dim] = n
+            out = torch.zeros(shape, dtype=src.dtype, device=src.device)
+            return out.scatter_add_(dim, index, src)
+
+        shim.scatter_add = scatter_add
+        sys.modules["torch_scatter"] = shim
+
+    if REF_ROOT not in sys.path:
+        sys.path.insert(0, REF_ROOT)
+    from model.RAFTSceneFlow import RSF
+
+    return RSF
+
+
+def _make_models(ref_rsf, truncate_k=64, seed=0):
+    import torch
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.checkpoint import import_torch_state_dict
+    from pvraft_tpu.models.raft import PVRaft
+
+    args = types.SimpleNamespace(
+        corr_levels=3, base_scales=0.25, truncate_k=truncate_k
+    )
+    torch.manual_seed(seed)
+    tmodel = ref_rsf(args)
+    tmodel.eval()
+
+    sd = {k: v.detach().numpy() for k, v in tmodel.state_dict().items()}
+    tree = import_torch_state_dict(sd)
+
+    cfg = ModelConfig(truncate_k=truncate_k)
+    jmodel = PVRaft(cfg)
+    return tmodel, jmodel, {"params": tree}
+
+
+def test_forward_flows_match_reference(ref_rsf):
+    """Same weights + same clouds -> same per-iteration flows (4 iters,
+    N=256). This is the end-to-end semantics certificate for encoder,
+    graph kNN, corr init/lookup (voxel + knn branches), and the GRU."""
+    import torch
+
+    import jax.numpy as jnp
+
+    tmodel, jmodel, variables = _make_models(ref_rsf)
+
+    rng = np.random.default_rng(42)
+    n = 256
+    xyz1 = rng.uniform(-1, 1, (1, n, 3)).astype(np.float32)
+    # pc2 = pc1 + small flow: keeps voxel bin assignments away from the
+    # +/-0.5 rounding boundaries that would flip under fp reordering.
+    xyz2 = (xyz1 + 0.05 * rng.normal(size=(1, n, 3))).astype(np.float32)
+
+    with torch.no_grad():
+        t_flows = tmodel([torch.from_numpy(xyz1), torch.from_numpy(xyz2)],
+                         num_iters=4)
+    t_flows = np.stack([f.numpy() for f in t_flows])  # (T, B, N, 3)
+
+    j_flows, _ = jmodel.apply(
+        variables, jnp.asarray(xyz1), jnp.asarray(xyz2), num_iters=4
+    )
+    j_flows = np.asarray(j_flows)
+
+    assert j_flows.shape == t_flows.shape
+    # Tolerance: fp32 reorderings accumulate over 4 GRU iterations; top-k
+    # tie-breaks are improbable with continuous random features.
+    np.testing.assert_allclose(j_flows, t_flows, atol=2e-4, rtol=1e-3)
+
+
+def test_eval_metrics_match_reference(ref_rsf):
+    """The reference eval protocol (test.py:120-126): final-iteration flow
+    feeds EPE3D — both frameworks must agree on the metric values too."""
+    import torch
+
+    import jax.numpy as jnp
+
+    from pvraft_tpu.engine.metrics import flow_metrics
+
+    tmodel, jmodel, variables = _make_models(ref_rsf, seed=1)
+
+    rng = np.random.default_rng(7)
+    n = 256
+    xyz1 = rng.uniform(-1, 1, (1, n, 3)).astype(np.float32)
+    gt_flow = 0.1 * rng.normal(size=(1, n, 3)).astype(np.float32)
+    xyz2 = xyz1 + gt_flow
+    mask = np.ones((1, n), np.float32)
+
+    with torch.no_grad():
+        t_flow = tmodel([torch.from_numpy(xyz1), torch.from_numpy(xyz2)],
+                        num_iters=4)[-1].numpy()
+    j_flow = np.asarray(jmodel.apply(
+        variables, jnp.asarray(xyz1), jnp.asarray(xyz2), num_iters=4
+    )[0][-1])
+
+    m_t = flow_metrics(jnp.asarray(t_flow), jnp.asarray(mask), jnp.asarray(gt_flow))
+    m_j = flow_metrics(jnp.asarray(j_flow), jnp.asarray(mask), jnp.asarray(gt_flow))
+    for k in m_t:
+        np.testing.assert_allclose(float(m_j[k]), float(m_t[k]), atol=1e-3)

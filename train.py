@@ -32,7 +32,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--eval_iters", type=int, default=32,
                    help="GRU iterations at val/test (reference hardcodes 32)")
     p.add_argument("--gamma", type=float, default=0.8)
-    p.add_argument("--batch_size", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=2,
+                   help="PER-DEVICE batch; global = batch_size x data-axis size "
+                        "(the reference's bs=2 across 2 GPUs = 1/device)")
     p.add_argument("--num_epochs", type=int, default=20)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--lr_schedule", default="parity",
