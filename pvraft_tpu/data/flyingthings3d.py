@@ -72,12 +72,14 @@ class FT3D(SceneFlowDataset):
         return len(self.filenames)
 
     def native_paths(self, idx: int):
-        """(pc1_path, pc2_path, flip_xz) for the native batch loader."""
+        """(pc1_path, pc2_path, flip_xz, filter_mode) for the native batch
+        loader (filter_mode 0: no row filter)."""
         scene = self.filenames[idx]
         return (
             os.path.join(scene, "pc1.npy"),
             os.path.join(scene, "pc2.npy"),
             True,
+            0,
         )
 
     def load_sequence(self, idx: int):

@@ -73,8 +73,18 @@ class KITTI(SceneFlowDataset):
     def __len__(self) -> int:
         return len(self.paths)
 
-    # NOTE: no native_paths here — the KITTI load path applies ground/depth
-    # filtering (below) that the native assembler does not implement.
+    def native_paths(self, idx: int):
+        """(pc1_path, pc2_path, flip_xz, filter_mode) for the native batch
+        loader. filter_mode 1 applies the ground/depth row filter
+        (``kitti_hplflownet.py:81-87``) inside the C++ assembler, mirroring
+        ``load_sequence`` below."""
+        scene = self.paths[idx]
+        return (
+            os.path.join(scene, "pc1.npy"),
+            os.path.join(scene, "pc2.npy"),
+            False,
+            1,
+        )
 
     def load_sequence(self, idx: int):
         scene = self.paths[idx]

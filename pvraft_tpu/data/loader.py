@@ -78,10 +78,10 @@ class PrefetchLoader:
     # -- native path --------------------------------------------------------
 
     def _native_epoch(self, order, starts, epoch: int) -> Iterator[Item]:
-        """C++ batch assembly: threaded npy reads + subsampling into
-        preallocated arrays. The reject-and-advance policy
-        (``generic.py:101-110``) is applied by re-requesting undersized
-        scenes at idx+1."""
+        """C++ batch assembly: threaded npy reads + optional row filter +
+        subsampling into preallocated arrays. The reject-and-advance policy
+        (``generic.py:101-110``) is applied per item: only undersized scenes
+        are re-requested (at idx+1), the rest of the batch is kept."""
         from pvraft_tpu import native as native_mod
 
         ds = self.dataset
@@ -89,34 +89,46 @@ class PrefetchLoader:
         threads = max(1, self.num_workers)
         for s in starts:
             idxs = [int(i) for i in order[s : s + self.batch_size]]
+            pending = list(range(len(idxs)))  # batch rows still unfilled
+            out = None
             for _attempt in range(len(ds) + 1):
-                triples = [ds.native_paths(j) for j in idxs]
+                quads = [ds.native_paths(idxs[p]) for p in pending]
                 pc1, pc2, mask, flow, status = native_mod.load_scene_batch(
-                    [t[0] for t in triples],
-                    [t[1] for t in triples],
-                    idxs,
+                    [q[0] for q in quads],
+                    [q[1] for q in quads],
+                    [idxs[p] for p in pending],
                     n_pts,
                     self.native_max_rows,
                     seed=ds._seed,
                     epoch=epoch,
-                    flip_xz=triples[0][2],
+                    flip_xz=quads[0][2],
+                    filter_mode=quads[0][3],
                     n_threads=threads,
                 )
                 if np.any(status < 0):
                     bad = int(np.argmax(status < 0))
                     raise IOError(
-                        f"native loader failed on {triples[bad][0]} "
+                        f"native loader failed on {quads[bad][0]} "
                         f"(status {int(status[bad])})"
                     )
-                if np.all(status == 1):
+                if out is None:  # first pass covers the whole batch
+                    out = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": flow}
+                else:
+                    for row, p in enumerate(pending):
+                        out["pc1"][p] = pc1[row]
+                        out["pc2"][p] = pc2[row]
+                        out["mask"][p] = mask[row]
+                        out["flow"][p] = flow[row]
+                retry = [p for row, p in enumerate(pending)
+                         if status[row] != 1]
+                if not retry:
                     break
-                idxs = [
-                    j if st == 1 else (j + 1) % len(ds)
-                    for j, st in zip(idxs, status)
-                ]
+                for p in retry:
+                    idxs[p] = (idxs[p] + 1) % len(ds)
+                pending = retry
             else:
                 raise RuntimeError("no scene with enough points")
-            yield {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": flow}
+            yield out
 
     # -- threaded python path ------------------------------------------------
 

@@ -48,7 +48,7 @@ def _load():
         ctypes.c_char_p, ctypes.c_char_p,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         ctypes.c_long, ctypes.c_long, ctypes.c_long,
-        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
         np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
@@ -99,10 +99,12 @@ def load_scene_batch(
     seed: int,
     epoch: int,
     flip_xz: bool,
+    filter_mode: int = 0,
     n_threads: int = 4,
 ):
     """Threaded native batch assembly. Returns (pc1, pc2, mask, flow,
-    status) — status[i]: 1 ok, 0 too-few-points, <0 error."""
+    status) — status[i]: 1 ok, 0 too-few-points, <0 error. filter_mode:
+    0 none, 1 KITTI ground/depth row filter (kitti_hplflownet.py:81-87)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
@@ -117,6 +119,7 @@ def load_scene_batch(
         b"\0".join(p.encode() for p in pc1_paths) + b"\0",
         b"\0".join(p.encode() for p in pc2_paths) + b"\0",
         idx, n, n_points, max_rows, seed, epoch, int(flip_xz),
+        int(filter_mode),
         out_pc1, out_pc2, out_mask, out_flow, status, n_threads,
     )
     return out_pc1, out_pc2, out_mask, out_flow, status

@@ -187,14 +187,22 @@ long pvraft_npy_read_f32(const char* path, float* out, long capacity,
 //     (index-aligned gt, flyingthings3d_hplflownet.py:104-107);
 //   * mask is all ones (out_mask[i]).
 //
+// filter_mode selects an optional row filter applied to the index-aligned
+// clouds before subsampling:
+//   0 — none (FT3D);
+//   1 — KITTI eval filter (kitti_hplflownet.py:81-87): drop rows where both
+//       frames are ground (y < -1.4) or either frame is far (z >= 35 m).
+//       Requires pc1/pc2 row counts to match (they are index-aligned).
+//
 // Scenes whose clouds have fewer than n_points rows are reported in
 // status[i] = 0 (caller applies the reject-and-advance policy); success is
-// status[i] = 1, IO/parse errors are negative.
+// status[i] = 1, IO/parse errors are negative (-3: filter_mode=1 with
+// misaligned clouds).
 void pvraft_load_scene_batch(
     const char* pc1_paths, const char* pc2_paths, const long* scene_indices,
     long n_scenes, long n_points, long max_rows, uint64_t seed, uint64_t epoch,
-    int flip_xz, float* out_pc1, float* out_pc2, float* out_mask,
-    float* out_flow, int* status, long n_threads) {
+    int flip_xz, int filter_mode, float* out_pc1, float* out_pc2,
+    float* out_mask, float* out_flow, int* status, long n_threads) {
   std::vector<const char*> p1(n_scenes), p2(n_scenes);
   {
     const char* c1 = pc1_paths;
@@ -210,15 +218,35 @@ void pvraft_load_scene_batch(
   auto work = [&](long i) {
     std::vector<float> buf1(max_rows * 3), buf2(max_rows * 3);
     long cols = 0;
-    const long n1 = read_npy_f32(p1[i], buf1.data(), max_rows * 3, &cols);
+    long n1 = read_npy_f32(p1[i], buf1.data(), max_rows * 3, &cols);
     if (n1 < 0 || cols != 3) {
       status[i] = -1;
       return;
     }
-    const long n2 = read_npy_f32(p2[i], buf2.data(), max_rows * 3, &cols);
+    long n2 = read_npy_f32(p2[i], buf2.data(), max_rows * 3, &cols);
     if (n2 < 0 || cols != 3) {
       status[i] = -2;
       return;
+    }
+    if (filter_mode == 1) {
+      if (n1 != n2) {
+        status[i] = -3;
+        return;
+      }
+      long w = 0;
+      for (long r = 0; r < n1; ++r) {
+        const bool ground =
+            buf1[r * 3 + 1] < -1.4f && buf2[r * 3 + 1] < -1.4f;
+        const bool near =
+            buf1[r * 3 + 2] < 35.0f && buf2[r * 3 + 2] < 35.0f;
+        if (ground || !near) continue;
+        for (int c = 0; c < 3; ++c) {
+          buf1[w * 3 + c] = buf1[r * 3 + c];
+          buf2[w * 3 + c] = buf2[r * 3 + c];
+        }
+        ++w;
+      }
+      n1 = n2 = w;
     }
     if (n1 < n_points || n2 < n_points) {
       status[i] = 0;  // caller walks to the next scene

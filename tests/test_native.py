@@ -109,6 +109,104 @@ def test_determinism_across_calls(tmp_path):
     assert not np.array_equal(a[0], c[0])
 
 
+def test_kitti_filter_matches_python(tmp_path):
+    """filter_mode=1 must drop exactly the rows the python KITTI path drops
+    (ground in both frames, or far in either frame)."""
+    rng = np.random.default_rng(6)
+    n = 200
+    pc1 = rng.uniform(-5, 5, (n, 3)).astype(np.float32)
+    pc2 = (pc1 + 0.1).astype(np.float32)
+    # Plant ground rows (y < -1.4 both frames) and far rows (z >= 35).
+    pc1[:20, 1] = -2.0
+    pc2[:20, 1] = -2.0
+    pc1[20:30, 2] = 40.0
+    np.save(str(tmp_path / "pc1.npy"), pc1)
+    np.save(str(tmp_path / "pc2.npy"), pc2)
+
+    not_ground = ~np.logical_and(pc1[:, 1] < -1.4, pc2[:, 1] < -1.4)
+    keep1, keep2 = pc1[not_ground], pc2[not_ground]
+    near = np.logical_and(keep1[:, 2] < 35.0, keep2[:, 2] < 35.0)
+    keep1, keep2 = keep1[near], keep2[near]
+
+    n_pts = keep1.shape[0]  # ask for exactly the surviving rows
+    got1, got2, _, flow, status = native.load_scene_batch(
+        [str(tmp_path / "pc1.npy")], [str(tmp_path / "pc2.npy")], [0],
+        n_pts, 256, seed=0, epoch=0, flip_xz=False, filter_mode=1,
+    )
+    assert status.tolist() == [1]
+    want = {tuple(np.round(r, 5)) for r in keep1}
+    got = {tuple(np.round(r, 5)) for r in got1[0]}
+    assert got == want  # sampled every surviving row, none of the dropped
+    np.testing.assert_allclose(flow[0], 0.1, atol=1e-6)
+    # Asking for one more point than survives the filter must reject.
+    _, _, _, _, status = native.load_scene_batch(
+        [str(tmp_path / "pc1.npy")], [str(tmp_path / "pc2.npy")], [0],
+        n_pts + 1, 256, seed=0, epoch=0, flip_xz=False, filter_mode=1,
+    )
+    assert status.tolist() == [0]
+
+
+def test_native_loader_per_item_retry(tmp_path):
+    """A batch with one undersized scene keeps the good rows and re-requests
+    only the bad one (reject-and-advance, generic.py:101-110)."""
+    from pvraft_tpu.data import FT3D, PrefetchLoader
+
+    rng = np.random.default_rng(7)
+    # FT3D holds out scene 0 for val; the train list is scenes 1..4 with
+    # flow offsets 1, 2, 3, 4. Scene 2 is too small for 32 points.
+    sizes = [64, 64, 8, 64, 64]
+    for i, n in enumerate(sizes):
+        scene = tmp_path / "train" / f"{i:07d}"
+        scene.mkdir(parents=True)
+        pc1 = rng.normal(size=(n, 3)).astype(np.float32)
+        np.save(scene / "pc1.npy", pc1)
+        np.save(scene / "pc2.npy", pc1 + float(i))
+
+    ds = FT3D(str(tmp_path), nb_points=32, mode="train", strict_sizes=False)
+    assert len(ds) == 4
+    loader = PrefetchLoader(ds, 4, shuffle=False, num_workers=1, native=True)
+    assert loader.native
+    (batch,) = list(loader.epoch(0))
+    assert batch["pc1"].shape == (4, 32, 3)
+    # Batch row 1 (small scene 2) is replaced by the next dataset item
+    # (scene 3); the other rows keep their original scenes. The FT3D x/z
+    # sign flip turns a +i offset into flow (-i, i, -i).
+    def expect(i):
+        return np.broadcast_to(np.asarray([-i, i, -i], np.float32), (32, 3))
+
+    np.testing.assert_allclose(batch["flow"][0], expect(1), atol=1e-5)
+    np.testing.assert_allclose(batch["flow"][1], expect(3), atol=1e-5)
+    np.testing.assert_allclose(batch["flow"][2], expect(3), atol=1e-5)
+    np.testing.assert_allclose(batch["flow"][3], expect(4), atol=1e-5)
+
+
+def test_kitti_native_loader_end_to_end(tmp_path):
+    """KITTI eval through the native path: batches equal the python path's
+    content (same filter + sampler semantics)."""
+    from pvraft_tpu.data import KITTI, PrefetchLoader
+
+    rng = np.random.default_rng(8)
+    for i in range(200):
+        scene = tmp_path / f"{i:06d}"
+        scene.mkdir(parents=True)
+        n = 96
+        pc1 = rng.uniform(-5, 5, (n, 3)).astype(np.float32)
+        pc1[:, 2] = np.abs(pc1[:, 2])  # keep z near
+        pc2 = pc1 + 0.25
+        np.save(scene / "pc1.npy", pc1)
+        np.save(scene / "pc2.npy", pc2)
+
+    ds = KITTI(str(tmp_path), nb_points=48)
+    assert len(ds) == 142
+    loader = PrefetchLoader(ds, 1, num_workers=1, native=True)
+    assert loader.native
+    batches = list(loader.epoch(0))
+    assert len(batches) == 142
+    for b in batches[:5]:
+        assert b["pc1"].shape == (1, 48, 3)
+        np.testing.assert_allclose(b["flow"], 0.25, atol=1e-6)
+
+
 def test_ft3d_native_loader_end_to_end(tmp_path):
     from pvraft_tpu.data import FT3D, PrefetchLoader
 
