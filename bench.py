@@ -43,7 +43,10 @@ TRUNCATE_K = int(os.environ.get("PVRAFT_BENCH_K", 512))
 DEADLINE = time.monotonic() + float(os.environ.get("PVRAFT_BENCH_BUDGET_S", 2700))
 
 PROBE_TIMEOUT_S = float(os.environ.get("PVRAFT_BENCH_PROBE_TIMEOUT_S", 240))
-VARIANT_TIMEOUT_S = float(os.environ.get("PVRAFT_BENCH_VARIANT_TIMEOUT_S", 900))
+# First compile of the full model through the remote-compile tunnel has been
+# observed to take several minutes; killing a child mid-compile can wedge
+# the TPU claim, so variant children get a generous window.
+VARIANT_TIMEOUT_S = float(os.environ.get("PVRAFT_BENCH_VARIANT_TIMEOUT_S", 1200))
 
 VARIANTS = [
     ("bf16+pallas+approx", dict(compute_dtype="bfloat16", use_pallas=True,
@@ -64,8 +67,19 @@ def _unit() -> str:
 # ---------------------------------------------------------------- child ----
 
 
+def _maybe_pin_cpu() -> None:
+    """Child-side CPU pin. Must use the config API: the TPU plugin's
+    sitecustomize forces jax_platforms at interpreter start, so a
+    JAX_PLATFORMS env var set by the parent is silently overridden."""
+    if "--cpu" in sys.argv:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def _child_probe() -> None:
     """Initialize the backend and report the platform. Hangs die with us."""
+    _maybe_pin_cpu()
     import jax
 
     devices = jax.devices()
@@ -75,6 +89,7 @@ def _child_probe() -> None:
 
 def _child_variant(name: str) -> None:
     """Measure steady-state seconds/step for one variant; print one line."""
+    _maybe_pin_cpu()
     kwargs = dict(VARIANTS)[name]
 
     import numpy as np
@@ -97,11 +112,16 @@ def _child_variant(name: str) -> None:
     gt = pc2 - pc1
     mask = jnp.ones((BATCH, N_POINTS), jnp.float32)
 
-    params = model.init(jax.random.key(0), pc1[:, :256], pc2[:, :256], 2)
+    # Init on a small cloud (params are point-count independent) — but it
+    # must still hold >= truncate_k candidate points for corr_init.
+    n_init = min(N_POINTS, max(256, TRUNCATE_K))
+    params = model.init(jax.random.key(0), pc1[:, :n_init], pc2[:, :n_init], 2)
     tx = optax.adam(1e-3)
     opt_state = tx.init(params)
 
-    @jax.jit
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, pc1, pc2, mask, gt):
         def loss_fn(p):
             flows, _ = model.apply(p, pc1, pc2, ITERS)
@@ -117,7 +137,8 @@ def _child_variant(name: str) -> None:
     if not np.isfinite(float(loss)):
         raise FloatingPointError("non-finite loss")
 
-    n_steps = 10
+    # CPU fallback steps are minutes each at 8,192 points — keep it short.
+    n_steps = 10 if platform != "cpu" else 2
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt_state, loss = step(params, opt_state, pc1, pc2, mask, gt)
@@ -129,6 +150,7 @@ def _child_variant(name: str) -> None:
 def _child_eval(name: str) -> None:
     """Eval-protocol throughput: scenes/s at bs=1, 32 GRU iters
     (``test.py:92,120``) — the other half of the capability story."""
+    _maybe_pin_cpu()
     kwargs = dict(VARIANTS)[name]
 
     import numpy as np
@@ -151,7 +173,8 @@ def _child_eval(name: str) -> None:
     batch = {"pc1": pc1, "pc2": pc2, "mask": jnp.ones((1, N_POINTS), jnp.float32),
              "flow": pc2 - pc1}
 
-    params = model.init(jax.random.key(0), pc1[:, :256], pc2[:, :256], 2)
+    n_init = min(N_POINTS, max(256, TRUNCATE_K))
+    params = model.init(jax.random.key(0), pc1[:, :n_init], pc2[:, :n_init], 2)
     step = make_eval_step(model, eval_iters, 0.8)
 
     metrics, flow = step(params, batch)  # warmup/compile
@@ -172,7 +195,7 @@ def _spawn(child_args: list, timeout_s: float, cpu: bool = False):
     """Run a bench child; return its parsed JSON line or None on failure."""
     env = dict(os.environ)
     if cpu:
-        env["JAX_PLATFORMS"] = "cpu"
+        child_args = list(child_args) + ["--cpu"]  # config-API pin (see _maybe_pin_cpu)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), *child_args],

@@ -1,5 +1,12 @@
 import sys, os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if "--sp" in sys.argv:
+    # Must precede backend init: the seq-parallel leg wants an 8-device
+    # virtual CPU mesh.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 import time
 import numpy as np
 import jax
@@ -24,3 +31,44 @@ t0 = time.time()
 flows, _ = jax.jit(lambda p, a, b: model.apply(p, a, b, 2))(params, pc1, pc2)
 jax.block_until_ready(flows)
 print(f"16k fwd ok: {flows.shape} finite={bool(np.isfinite(np.asarray(flows)).all())} {time.time()-t0:.0f}s")
+
+if "--sp" in sys.argv:
+    # Sequence-parallel training step at 16k points: the ppermute-ring
+    # correlation (parallel/ring.py) over a 1x8 seq mesh — the multi-chip
+    # long-context path actually training, not just the op in isolation.
+    import dataclasses
+
+    import optax
+
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+
+    mesh = make_mesh(n_data=1, n_seq=8)
+    sp_cfg = dataclasses.replace(cfg, corr_chunk=None, seq_shard=True)
+    sp_model = PVRaft(sp_cfg, mesh=mesh)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def step(p, o, a, b, m, g):
+        def loss_fn(pp):
+            fl, _ = sp_model.apply(pp, a, b, 2)
+            return sequence_loss(fl, m, g, 0.8)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        up, o = tx.update(grads, o)
+        return optax.apply_updates(p, up), o, loss
+
+    pr = replicate(params, mesh)
+    opr = replicate(opt_state, mesh)
+    batch = shard_batch(
+        {"pc1": pc1, "pc2": pc2,
+         "mask": jnp.ones((1, n), jnp.float32), "gt": pc2 - pc1},
+        mesh, on_indivisible="replicate",
+    )
+    t0 = time.time()
+    _, _, loss = jax.jit(step)(
+        pr, opr, batch["pc1"], batch["pc2"], batch["mask"], batch["gt"]
+    )
+    jax.block_until_ready(loss)
+    print(f"16k seq-parallel train step ok: loss={float(loss):.4f} "
+          f"{time.time()-t0:.0f}s")

@@ -86,6 +86,9 @@ def config_from_args(a: argparse.Namespace) -> Config:
             remat=a.remat,
             approx_topk=a.approx_topk,
             graph_chunk=a.graph_chunk,
+            # A requested seq mesh axis routes the correlation init through
+            # the ppermute ring (parallel/ring.py).
+            seq_shard=a.seq_parallel > 1,
         ),
         data=DataConfig(
             dataset=a.dataset, root=a.root, max_points=a.max_points,
