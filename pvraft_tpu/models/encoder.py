@@ -3,6 +3,10 @@
 Equivalent of the reference ``FlotEncoder`` (``model/extractor.py:7-23``):
 one kNN graph per cloud, three stacked SetConvs widening 3 -> w -> 2w -> 4w
 (default w=32, output 128 channels).
+
+With a ``mesh`` attached (seq axis > 1), the kNN graph is built
+sequence-parallel via the ppermute ring (``parallel/ring.py``) instead of
+the dense (N, N) distance matrix.
 """
 
 from __future__ import annotations
@@ -10,6 +14,7 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from pvraft_tpu.models.layers import SetConv
@@ -21,10 +26,16 @@ class PointEncoder(nn.Module):
     graph_k: int = 32
     dtype: Optional[jnp.dtype] = None
     graph_chunk: Optional[int] = None
+    mesh: Optional[jax.sharding.Mesh] = None
 
     @nn.compact
     def __call__(self, pc: jnp.ndarray) -> Tuple[jnp.ndarray, Graph]:
-        graph = build_graph(pc, self.graph_k, chunk=self.graph_chunk)
+        if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
+            from pvraft_tpu.parallel.ring import seq_sharded_graph
+
+            graph = seq_sharded_graph(pc, self.graph_k, self.mesh)
+        else:
+            graph = build_graph(pc, self.graph_k, chunk=self.graph_chunk)
         x = SetConv(self.width, dtype=self.dtype, name="conv1")(pc, graph)
         x = SetConv(2 * self.width, dtype=self.dtype, name="conv2")(x, graph)
         x = SetConv(4 * self.width, dtype=self.dtype, name="conv3")(x, graph)

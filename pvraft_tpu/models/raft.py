@@ -109,9 +109,11 @@ class PVRaft(nn.Module):
     ) -> Tuple[jnp.ndarray, Graph]:
         cfg = self.cfg
         dtype = compute_dtype(cfg)
+        enc_mesh = self.mesh if cfg.seq_shard else None
         feat = PointEncoder(
             cfg.encoder_width, cfg.graph_k, dtype=dtype,
-            graph_chunk=cfg.graph_chunk, name="feature_extractor"
+            graph_chunk=cfg.graph_chunk, mesh=enc_mesh,
+            name="feature_extractor"
         )
         fmap1, graph1 = feat(xyz1)
         fmap2, _ = feat(xyz2)
@@ -120,7 +122,8 @@ class PVRaft(nn.Module):
 
         fct, graph_ctx = PointEncoder(
             cfg.encoder_width, cfg.graph_k, dtype=dtype,
-            graph_chunk=cfg.graph_chunk, name="context_extractor"
+            graph_chunk=cfg.graph_chunk, mesh=enc_mesh,
+            name="context_extractor"
         )(xyz1)
         net, inp = jnp.split(fct, [cfg.hidden_dim], axis=-1)
         net = jnp.tanh(net)

@@ -23,6 +23,82 @@ from jax import lax
 from pvraft_tpu.ops.corr import CorrState, merge_topk_xyz
 
 
+def ring_knn_indices(
+    query: jnp.ndarray,
+    db: jnp.ndarray,
+    k: int,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Global kNN indices via a ppermute ring — the sequence-parallel
+    equivalent of ``ops.geometry.knn_indices`` (dense (N, N) matrix at
+    ``model/flot/graph.py:53-57``; 1 GB fp32 at 16,384 points).
+
+    query: (B, Nq/P, 3) — this device's query rows (resident).
+    db: (B, Nd/P, 3) — this device's candidate chunk (circulates).
+    Returns (B, Nq/P, k) int32 indices into the GLOBAL db ordering,
+    nearest first (self included when query is db — ``graph.py:60``).
+    """
+    p = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, nq, _ = query.shape
+    chunk = db.shape[1]
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    q2 = jnp.sum(query * query, axis=-1, keepdims=True)      # (B, Nq, 1)
+
+    def body(i, state):
+        best_v, best_i, db_c = state
+        src = (me - i) % p          # shard this chunk originated from
+        p2 = jnp.sum(db_c * db_c, axis=-1)[:, None, :]       # (B, 1, chunk)
+        cross = jnp.einsum("bnc,bmc->bnm", query, db_c)
+        negd = -(q2 + p2 - 2.0 * cross)                      # (B, Nq, chunk)
+        gidx = jnp.broadcast_to(
+            (src * chunk + jnp.arange(chunk, dtype=jnp.int32))[None, None, :],
+            negd.shape,
+        )
+        cand_v = jnp.concatenate([best_v, negd], axis=-1)
+        cand_i = jnp.concatenate([best_i, gidx], axis=-1)
+        new_v, sel = lax.top_k(cand_v, k)
+        new_i = jnp.take_along_axis(cand_i, sel, axis=-1)
+        db_c = lax.ppermute(db_c, axis_name, perm)
+        return new_v, new_i, db_c
+
+    init = (
+        jnp.full((b, nq, k), -jnp.inf, query.dtype),
+        jnp.zeros((b, nq, k), jnp.int32),
+        db,
+    )
+    _, best_i, _ = lax.fori_loop(0, p, body, init)
+    return best_i
+
+
+def seq_sharded_graph(pc: jnp.ndarray, k: int, mesh) -> "Graph":
+    """kNN graph of a cloud with itself, computed sequence-parallel over
+    the mesh ``seq`` axis (``shard_map`` + :func:`ring_knn_indices`).
+    Returns the same global ``Graph`` as ``ops.geometry.build_graph``."""
+    from jax.sharding import PartitionSpec as P
+
+    from pvraft_tpu.ops.geometry import Graph, gather_neighbors
+
+    seq = mesh.shape["seq"]
+    n = pc.shape[1]
+    if n % seq:
+        raise ValueError(
+            f"seq_shard: the mesh seq axis ({seq}) must divide the point "
+            f"count ({n})"
+        )
+    n_data = mesh.shape.get("data", 1)
+    bspec = "data" if n_data > 1 and pc.shape[0] % n_data == 0 else None
+    idx = jax.shard_map(
+        lambda q, d: ring_knn_indices(q, d, k, "seq"),
+        mesh=mesh,
+        in_specs=(P(bspec, "seq", None), P(bspec, "seq", None)),
+        out_specs=P(bspec, "seq", None),
+        check_vma=False,
+    )(pc, pc)
+    nb = gather_neighbors(pc, idx)
+    return Graph(neighbors=idx, rel_pos=nb - pc[:, :, None, :])
+
+
 def ring_corr_init(
     fmap1: jnp.ndarray,
     fmap2: jnp.ndarray,

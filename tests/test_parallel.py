@@ -122,6 +122,26 @@ def test_dp_train_step_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4)
 
 
+def test_ring_knn_matches_dense():
+    """ring_knn_indices must reproduce the dense kNN graph (global
+    indices, nearest first, self included)."""
+    from pvraft_tpu.ops.geometry import knn_indices
+    from pvraft_tpu.parallel.ring import seq_sharded_graph
+    from pvraft_tpu.ops.geometry import build_graph
+
+    mesh = make_mesh(n_data=1, n_seq=8)
+    rng = np.random.default_rng(6)
+    pc = jnp.asarray(rng.uniform(-1, 1, (2, 64, 3)).astype(np.float32))
+    dense = build_graph(pc, 8)
+    ring = seq_sharded_graph(pc, 8, mesh)
+    np.testing.assert_array_equal(
+        np.asarray(ring.neighbors), np.asarray(dense.neighbors)
+    )
+    np.testing.assert_allclose(
+        np.asarray(ring.rel_pos), np.asarray(dense.rel_pos), atol=1e-6
+    )
+
+
 def test_seq_shard_model_matches_dense():
     """cfg.seq_shard routes the model's corr_init through the ppermute ring
     (VERDICT r1 item 6): a 1x8 seq mesh forward must match the dense
