@@ -103,7 +103,8 @@ def _child_variant(name: str) -> None:
     from pvraft_tpu.models import PVRaft
 
     platform = jax.devices()[0].platform
-    cfg = ModelConfig(truncate_k=TRUNCATE_K, **kwargs)
+    unroll = int(os.environ.get("PVRAFT_BENCH_UNROLL", 1))
+    cfg = ModelConfig(truncate_k=TRUNCATE_K, scan_unroll=unroll, **kwargs)
     model = PVRaft(cfg)
 
     rng = np.random.default_rng(0)

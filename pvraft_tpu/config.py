@@ -41,6 +41,9 @@ class ModelConfig:
     # lax.approx_max_k for the correlation truncation: much faster on TPU
     # (recall ~0.95 by default); exact sort-based top-k when False.
     approx_topk: bool = False
+    # Unroll factor of the GRU iteration scan (1 = rolled). Unrolling lets
+    # XLA fuse across iterations at the cost of compile time; tune on TPU.
+    scan_unroll: int = 1
     # Stream the kNN graph construction over point chunks (avoids the
     # (N, N) distance matrix; needed for 16k+ point clouds).
     graph_chunk: Optional[int] = None

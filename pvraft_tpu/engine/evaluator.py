@@ -51,8 +51,9 @@ class Evaluator:
         )
         sample = next(iter(self.loader.epoch(0)))
         b = {k: jnp.asarray(v) for k, v in sample.items()}
-        self.params = self.model.init(
-            jax.random.key(0), b["pc1"], b["pc2"], 2
+        self.params = replicate(
+            self.model.init(jax.random.key(0), b["pc1"], b["pc2"], 2),
+            self.mesh,
         )
         self.eval_step = make_eval_step(
             self.model, cfg.train.eval_iters, cfg.train.gamma, refine=refine

@@ -139,6 +139,7 @@ class PVRaft(nn.Module):
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=num_iters,
+            unroll=min(cfg.scan_unroll, num_iters),
         )
         carry = (net, xyz1, xyz1)
         _, flows = scan(cfg, name="update_iter")(carry, state, inp, graph_ctx)

@@ -40,6 +40,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--synthetic_size", type=int, default=16)
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                    help="force a jax platform (e.g. cpu for host debugging)")
+    p.add_argument("--seq_parallel", type=int, default=1,
+                   help="devices on the sequence mesh axis (ring correlation "
+                        "+ kNN for clouds too large for one chip)")
     return p.parse_args(argv)
 
 
@@ -53,6 +56,7 @@ def main(argv=None) -> None:
             corr_chunk=a.corr_chunk, graph_chunk=a.graph_chunk,
             approx_topk=a.approx_topk,
             compute_dtype="bfloat16" if a.bf16 else "float32",
+            seq_shard=a.seq_parallel > 1,
         ),
         data=DataConfig(dataset=a.dataset, root=a.root,
                         max_points=a.max_points, num_workers=a.num_workers,
@@ -68,8 +72,12 @@ def main(argv=None) -> None:
         jax.config.update("jax_platforms", a.platform)
 
     from pvraft_tpu.engine.evaluator import Evaluator
+    from pvraft_tpu.parallel.mesh import make_mesh
 
-    ev = Evaluator(cfg)
+    mesh = None
+    if a.seq_parallel > 1:
+        mesh = make_mesh(n_data=1, n_seq=a.seq_parallel)
+    ev = Evaluator(cfg, mesh=mesh)
     if a.weights:
         ev.load(a.weights)
     if a.torch_weights:

@@ -65,6 +65,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--approx_topk", action="store_true",
                    help="approximate correlation truncation (faster on TPU)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--scan_unroll", type=int, default=1,
+                   help="unroll factor of the GRU iteration scan")
     p.add_argument("--synthetic_size", type=int, default=64)
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                    help="force a jax platform (e.g. cpu for host debugging)")
@@ -86,6 +88,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
             remat=a.remat,
             approx_topk=a.approx_topk,
             graph_chunk=a.graph_chunk,
+            scan_unroll=a.scan_unroll,
             # A requested seq mesh axis routes the correlation init through
             # the ppermute ring (parallel/ring.py).
             seq_shard=a.seq_parallel > 1,
