@@ -166,6 +166,27 @@ def test_trainer_rejects_oversized_global_batch(tmp_path):
         Trainer(cfg, mesh=make_mesh(n_data=8))  # wants 16 > 4
 
 
+def test_trainer_seq_shard_end_to_end(tmp_path):
+    """Full Trainer epoch on a 2x2 (data x seq) mesh with the ring
+    correlation + ring kNN active inside the jitted train step."""
+    import dataclasses
+
+    from pvraft_tpu.engine.trainer import Trainer
+
+    cfg = _tiny_cfg(tmp_path)
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, seq_shard=True),
+        train=cfg.train.__class__(batch_size=1, num_epochs=1, iters=2,
+                                  eval_iters=2, checkpoint_interval=1),
+    )
+    tr = Trainer(cfg, mesh=make_mesh(n_data=2, n_seq=2))
+    assert tr.global_batch == 2
+    m = tr.training(0)
+    assert np.isfinite(m["loss"])
+    v = tr.val_test(0, "val")
+    assert np.isfinite(v["epe3d"])
+
+
 def test_evaluator_runs_and_dumps(tmp_path):
     from pvraft_tpu.engine.evaluator import Evaluator
 
