@@ -57,10 +57,11 @@ VARIANTS = [
 ]
 
 
-def _unit() -> str:
+def _unit(points: int = N_POINTS, iters: int = ITERS,
+          batch: int = BATCH) -> str:
     return (
-        f"point-pairs/s/chip ({N_POINTS} pts, {ITERS} iters, "
-        f"bs={BATCH}, fwd+bwd+adam)"
+        f"point-pairs/s/chip ({points} pts, {iters} iters, "
+        f"bs={batch}, fwd+bwd+adam)"
     )
 
 
@@ -145,7 +146,8 @@ def _child_variant(name: str) -> None:
         params, opt_state, loss = step(params, opt_state, pc1, pc2, mask, gt)
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / n_steps
-    print(json.dumps({"ok": True, "dt": dt, "platform": platform}))
+    print(json.dumps({"ok": True, "dt": dt, "platform": platform,
+                      "points": N_POINTS, "batch": BATCH, "iters": ITERS}))
 
 
 def _child_eval(name: str) -> None:
@@ -192,9 +194,12 @@ def _child_eval(name: str) -> None:
 # --------------------------------------------------------------- parent ----
 
 
-def _spawn(child_args: list, timeout_s: float, cpu: bool = False):
-    """Run a bench child; return its parsed JSON line or None on failure."""
+def _spawn(child_args: list, timeout_s: float, cpu: bool = False,
+           env_overrides: dict = None):
+    """Run a bench child; return (parsed JSON line or None, timed_out)."""
     env = dict(os.environ)
+    if env_overrides:
+        env.update(env_overrides)
     if cpu:
         child_args = list(child_args) + ["--cpu"]  # config-API pin (see _maybe_pin_cpu)
     try:
@@ -279,9 +284,17 @@ def main() -> None:
     #    (clearly labeled) number beats a zeroed benchmark.
     if use_cpu_fallback and best is None:
         notes.append("accelerator unreachable after retries; cpu fallback")
+        # A CPU step at the flagship config takes minutes; measure a smaller
+        # labeled config rather than timing out to a zero.
+        shrink = {
+            "PVRAFT_BENCH_POINTS": str(min(N_POINTS, 2048)),
+            "PVRAFT_BENCH_ITERS": str(min(ITERS, 4)),
+            "PVRAFT_BENCH_K": str(min(TRUNCATE_K, 256)),
+        }
         for name in ("bf16", "fp32"):
             budget = min(VARIANT_TIMEOUT_S, max(_remaining(), 60.0))
-            res, _ = _spawn(["--child-variant", name], budget, cpu=True)
+            res, _ = _spawn(["--child-variant", name], budget, cpu=True,
+                            env_overrides=shrink)
             if res is not None:
                 best = (name, res)
                 break
@@ -292,18 +305,31 @@ def main() -> None:
         return
 
     name, res = best
-    pairs_per_sec = BATCH * N_POINTS / res["dt"]
-    extra = {"variant": name, "platform": res.get("platform", "unknown")}
+    points = int(res.get("points", N_POINTS))
+    batch = int(res.get("batch", BATCH))
+    iters = int(res.get("iters", ITERS))
+    pairs_per_sec = batch * points / res["dt"]
+    extra = {"variant": name, "platform": res.get("platform", "unknown"),
+             "unit": _unit(points, iters, batch)}  # overrides the default
 
     # Secondary metric: eval-protocol throughput (bs=1, 32 iters).
     if _remaining() > 120:
+        on_cpu = res.get("platform") == "cpu"
         ev, _ = _spawn(
             ["--child-eval", name],
             min(VARIANT_TIMEOUT_S, _remaining()),
-            cpu=res.get("platform") == "cpu",
+            cpu=on_cpu,
+            # Match the (possibly shrunk) measured config on CPU.
+            env_overrides={
+                "PVRAFT_BENCH_POINTS": str(points),
+                "PVRAFT_BENCH_K": str(min(TRUNCATE_K, 256)),
+                "PVRAFT_BENCH_EVAL_ITERS": "8",
+            } if on_cpu else None,
         )
         if ev is not None:
             extra["eval_scenes_per_sec"] = round(1.0 / ev["dt"], 3)
+            if on_cpu:
+                extra["eval_detail"] = f"{points} pts, 8 iters (cpu-shrunk)"
         else:
             notes.append("eval:failed")
 
