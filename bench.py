@@ -171,24 +171,39 @@ def _child_eval(name: str) -> None:
     eval_iters = int(os.environ.get("PVRAFT_BENCH_EVAL_ITERS", 32))
 
     rng = np.random.default_rng(0)
-    pc1 = jnp.asarray(rng.uniform(-1, 1, (1, N_POINTS, 3)).astype(np.float32))
-    pc2 = jnp.asarray(rng.uniform(-1, 1, (1, N_POINTS, 3)).astype(np.float32))
-    batch = {"pc1": pc1, "pc2": pc2, "mask": jnp.ones((1, N_POINTS), jnp.float32),
-             "flow": pc2 - pc1}
+
+    def make_batch():
+        pc1 = jnp.asarray(
+            rng.uniform(-1, 1, (1, N_POINTS, 3)).astype(np.float32))
+        pc2 = jnp.asarray(
+            rng.uniform(-1, 1, (1, N_POINTS, 3)).astype(np.float32))
+        return {"pc1": pc1, "pc2": pc2,
+                "mask": jnp.ones((1, N_POINTS), jnp.float32),
+                "flow": pc2 - pc1}
+
+    # One DISTINCT batch per timed call: the axon remote executor memoizes
+    # executions with identical inputs (a repeat "runs" in ~0.1 ms no matter
+    # the program), so a same-batch loop times cache hits, not eval.
+    n_steps = 10
+    batches = [make_batch() for _ in range(n_steps + 1)]
 
     n_init = min(N_POINTS, max(256, TRUNCATE_K))
-    params = model.init(jax.random.key(0), pc1[:, :n_init], pc2[:, :n_init], 2)
+    pc1 = batches[0]["pc1"]
+    params = model.init(jax.random.key(0), pc1[:, :n_init],
+                        batches[0]["pc2"][:, :n_init], 2)
     step = make_eval_step(model, eval_iters, 0.8)
 
-    metrics, flow = step(params, batch)  # warmup/compile
+    metrics, flow = step(params, batches[0])  # warmup/compile
     jax.block_until_ready(flow)
-    n_steps = 10
+    if platform == "cpu":  # minutes/step at full config — keep it short
+        batches = batches[:3]
     t0 = time.perf_counter()
-    for _ in range(n_steps):
-        metrics, flow = step(params, batch)
+    for b in batches[1:]:
+        metrics, flow = step(params, b)
     jax.block_until_ready(flow)
-    dt = (time.perf_counter() - t0) / n_steps
-    print(json.dumps({"ok": True, "dt": dt, "platform": platform}))
+    dt = (time.perf_counter() - t0) / (len(batches) - 1)
+    print(json.dumps({"ok": True, "dt": dt, "platform": platform,
+                      "points": N_POINTS, "iters": eval_iters}))
 
 
 # --------------------------------------------------------------- parent ----
@@ -230,12 +245,19 @@ def _remaining() -> float:
     return DEADLINE - time.monotonic()
 
 
-def _emit(value: float, extra: dict) -> None:
+def _emit(value: float, extra: dict, comparable: bool = True) -> None:
+    """``comparable=False`` when the measured config is not the flagship
+    one (shrunk CPU fallback): a rate from half the GRU iters and a
+    quarter of the points must not be ratioed against the full-config
+    baseline — report 0.0 there rather than an inflated headline."""
     out = {
         "metric": "train_point_pairs_per_sec_per_chip",
         "value": round(value, 1),
         "unit": _unit(),
-        "vs_baseline": round(value / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
+        "vs_baseline": (
+            round(value / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3)
+            if comparable else 0.0
+        ),
     }
     out.update(extra)
     print(json.dumps(out))
@@ -309,8 +331,14 @@ def main() -> None:
     batch = int(res.get("batch", BATCH))
     iters = int(res.get("iters", ITERS))
     pairs_per_sec = batch * points / res["dt"]
+    comparable = (points, iters) == (N_POINTS, ITERS)
     extra = {"variant": name, "platform": res.get("platform", "unknown"),
              "unit": _unit(points, iters, batch)}  # overrides the default
+    if not comparable:
+        extra["baseline_note"] = (
+            "measured config differs from the baseline config; "
+            "vs_baseline not comparable"
+        )
 
     # Secondary metric: eval-protocol throughput (bs=1, 32 iters).
     if _remaining() > 120:
@@ -319,23 +347,27 @@ def main() -> None:
             ["--child-eval", name],
             min(VARIANT_TIMEOUT_S, _remaining()),
             cpu=on_cpu,
-            # Match the (possibly shrunk) measured config on CPU.
+            # CPU eval steps are minutes at full config — shrink hard.
             env_overrides={
-                "PVRAFT_BENCH_POINTS": str(points),
+                "PVRAFT_BENCH_POINTS": str(min(points, 2048)),
                 "PVRAFT_BENCH_K": str(min(TRUNCATE_K, 256)),
                 "PVRAFT_BENCH_EVAL_ITERS": "8",
             } if on_cpu else None,
         )
         if ev is not None:
             extra["eval_scenes_per_sec"] = round(1.0 / ev["dt"], 3)
-            if on_cpu:
-                extra["eval_detail"] = f"{points} pts, 8 iters (cpu-shrunk)"
+            ev_pts, ev_it = ev.get("points"), ev.get("iters")
+            if (ev_pts, ev_it) != (N_POINTS, 32):
+                extra["eval_detail"] = (
+                    f"{ev_pts} pts, {ev_it} iters (shrunk, not the "
+                    "reference eval protocol)"
+                )
         else:
             notes.append("eval:failed")
 
     if len(notes) > 1 or res.get("platform") == "cpu":
         extra["note"] = ",".join(notes)
-    _emit(pairs_per_sec, extra)
+    _emit(pairs_per_sec, extra, comparable=comparable)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Bisect the chained-train-step overhead on the axon remote-TPU platform.
+
+Observed (2026-07-29): the flagship train step measures ~5 ms/step when
+every call gets fresh host-fed inputs, but ~3.4 s/step when step outputs
+(params, opt_state) feed the next call — a ~700x dispatch artifact that
+does not reproduce with small chained programs (scripts/platform_probe.py).
+
+Four measurements of the SAME train-step program:
+  fresh      params/opt fed from host-resident buffers every call;
+  chain-loss only the scalar loss feeds back (serializes steps, no tree);
+  chain-pack params+opt_state flattened into ONE fused f32 buffer between
+             steps (ravel_pytree inside jit) — few, large chained outputs;
+  chain-full the real training loop (tree of ~300 chained leaves).
+
+If chain-pack is fast while chain-full is slow, a fused train-state buffer
+is a practical mitigation for training through the tunnel; if both are
+slow, the overhead is per-chained-execution and unavoidable here (and
+absent on a directly-attached TPU VM, where donation keeps buffers
+device-resident with none of this dispatch cost).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--points", type=int, default=8192)
+    p.add_argument("--k", type=int, default=512)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--cpu", action="store_true")
+    a = p.parse_args()
+
+    import jax
+    if a.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    from jax.flatten_util import ravel_pytree
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.models import PVRaft
+
+    cfg = ModelConfig(truncate_k=a.k, compute_dtype="bfloat16",
+                      use_pallas=True, approx_topk=True)
+    model = PVRaft(cfg)
+    print(f"backend={jax.default_backend()} pts={a.points} bs={a.batch} "
+          f"iters={a.iters}", flush=True)
+
+    rng = np.random.default_rng(0)
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (a.batch, a.points, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (a.batch, a.points, 3)).astype(np.float32))
+    gt = pc2 - pc1
+    mask = jnp.ones((a.batch, a.points), jnp.float32)
+    n0 = max(256, a.k)
+    params0 = model.init(jax.random.key(0), pc1[:, :n0], pc2[:, :n0], 2)
+    tx = optax.adam(1e-3)
+    opt0 = tx.init(params0)
+
+    def loss_fn(p, x, y):
+        flows, _ = model.apply(p, x, y, a.iters)
+        return sequence_loss(flows, mask, gt, 0.8)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    flat0, unravel = ravel_pytree((params0, opt0))
+
+    @jax.jit
+    def step_packed(flat, x, y):
+        params, opt_state = unravel(flat)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state)
+        new_flat, _ = ravel_pytree(
+            (optax.apply_updates(params, updates), opt_state)
+        )
+        return new_flat, loss
+
+    def timed(label, run):
+        t0 = time.perf_counter()
+        run()
+        dt = (time.perf_counter() - t0) / a.steps * 1e3
+        print(f"{label:11s} {dt:10.1f} ms/step", flush=True)
+
+    # fresh: same host-fed params every call, perturbed pc to defeat dedup.
+    out = step(params0, opt0, pc1, pc2)
+    jax.block_until_ready(out)
+
+    def run_fresh():
+        for i in range(a.steps):
+            out = step(params0, opt0, pc1 + np.float32((i + 1) * 1e-7), pc2)
+        jax.block_until_ready(out)
+
+    timed("fresh", run_fresh)
+
+    # chain-loss: scalar loss feeds forward into the next call's pc1.
+    def run_chain_loss():
+        loss = jnp.float32(0)
+        for _ in range(a.steps):
+            _, _, loss = step(params0, opt0, pc1 + loss * 1e-12, pc2)
+        jax.block_until_ready(loss)
+
+    run_chain_loss()  # warm the (pc1-dependent) cache path
+    timed("chain-loss", run_chain_loss)
+
+    # chain-pack: one fused buffer carries the whole train state.
+    flat, loss = step_packed(flat0, pc1, pc2)
+    jax.block_until_ready(loss)
+
+    def run_chain_pack():
+        f = flat
+        for _ in range(a.steps):
+            f, l = step_packed(f, pc1, pc2)
+        jax.block_until_ready(l)
+
+    timed("chain-pack", run_chain_pack)
+
+    # chain-full: the real loop.
+    def run_chain_full():
+        p, o = params0, opt0
+        for _ in range(a.steps):
+            p, o, l = step(p, o, pc1, pc2)
+        jax.block_until_ready(l)
+
+    timed("chain-full", run_chain_full)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,13 +23,32 @@ import numpy as np
 
 
 def timeit(fn, *args, iters=20):
-    import jax
+    """Steady-state ms/call with a fresh scalar perturbation per call.
 
-    out = fn(*args)
+    The perturbation matters: the axon remote-TPU executor memoizes
+    executions with identical input buffers (observed: a 4096^2 matmul
+    "re-runs" in 0.03 ms with the same input vs 0.41 ms with a fresh one),
+    so the classic same-input timing loop measures cache hits, not work.
+    Every float input gets ``+ i * 1e-7`` inside the jitted wrapper; the
+    scalar is a real argument, so each call is a distinct execution.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def perturb(eps, t):
+        if isinstance(t, jnp.ndarray) and jnp.issubdtype(t.dtype, jnp.floating):
+            return t + eps.astype(t.dtype)
+        return t
+
+    wrapped = jax.jit(
+        lambda eps, *a: fn(*jax.tree.map(lambda t: perturb(eps, t), a))
+    )
+
+    out = wrapped(jnp.float32(0), *args)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
+    for i in range(iters):
+        out = wrapped(jnp.float32((i + 1) * 1e-7), *args)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / iters * 1e3
 

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Timing-semantics probes for the accelerator platform.
+
+Benchmarking through a remote-TPU tunnel (the experimental ``axon``
+platform) has sharp edges that silently corrupt naive timing loops; this
+script measures them so benchmark idioms elsewhere in the repo
+(``bench.py``, ``scripts/kernel_bench.py``) stay honest. Measured on
+2026-07-29 (TPU v5 lite, single chip):
+
+  * same-input re-execution of a jitted fn returns in ~0.03 ms regardless
+    of program size — identical in-flight executions are deduplicated /
+    memoized, so the classic ``for _ in range(n): f(x)`` loop times cache
+    hits, not device work;
+  * fresh-input calls (a distinct scalar argument per call) time real
+    execution: a 4096^2 f32 matmul measures ~0.41 ms =~ bf16-pass peak;
+  * host<->device transfers ride the tunnel at single-digit MB/s
+    (32 MB: ~7.6 s H2D, ~2.9 s D2H) — keep buffers device-resident;
+  * chaining step outputs into the next step's inputs (a training loop)
+    adds a large per-step overhead for the full train step (~3.4 s/step at
+    the flagship config vs ~5 ms fresh-input) that does NOT reproduce with
+    simple op chains or many-leaf pytree chains (all <10 ms/step below) —
+    a tunnel artifact, not a property of the XLA program.
+
+Usage: python scripts/platform_probe.py [--cpu]
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+if "--cpu" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+
+def sync_time(thunk, iters):
+    out = thunk(0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = thunk(i + 1)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main() -> None:
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    rng = np.random.default_rng(0)
+    n = 4096
+    x = jnp.asarray(rng.normal(size=(n, n)).astype(np.float32))
+
+    f = jax.jit(lambda a: a @ a)
+    print(f"matmul same-input   {sync_time(lambda i: f(x), 10):8.3f} ms"
+          "   (dedup/memoization if << fresh)")
+
+    g = jax.jit(lambda a, s: (a + s) @ (a + s))
+    print(f"matmul fresh-input  {sync_time(lambda i: g(x, i * 1e-6), 10):8.3f} ms"
+          "   (honest device time)")
+
+    x_np = rng.normal(size=(8 * 1024 * 1024 // 4,)).astype(np.float32)  # 8 MB
+    t0 = time.perf_counter()
+    xd = jax.device_put(x_np)
+    jax.block_until_ready(xd)
+    print(f"H2D 8MB             {(time.perf_counter() - t0) * 1e3:8.1f} ms")
+    t0 = time.perf_counter()
+    _ = np.asarray(xd)
+    print(f"D2H 8MB             {(time.perf_counter() - t0) * 1e3:8.1f} ms")
+
+    # Chained single buffer through a trivial op: dispatch round-trip floor.
+    h = jax.jit(lambda a: a * 1.000001)
+    z = [xd]
+
+    def chained(i):
+        z[0] = h(z[0])
+        return z[0]
+
+    print(f"chained 8MB op      {sync_time(chained, 10):8.3f} ms")
+
+    # Chained many-leaf pytree (train-state shaped): per-leaf overhead.
+    tree = [jnp.asarray(rng.normal(size=(2048,)).astype(np.float32))
+            for _ in range(300)]
+    ft = jax.jit(lambda t: jax.tree.map(lambda a: a * 1.000001 + 1e-9, t))
+    box = [ft(tree)]
+
+    def chained_tree(i):
+        box[0] = ft(box[0])
+        return box[0]
+
+    print(f"chained 300-leaf    {sync_time(chained_tree, 5):8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Bisect where the flagship train step's wall-clock goes on real hardware.
+
+Times (fresh-input perturbation per call — see kernel_bench.timeit):
+  encoder fwd / full model fwd (8 iters) / fwd+loss+grad / full train step,
+for the bench variants.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+import numpy as np
+
+from kernel_bench import timeit as _timeit
+
+timeit = functools.partial(_timeit, iters=5)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--points", type=int, default=8192)
+    p.add_argument("--k", type=int, default=512)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--variant", default="bf16+pallas+approx")
+    p.add_argument("--cpu", action="store_true")
+    a = p.parse_args()
+
+    import jax
+    if a.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.models import PVRaft
+    from pvraft_tpu.models.encoder import PointEncoder
+    from pvraft_tpu.config import compute_dtype
+
+    VAR = {
+        "bf16+pallas+approx": dict(compute_dtype="bfloat16", use_pallas=True,
+                                   approx_topk=True),
+        "bf16+approx": dict(compute_dtype="bfloat16", approx_topk=True),
+        "bf16": dict(compute_dtype="bfloat16"),
+        "fp32": dict(),
+    }
+    cfg = ModelConfig(truncate_k=a.k, **VAR[a.variant])
+    model = PVRaft(cfg)
+    print(f"backend={jax.default_backend()} variant={a.variant} "
+          f"pts={a.points} bs={a.batch} iters={a.iters}")
+
+    rng = np.random.default_rng(0)
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (a.batch, a.points, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (a.batch, a.points, 3)).astype(np.float32))
+    gt = pc2 - pc1
+    mask = jnp.ones((a.batch, a.points), jnp.float32)
+
+    params = model.init(jax.random.key(0), pc1[:, :max(256, a.k)],
+                        pc2[:, :max(256, a.k)], 2)
+
+    enc = PointEncoder(cfg.encoder_width, cfg.graph_k,
+                       dtype=compute_dtype(cfg), graph_chunk=cfg.graph_chunk)
+    enc_params = enc.init(jax.random.key(1), pc1)
+    print(f"encoder fwd       {timeit(lambda p, x: enc.apply(p, x), enc_params, pc1):9.1f} ms")
+
+    print(f"model fwd         {timeit(lambda p, x, y: model.apply(p, x, y, a.iters)[0], params, pc1, pc2):9.1f} ms")
+
+    def grad_fn(p, x, y):
+        def loss_fn(pp):
+            flows, _ = model.apply(pp, x, y, a.iters)
+            return sequence_loss(flows, mask, gt, 0.8)
+        return jax.value_and_grad(loss_fn)(p)
+
+    print(f"fwd+bwd           {timeit(grad_fn, params, pc1, pc2):9.1f} ms")
+
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def train_step(p, o, x, y):
+        loss, grads = grad_fn(p, x, y)
+        updates, o = tx.update(grads, o)
+        return optax.apply_updates(p, updates), o, loss
+
+    print(f"train step        {timeit(train_step, params, opt_state, pc1, pc2):9.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
