@@ -1,0 +1,50 @@
+#!/bin/bash
+# Patient TPU work queue: wait for the axon claim to free (probe in
+# short-lived subprocesses that are allowed to fail), then run the queued
+# TPU jobs sequentially. Each job logs to artifacts/logs/.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p artifacts/logs
+
+probe() {
+    # A probe on a stale claim hangs for up to ~30 min before the server
+    # answers Unavailable. Killing hanging clients has been observed to
+    # PROLONG the wedge, so probes get a long leash (40 min backstop)
+    # and failures are followed by a quiet period, not a rapid retry.
+    timeout 2400 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1
+}
+
+echo "[tpu_batch] waiting for TPU claim..."
+for attempt in $(seq 1 8); do
+    p=$(probe)
+    if [ "$p" = "tpu" ]; then
+        echo "[tpu_batch] claim acquired on attempt $attempt"
+        break
+    fi
+    echo "[tpu_batch] attempt $attempt: backend=$p; quiet for 300s"
+    sleep 300
+done
+if [ "$p" != "tpu" ]; then
+    echo "[tpu_batch] TPU never became available; giving up"
+    exit 1
+fi
+
+failed=0
+run() {
+    name=$1; shift
+    echo "[tpu_batch] === $name: $* ==="
+    # A job can hang on a re-wedged claim (the failure mode this script
+    # works around) — bound it. NB the kill itself can wedge the claim
+    # further if it lands mid-compile; 90 min leaves compiles room.
+    timeout 5400 "$@" > "artifacts/logs/$name.log" 2>&1
+    rc=$?
+    echo "[tpu_batch] $name rc=$rc (tail below)"
+    tail -5 "artifacts/logs/$name.log"
+    [ "$rc" -ne 0 ] && failed=1
+}
+
+run chain_bisect   python scripts/chain_bisect.py
+run consistency    python scripts/tpu_consistency.py
+run kernel_bench   python scripts/kernel_bench.py --points 8192 --k 512
+echo "[tpu_batch] done failed=$failed"
+exit $failed

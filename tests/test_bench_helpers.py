@@ -1,0 +1,49 @@
+"""Unit tests for benchmark helpers: honest-timing wrapper and baseline
+comparability labeling (no accelerator required)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+sys.path.insert(0, REPO)
+
+
+def test_kernel_bench_timeit_runs_and_preserves_semantics():
+    """timeit perturbs float inputs per call but must still execute the
+    function (finite positive ms) and work for pytree args."""
+    from kernel_bench import timeit
+
+    import jax.numpy as jnp
+
+    def fn(state, idx):
+        corr, xyz = state
+        return jnp.sum(corr * corr) + jnp.sum(xyz) + idx.sum()
+
+    state = (jnp.ones((2, 8, 4)), jnp.zeros((2, 8, 4, 3)))
+    idx = jnp.zeros((2, 8), jnp.int32)   # int leaves must pass untouched
+    ms = timeit(fn, state, idx, iters=3)
+    assert np.isfinite(ms) and ms > 0
+
+
+def test_bench_emit_comparability():
+    """vs_baseline must be zeroed when the measured config is not the
+    flagship config (shrunk CPU fallback) instead of inflating."""
+    out = subprocess.run(
+        [sys.executable, "-c", (
+            "import bench; "
+            "bench._emit(1000.0, {'variant': 'x'}, comparable=False); "
+            "bench._emit(bench.BASELINE_PAIRS_PER_SEC_PER_CHIP, {}, "
+            "comparable=True)"
+        )],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    assert lines[0]["vs_baseline"] == 0.0
+    assert lines[0]["value"] == 1000.0
+    assert abs(lines[1]["vs_baseline"] - 1.0) < 1e-6
