@@ -70,6 +70,59 @@ def make_refine_train_step(
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
+def make_packed_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    gamma: float,
+    num_iters: int,
+    params,
+    opt_state,
+    donate: bool = True,
+):
+    """``make_train_step`` with the train state crossing the step boundary
+    as ONE flat buffer instead of a ~300-leaf pytree.
+
+    Motivation (hypothesis, decided by ``scripts/chain_bisect.py`` on
+    hardware): the remote-TPU tunnel shows a large per-step overhead when
+    the full train step's ~300-leaf output tree feeds the next call
+    (BENCHMARKS.md) — small-program chains don't reproduce it, so one
+    candidate cause is the chained executable/buffer bookkeeping, which
+    this step minimizes by carrying params+opt_state as a single array.
+    Cost: one concat/split pair per step (a few MB of on-device copies).
+    Numerics are identical to the unpacked step: ``ravel_pytree`` casts
+    the optax int32 step count through the promoted dtype and back
+    losslessly for any realistic step count (< 2^24).
+
+    Returns ``(step, flat0, unravel)``: ``step(flat, batch) ->
+    (new_flat, metrics)``, ``flat0`` the packed initial state, and
+    ``unravel(flat) -> (params, opt_state)`` for checkpointing.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    flat0, unravel = ravel_pytree((params, opt_state))
+
+    def step(flat, batch):
+        params, opt_state = unravel(flat)
+
+        def loss_fn(p):
+            flows, _ = model.apply(p, batch["pc1"], batch["pc2"], num_iters)
+            loss = sequence_loss(flows, batch["mask"], batch["flow"], gamma)
+            return loss, flows
+
+        (loss, flows), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        epe = epe_train(flows[-1], batch["mask"], batch["flow"])
+        new_flat, _ = ravel_pytree((params, opt_state))
+        return new_flat, {"loss": loss, "epe": epe}
+
+    return (
+        jax.jit(step, donate_argnums=(0,) if donate else ()),
+        flat0,
+        unravel,
+    )
+
+
 def make_eval_step(model, num_iters: int, gamma: float, refine: bool = False):
     """Eval step returning loss + the full metric set
     (``tools/engine.py:197-234``, ``test.py:117-126``)."""

@@ -21,8 +21,10 @@ for attempt in $(seq 1 8); do
         echo "[tpu_batch] claim acquired on attempt $attempt"
         break
     fi
-    echo "[tpu_batch] attempt $attempt: backend=$p; quiet for 300s"
-    sleep 300
+    if [ "$attempt" -lt 8 ]; then
+        echo "[tpu_batch] attempt $attempt: backend=$p; quiet for 300s"
+        sleep 300
+    fi
 done
 if [ "$p" != "tpu" ]; then
     echo "[tpu_batch] TPU never became available; giving up"
