@@ -139,14 +139,49 @@ def _child_variant(name: str) -> None:
     if not np.isfinite(float(loss)):
         raise FloatingPointError("non-finite loss")
 
+    def time_pytree(n):
+        nonlocal params, opt_state, loss
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, opt_state, loss = step(params, opt_state, pc1, pc2,
+                                           mask, gt)
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / n
+
     # CPU fallback steps are minutes each at 8,192 points — keep it short.
     n_steps = 10 if platform != "cpu" else 2
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        params, opt_state, loss = step(params, opt_state, pc1, pc2, mask, gt)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / n_steps
+    strategy = "pytree"
+    dt = time_pytree(2 if platform != "cpu" else n_steps)
+    if platform != "cpu" and dt > 0.5:
+        # Chained-dispatch overhead detected (device step time is single-
+        # digit ms at this config — BENCHMARKS.md): retime with the packed
+        # single-buffer train step, which carries params+opt_state as one
+        # flat array between steps (numerically identical; Trainer supports
+        # it via ParallelConfig.packed_state). Keep whichever loop is
+        # genuinely faster — both are real state-chained training loops.
+        from pvraft_tpu.engine.steps import make_packed_train_step
+
+        batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
+        pstep, flat, _ = make_packed_train_step(
+            model, tx, 0.8, ITERS, params, opt_state, donate=True
+        )
+        flat, m = pstep(flat, batch)  # warmup/compile
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            flat, m = pstep(flat, batch)
+        jax.block_until_ready(m["loss"])
+        dt_packed = (time.perf_counter() - t0) / n_steps
+        if dt_packed < dt:
+            strategy, dt = "packed", dt_packed
+        else:
+            # Keep sample counts consistent: the 2-step probe decided the
+            # strategy; the reported number gets the full n_steps.
+            dt = time_pytree(n_steps)
+    elif platform != "cpu":
+        dt = time_pytree(n_steps)
     print(json.dumps({"ok": True, "dt": dt, "platform": platform,
+                      "strategy": strategy,
                       "points": N_POINTS, "batch": BATCH, "iters": ITERS}))
 
 
@@ -334,6 +369,8 @@ def main() -> None:
     comparable = (points, iters) == (N_POINTS, ITERS)
     extra = {"variant": name, "platform": res.get("platform", "unknown"),
              "unit": _unit(points, iters, batch)}  # overrides the default
+    if res.get("strategy") and res["strategy"] != "pytree":
+        extra["step_strategy"] = res["strategy"]
     if not comparable:
         extra["baseline_note"] = (
             "measured config differs from the baseline config; "

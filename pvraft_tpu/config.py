@@ -110,6 +110,11 @@ class ParallelConfig:
     data_axis: int = -1            # -1: all devices on the data axis
     seq_axis: int = 1              # sequence-parallel shards of the N2 axis
     donate: bool = True
+    # Carry params+opt_state across the step boundary as ONE flat buffer
+    # (engine/steps.py:make_packed_train_step). Numerically identical to the
+    # pytree step (tests/test_packed_step.py); mitigates per-chained-leaf
+    # dispatch overhead on remote-dispatch platforms (BENCHMARKS.md).
+    packed_state: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

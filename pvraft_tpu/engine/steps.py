@@ -78,6 +78,7 @@ def make_packed_train_step(
     params,
     opt_state,
     donate: bool = True,
+    refine: bool = False,
 ):
     """``make_train_step`` with the train state crossing the step boundary
     as ONE flat buffer instead of a ~300-leaf pytree.
@@ -105,14 +106,17 @@ def make_packed_train_step(
         params, opt_state = unravel(flat)
 
         def loss_fn(p):
+            if refine:
+                flow = model.apply(p, batch["pc1"], batch["pc2"], num_iters)
+                return compute_loss(flow, batch["mask"], batch["flow"]), flow
             flows, _ = model.apply(p, batch["pc1"], batch["pc2"], num_iters)
             loss = sequence_loss(flows, batch["mask"], batch["flow"], gamma)
-            return loss, flows
+            return loss, flows[-1]
 
-        (loss, flows), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        (loss, last), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        epe = epe_train(flows[-1], batch["mask"], batch["flow"])
+        epe = epe_train(last, batch["mask"], batch["flow"])
         new_flat, _ = ravel_pytree((params, opt_state))
         return new_flat, {"loss": loss, "epe": epe}
 

@@ -43,6 +43,7 @@ from pvraft_tpu.engine.checkpoint import (
 from pvraft_tpu.engine.schedule import make_lr_schedule
 from pvraft_tpu.engine.steps import (
     make_eval_step,
+    make_packed_train_step,
     make_refine_train_step,
     make_train_step,
 )
@@ -166,8 +167,29 @@ class Trainer:
         self.eval_step = make_eval_step(
             self.model, self.eval_iters, cfg.train.gamma, refine=refine
         )
+        # Packed-state mode: the train loop carries one flat buffer instead
+        # of the ~300-leaf (params, opt_state) tree; unpacked back into
+        # self.params at epoch end so eval/checkpointing are unchanged.
+        # Tradeoff: flat + unpacked trees are both device-resident (~2x the
+        # train state; ~7 MB for the flagship model — dwarfed by
+        # activations, so not offloaded).
+        self.packed = cfg.parallel.packed_state
+        if self.packed:
+            self.packed_step, self.flat, self.unravel = make_packed_train_step(
+                self.model, tx, cfg.train.gamma, cfg.train.iters,
+                self.params, self.opt_state, donate=cfg.parallel.donate,
+                refine=refine,
+            )
 
         self.ckpt_dir = os.path.join(cfg.exp_path, "checkpoints")
+
+    def _repack(self) -> None:
+        """Refresh the packed train state after self.params/opt_state were
+        replaced outside the train loop (weight load / resume)."""
+        if self.packed:
+            from jax.flatten_util import ravel_pytree
+
+            self.flat, _ = ravel_pytree((self.params, self.opt_state))
 
     # -- checkpoint / resume -------------------------------------------------
 
@@ -186,6 +208,7 @@ class Trainer:
             # Keep the TB x-axis continuous across restarts (the optax
             # schedule itself continues from the restored optimizer count).
             self.step_count = self.begin_epoch * max(1, len(self.train_loader))
+        self._repack()
         self.log.info(f"loaded weights from {path} (epoch {epoch})")
 
     def load_stage1_weights(self, path: str) -> None:
@@ -196,6 +219,7 @@ class Trainer:
         s1, _, epoch = load_checkpoint(path, {"params": backbone_tmpl}, None)
         params["params"]["backbone"] = s1["params"]
         self.params = replicate(params, self.mesh)
+        self._repack()
         self.log.info(f"imported stage-1 weights from {path} (epoch {epoch})")
 
     # -- loops ---------------------------------------------------------------
@@ -215,13 +239,20 @@ class Trainer:
             last = None
             for batch in self.train_loader.epoch(epoch):
                 b = self._device_batch(batch)
-                self.params, self.opt_state, m = self.train_step(
-                    self.params, self.opt_state, b
-                )
+                if self.packed:
+                    self.flat, m = self.packed_step(self.flat, b)
+                else:
+                    self.params, self.opt_state, m = self.train_step(
+                        self.params, self.opt_state, b
+                    )
                 dev_metrics.append(m)
                 last = m
             if last is not None:
                 timer.stop(last["loss"])
+        if self.packed:
+            # Unpack once per epoch so eval and checkpointing see the
+            # trained state without per-step tree traffic.
+            self.params, self.opt_state = self.unravel(self.flat)
         n_steps = len(dev_metrics)
         losses = [float(m["loss"]) for m in dev_metrics]
         epes = [float(m["epe"]) for m in dev_metrics]

@@ -1,10 +1,13 @@
 #!/bin/bash
 # Patient TPU work queue: wait for the axon claim to free (probe in
 # short-lived subprocesses that are allowed to fail), then run the queued
-# TPU jobs sequentially. Each job logs to artifacts/logs/.
+# TPU jobs sequentially, re-probing between jobs. Each job logs to
+# artifacts/logs/. A job that fails on an Unavailable backend is retried
+# (up to TPU_JOB_RETRIES times, default 3) after the claim comes back.
 set -u
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p artifacts/logs
+RETRIES=${TPU_JOB_RETRIES:-3}
 
 probe() {
     # A probe on a stale claim hangs for up to ~30 min before the server
@@ -14,39 +17,61 @@ probe() {
     timeout 2400 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1
 }
 
-echo "[tpu_batch] waiting for TPU claim..."
-for attempt in $(seq 1 8); do
-    p=$(probe)
-    if [ "$p" = "tpu" ]; then
-        echo "[tpu_batch] claim acquired on attempt $attempt"
-        break
-    fi
-    if [ "$attempt" -lt 8 ]; then
-        echo "[tpu_batch] attempt $attempt: backend=$p; quiet for 300s"
-        sleep 300
-    fi
-done
-if [ "$p" != "tpu" ]; then
-    echo "[tpu_batch] TPU never became available; giving up"
-    exit 1
-fi
+wait_for_claim() {
+    for attempt in $(seq 1 8); do
+        p=$(probe)
+        if [ "$p" = "tpu" ]; then
+            echo "[tpu_batch] claim acquired on attempt $attempt"
+            return 0
+        fi
+        if [ "$attempt" -lt 8 ]; then
+            echo "[tpu_batch] attempt $attempt: backend=$p; quiet for 300s"
+            sleep 300
+        fi
+    done
+    return 1
+}
 
 failed=0
 run() {
     name=$1; shift
-    echo "[tpu_batch] === $name: $* ==="
-    # A job can hang on a re-wedged claim (the failure mode this script
-    # works around) — bound it. NB the kill itself can wedge the claim
-    # further if it lands mid-compile; 90 min leaves compiles room.
-    timeout 5400 "$@" > "artifacts/logs/$name.log" 2>&1
-    rc=$?
-    echo "[tpu_batch] $name rc=$rc (tail below)"
-    tail -5 "artifacts/logs/$name.log"
-    [ "$rc" -ne 0 ] && failed=1
+    for try in $(seq 1 "$RETRIES"); do
+        if ! wait_for_claim; then
+            # One exhausted claim wait ends the whole queue: every later
+            # job would repeat the same multi-hour probe cycle for nothing.
+            echo "[tpu_batch] TPU never became available; aborting queue"
+            failed=1
+            exit $failed
+        fi
+        log="artifacts/logs/$name.log"
+        [ "$try" -gt 1 ] && log="artifacts/logs/$name.try$try.log"
+        echo "[tpu_batch] === $name (try $try): $* ==="
+        # A job can hang on a re-wedged claim (the failure mode this script
+        # works around) — bound it. NB the kill itself can wedge the claim
+        # further if it lands mid-compile; 90 min leaves compiles room.
+        timeout 5400 "$@" > "$log" 2>&1
+        rc=$?
+        echo "[tpu_batch] $name rc=$rc (tail below)"
+        tail -5 "$log"
+        if [ "$rc" -eq 0 ]; then
+            return
+        fi
+        # Retry only backend-outage failures (Unavailable / wedged-claim
+        # timeout rc=124); anything else is deterministic — move on.
+        if [ "$rc" -ne 124 ] && ! grep -qi "UNAVAILABLE" "$log"; then
+            echo "[tpu_batch] $name: deterministic failure; not retrying"
+            break
+        fi
+        # Unavailable mid-job: quiet period before the next wait_for_claim.
+        sleep 120
+    done
+    failed=1
 }
 
 run chain_bisect   python scripts/chain_bisect.py
 run consistency    python scripts/tpu_consistency.py
 run kernel_bench   python scripts/kernel_bench.py --points 8192 --k 512
+run convergence    python scripts/convergence_record.py --out artifacts/convergence_tpu.json
+run bench          python bench.py
 echo "[tpu_batch] done failed=$failed"
 exit $failed

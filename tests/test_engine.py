@@ -227,3 +227,32 @@ def test_visual_render(tmp_path):
     np.save(scene / "flow.npy", rng.normal(size=(50, 3)).astype(np.float32))
     out = visual.render(str(scene), str(scene / "render.png"))
     assert os.path.exists(out) and os.path.getsize(out) > 1000
+
+
+def test_trainer_packed_state_matches_unpacked(tmp_path):
+    import dataclasses
+
+    from pvraft_tpu.config import ParallelConfig
+
+    cfg = _tiny_cfg(tmp_path / "a", epochs=1)
+    tr = _tiny_trainer(cfg)
+    m = tr.training(0)
+    v = tr.val_test(0, "val")
+
+    cfg_p = dataclasses.replace(
+        _tiny_cfg(tmp_path / "b", epochs=1),
+        parallel=ParallelConfig(packed_state=True),
+    )
+    tr_p = _tiny_trainer(cfg_p)
+    assert tr_p.packed
+    m_p = tr_p.training(0)
+    v_p = tr_p.val_test(0, "val")
+
+    # Same data order (seeded loader) + numerically identical step
+    # (tests/test_packed_step.py) => same epoch metrics and eval result.
+    assert m_p["loss"] == pytest.approx(m["loss"], rel=1e-5)
+    assert v_p["epe3d"] == pytest.approx(v["epe3d"], rel=1e-4)
+    # And the packed trainer resumes through the pack/unpack boundary.
+    last = os.path.join(cfg_p.exp_path, "checkpoints", "last_checkpoint.msgpack")
+    tr_p.load_weights(last, resume=True)
+    assert tr_p.begin_epoch == 1

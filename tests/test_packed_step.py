@@ -56,3 +56,46 @@ def test_packed_matches_pytree_step(setup):
     counts = [x for x in jax.tree.leaves(o_packed)
               if np.asarray(x).dtype == np.int32]
     assert counts and all(int(c) == 3 for c in counts)
+
+
+def test_packed_refine_matches_pytree_step():
+    """Stage-2: packed step through optax.masked state + compute_loss."""
+    from pvraft_tpu.engine.steps import make_refine_train_step
+    from pvraft_tpu.models import PVRaftRefine
+
+    cfg = ModelConfig(truncate_k=16, corr_knn=8, graph_k=4)
+    model = PVRaftRefine(cfg)
+    rng = np.random.default_rng(1)
+    n = 64
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+    batch = {"pc1": pc1, "pc2": pc2,
+             "mask": jnp.ones((1, n), jnp.float32), "flow": pc2 - pc1}
+    params = model.init(jax.random.key(0), pc1, pc2, 2)
+
+    def mark(path, _):
+        return not any(getattr(k, "key", None) == "backbone" for k in path)
+
+    tx = optax.masked(optax.adam(1e-3),
+                      jax.tree_util.tree_map_with_path(mark, params))
+    opt_state = tx.init(params)
+
+    ref_step = make_refine_train_step(model, tx, 2, donate=False)
+    p, o = params, opt_state
+    ref_losses = []
+    for _ in range(3):
+        p, o, m = ref_step(p, o, batch)
+        ref_losses.append(float(m["loss"]))
+
+    step, flat, unravel = make_packed_train_step(
+        model, tx, 0.8, 2, params, opt_state, donate=False, refine=True
+    )
+    packed_losses = []
+    for _ in range(3):
+        flat, m = step(flat, batch)
+        packed_losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(packed_losses, ref_losses, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(unravel(flat)[0]), jax.tree.leaves(p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

@@ -65,6 +65,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--approx_topk", action="store_true",
                    help="approximate correlation truncation (faster on TPU)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--packed_state", action="store_true",
+                   help="carry params+opt_state between steps as one flat "
+                        "buffer (fewer chained leaves; see BENCHMARKS.md)")
     p.add_argument("--scan_unroll", type=int, default=1,
                    help="unroll factor of the GRU iteration scan")
     p.add_argument("--synthetic_size", type=int, default=64)
@@ -104,7 +107,8 @@ def config_from_args(a: argparse.Namespace) -> Config:
             checkpoint_interval=a.checkpoint_interval, refine=a.refine,
             seed=a.seed, lr_schedule=a.lr_schedule, profile_dir=a.profile_dir,
         ),
-        parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel),
+        parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel,
+                                packed_state=a.packed_state),
         exp_path=a.exp_path,
     )
 
