@@ -44,8 +44,6 @@ def run_variant(name: str, kwargs: dict, steps: int, n_points: int,
 
     from pvraft_tpu.config import ModelConfig
     from pvraft_tpu.data import PrefetchLoader, SyntheticDataset
-    from pvraft_tpu.engine.loss import sequence_loss
-    from pvraft_tpu.engine.metrics import epe_train
     from pvraft_tpu.models import PVRaft
 
     cfg = ModelConfig(truncate_k=truncate_k, **kwargs)
@@ -63,16 +61,21 @@ def run_variant(name: str, kwargs: dict, steps: int, n_points: int,
     tx = optax.adam(1e-3)
     opt_state = tx.init(params)
 
-    @jax.jit
-    def train_step(params, opt_state, pc1, pc2, mask, gt):
-        def loss_fn(p):
-            flows, _ = model.apply(p, pc1, pc2, iters)
-            return sequence_loss(flows, mask, gt, 0.8), flows[-1]
+    # On accelerators the state crosses the step boundary as one flat
+    # buffer (numerically identical — tests/test_packed_step.py): chaining
+    # a ~300-leaf tree through the remote-dispatch tunnel costs seconds
+    # per step (BENCHMARKS.md), which would dominate this 200-step record.
+    packed = jax.devices()[0].platform != "cpu"
+    if packed:
+        from pvraft_tpu.engine.steps import make_packed_train_step
 
-        (loss, flow), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        updates, opt_state = tx.update(grads, opt_state)
-        epe = epe_train(flow, mask, gt)
-        return optax.apply_updates(params, updates), opt_state, loss, epe
+        train_step, flat, _ = make_packed_train_step(
+            model, tx, 0.8, iters, params, opt_state
+        )
+    else:
+        from pvraft_tpu.engine.steps import make_train_step
+
+        train_step = make_train_step(model, tx, 0.8, iters)
 
     traj = []
     step = 0
@@ -82,11 +85,13 @@ def run_variant(name: str, kwargs: dict, steps: int, n_points: int,
         for b in loader.epoch(epoch):
             if step >= steps:
                 break
-            params, opt_state, loss, epe = train_step(
-                params, opt_state,
-                jnp.asarray(b["pc1"]), jnp.asarray(b["pc2"]),
-                jnp.asarray(b["mask"]), jnp.asarray(b["flow"]),
-            )
+            batch = {k: jnp.asarray(b[k])
+                     for k in ("pc1", "pc2", "mask", "flow")}
+            if packed:
+                flat, m = train_step(flat, batch)
+            else:
+                params, opt_state, m = train_step(params, opt_state, batch)
+            loss, epe = m["loss"], m["epe"]
             if step % log_every == 0 or step == steps - 1:
                 traj.append(
                     {"step": step, "loss": round(float(loss), 4),
