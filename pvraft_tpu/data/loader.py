@@ -35,7 +35,12 @@ class PrefetchLoader:
         seed: int = 0,
         native: bool = False,
         native_max_rows: int = 400_000,
+        shard: tuple = (0, 1),
     ):
+        """``shard=(rank, world)`` gives this loader every ``world``-th
+        sample starting at ``rank`` (after the seeded shuffle, which is
+        identical across ranks): the multi-host split of an epoch, the role
+        torch's DistributedSampler plays. Default (0, 1) = all samples."""
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -43,6 +48,10 @@ class PrefetchLoader:
         self.num_workers = max(0, num_workers)
         self.prefetch = prefetch
         self.seed = seed
+        rank, world = shard
+        if not (0 <= rank < world):
+            raise ValueError(f"shard rank {rank} outside world {world}")
+        self.shard = (rank, world)
         self.native_max_rows = native_max_rows
         self.native = False
         if native and hasattr(dataset, "native_paths"):
@@ -54,7 +63,7 @@ class PrefetchLoader:
                 self.native = False
 
     def __len__(self) -> int:
-        n = len(self.dataset)
+        n = len(self.dataset) // self.shard[1]  # identical on every rank
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def epoch(self, epoch: int = 0) -> Iterator[Item]:
@@ -62,6 +71,13 @@ class PrefetchLoader:
         order = np.arange(len(self.dataset))
         if self.shuffle:
             np.random.default_rng((self.seed, epoch)).shuffle(order)
+        rank, world = self.shard
+        if world > 1:
+            # Truncate to a multiple of world BEFORE slicing so every rank
+            # sees the same batch count per epoch — ranks running different
+            # step counts would deadlock the collectives and desynchronize
+            # the LR schedule across hosts.
+            order = order[: (len(order) // world) * world][rank::world]
         starts = list(range(0, len(order), self.batch_size))
         if self.drop_last:
             starts = [s for s in starts if s + self.batch_size <= len(order)]

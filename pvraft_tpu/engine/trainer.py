@@ -98,9 +98,22 @@ class Trainer:
         # of the mesh data axis gets cfg.train.batch_size samples).
         n_data = self.mesh.shape["data"]
         self.global_batch = cfg.train.batch_size * n_data
+        # Multi-host: each process loads only the slice of the global batch
+        # its local devices consume (PrefetchLoader shard + the
+        # make_array_from_process_local_data path in parallel/mesh.py);
+        # val/test loaders stay unsharded — bs=1 eval replicates, which
+        # needs identical data on every process.
+        n_proc = jax.process_count()
+        if self.global_batch % max(1, n_proc) != 0:
+            raise ValueError(
+                f"global batch {self.global_batch} must be a multiple of "
+                f"the process count ({n_proc})"
+            )
+        self.local_batch = self.global_batch // max(1, n_proc)
         self.log.info(
             f"mesh {dict(self.mesh.shape)}: per-device batch "
             f"{cfg.train.batch_size} -> global batch {self.global_batch}"
+            + (f" ({self.local_batch}/process x {n_proc})" if n_proc > 1 else "")
         )
         if self.global_batch > len(self.train_ds):
             raise ValueError(
@@ -111,12 +124,13 @@ class Trainer:
             )
         self.train_loader = PrefetchLoader(
             self.train_ds,
-            self.global_batch,
+            self.local_batch,
             shuffle=True,
             drop_last=True,
             num_workers=cfg.data.num_workers,
             seed=cfg.train.seed,
             native=cfg.data.native_loader,
+            shard=(jax.process_index(), n_proc),
         )
         self.val_loader = PrefetchLoader(
             self.val_ds, 1, num_workers=min(2, cfg.data.num_workers)

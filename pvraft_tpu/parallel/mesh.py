@@ -74,21 +74,49 @@ def shard_batch(batch: Any, mesh: Mesh, on_indivisible: str = "warn") -> Any:
     n_data = mesh.shape[DATA_AXIS]
     sharded = batch_sharding(mesh)
     repl = replicated_sharding(mesh)
+    # Multi-host: each process holds only its local slice of the global
+    # batch (PrefetchLoader shard=(rank, world)); assemble the global array
+    # from the per-process data. device_put to a non-addressable sharding
+    # is not allowed, so this is the only correct multi-host path. The
+    # replicated case requires every process to feed identical data (the
+    # unsharded val/test loaders guarantee that).
+    n_proc = jax.process_count()
+    multiproc = n_proc > 1
+    if multiproc and n_data % n_proc != 0:
+        raise ValueError(
+            f"mesh data axis ({n_data}) must be a multiple of the process "
+            f"count ({n_proc}) for multi-host batch sharding"
+        )
+    local_data = n_data // n_proc if multiproc else n_data
 
     def put(x):
-        ok = getattr(x, "ndim", 0) >= 1 and x.shape[0] % n_data == 0
+        dim = x.shape[0] if getattr(x, "ndim", 0) >= 1 else 0
+        ok = dim >= 1 and dim % local_data == 0
         if not ok and n_data > 1:
             msg = (
                 f"batch leading axis {getattr(x, 'shape', ())} does not "
-                f"divide mesh data axis ({n_data}); replicating instead of "
+                f"divide the per-process share of the mesh data axis "
+                f"({local_data} of {n_data}); replicating instead of "
                 f"sharding — no batch parallelism"
             )
             if on_indivisible == "error":
                 raise ValueError(msg)
+            if multiproc and on_indivisible != "replicate":
+                # Replication assembles each process's (different, sharded-
+                # loader) rows into an array JAX believes is replicated —
+                # silent cross-host divergence. Only an explicit
+                # "replicate" (caller guarantees identical data on every
+                # process, e.g. the unsharded bs=1 eval loaders) is safe.
+                raise ValueError(msg + " (unsafe on multi-host: per-process "
+                                 "data would silently diverge)")
             if on_indivisible == "warn":
                 import warnings
 
                 warnings.warn(msg, stacklevel=3)
+        if multiproc:
+            return jax.make_array_from_process_local_data(
+                sharded if ok else repl, np.asarray(x)
+            )
         return jax.device_put(x, sharded if ok else repl)
 
     return jax.tree_util.tree_map(put, batch)
@@ -96,6 +124,10 @@ def shard_batch(batch: Any, mesh: Mesh, on_indivisible: str = "warn") -> Any:
 
 def device_batch(batch: Any, mesh: Mesh, on_indivisible: str = "warn") -> Any:
     """Host batch dict (numpy) -> device arrays with batch-axis sharding."""
+    if jax.process_count() > 1:
+        # make_array_from_process_local_data consumes host arrays directly;
+        # a jnp.asarray here would add a device round-trip per step.
+        return shard_batch(batch, mesh, on_indivisible)
     import jax.numpy as jnp
 
     return shard_batch(
@@ -104,5 +136,15 @@ def device_batch(batch: Any, mesh: Mesh, on_indivisible: str = "warn") -> Any:
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Replicate a pytree over the whole mesh. Multi-host processes each
+    contribute their (identical — same seed/checkpoint) local copy, since
+    ``device_put`` cannot target a non-addressable sharding."""
     sharding = replicated_sharding(mesh)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            tree,
+        )
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

@@ -132,3 +132,41 @@ def test_kitti_filters(tmp_path):
     pc1, pc2, mask, flow = ds.load_sequence(0)
     assert pc1.shape[0] == 64 - 8  # ground + far removed
     assert (pc1[:, 2] < 35).all()
+
+
+def test_loader_shard_disjoint_and_covering():
+    """shard=(rank, world) splits each (identically shuffled) epoch into
+    disjoint per-rank sample sets covering the dataset — the multi-host
+    epoch split (DistributedSampler's role)."""
+    from pvraft_tpu.data import PrefetchLoader, SyntheticDataset
+
+    ds = SyntheticDataset(size=12, nb_points=32, seed=0)
+    world = 3
+    seen = []
+    for rank in range(world):
+        loader = PrefetchLoader(ds, 2, shuffle=True, num_workers=0,
+                                seed=7, shard=(rank, world))
+        assert len(loader) == 2  # 4 local samples / batch 2
+        ids = []
+        for b in loader.epoch(0):
+            assert b["pc1"].shape == (2, 32, 3)
+            ids.append(b["pc1"][:, 0, :].copy())
+        seen.append(np.concatenate(ids))
+    flat = np.concatenate(seen)
+    # All 12 samples appear exactly once across ranks (rows unique).
+    assert flat.shape[0] == 12
+    assert len(np.unique(np.round(flat, 6), axis=0)) == 12
+
+    with pytest.raises(ValueError):
+        PrefetchLoader(ds, 2, shard=(3, 3))
+
+    # Uneven dataset: every rank still gets the SAME batch count (epoch
+    # truncated to a multiple of world) — unequal per-rank step counts
+    # would deadlock multi-host collectives.
+    ds13 = SyntheticDataset(size=13, nb_points=32, seed=0)
+    counts = []
+    for rank in range(world):
+        loader = PrefetchLoader(ds13, 2, shuffle=True, num_workers=0,
+                                seed=7, shard=(rank, world))
+        counts.append((len(loader), sum(1 for _ in loader.epoch(0))))
+    assert counts == [(2, 2)] * world
