@@ -317,16 +317,30 @@ def main() -> None:
     # 2. Measure variants, fastest-expected first; first success wins.
     #    A timed-out child (wedged claim / slow remote compile) earns one
     #    retry; a fast nonzero exit is deterministic — move on immediately.
+    # After the first accelerator attempt, keep enough budget in reserve
+    # that the CPU fallback (~5 min at the shrunk config incl. compile) can
+    # still run after a worst-case string of hanging children — a zeroed
+    # benchmark is the one outcome this structure exists to prevent. The
+    # first attempt is exempt: with a small total budget the full-config
+    # accelerator measurement is worth spending the reserve on.
+    FALLBACK_RESERVE_S = 600.0
+    reserve = 0.0
+
     best = None
     if not use_cpu_fallback:
         for name, _ in VARIANTS:
-            if _remaining() < 60:
+            if _remaining() < 60 + reserve:
                 notes.append("deadline")
                 break
             for attempt in range(2):
-                budget = min(VARIANT_TIMEOUT_S, max(_remaining(), 60.0))
+                budget = min(
+                    VARIANT_TIMEOUT_S,
+                    max(_remaining() - reserve, 60.0),
+                )
                 res, timed_out = _spawn(["--child-variant", name], budget)
-                if res is not None or not timed_out or _remaining() < 120:
+                reserve = FALLBACK_RESERVE_S
+                if (res is not None or not timed_out
+                        or _remaining() < 120 + reserve):
                     break
                 notes.append(f"{name}:timeout")
             if res is not None:
@@ -340,7 +354,12 @@ def main() -> None:
     # 3. Last resort: a real measurement on the CPU backend — an honest
     #    (clearly labeled) number beats a zeroed benchmark.
     if use_cpu_fallback and best is None:
-        notes.append("accelerator unreachable after retries; cpu fallback")
+        notes.append(
+            "accelerator unreachable after retries; cpu fallback"
+            if probe is None else
+            "budget exhausted before an accelerator variant completed; "
+            "cpu fallback"
+        )
         # A CPU step at the flagship config takes minutes; measure a smaller
         # labeled config rather than timing out to a zero.
         shrink = {
