@@ -21,3 +21,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def tiny_trainer_cfg(tmp_path, refine=False, epochs=1):
+    """Shared tiny synthetic Trainer config (4-sample dataset, 64 points)."""
+    from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+
+    return Config(
+        model=ModelConfig(truncate_k=16, corr_knn=8, graph_k=8),
+        data=DataConfig(dataset="synthetic", max_points=64, synthetic_size=4,
+                        num_workers=0),
+        train=TrainConfig(batch_size=2, num_epochs=epochs, iters=2,
+                          eval_iters=2, refine=refine, checkpoint_interval=1),
+        exp_path=str(tmp_path / "exp"),
+    )

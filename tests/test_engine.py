@@ -8,20 +8,14 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
 from pvraft_tpu.engine.schedule import make_lr_schedule
 from pvraft_tpu.parallel.mesh import make_mesh
 
 
 def _tiny_cfg(tmp_path, refine=False, epochs=1):
-    return Config(
-        model=ModelConfig(truncate_k=16, corr_knn=8, graph_k=8),
-        data=DataConfig(dataset="synthetic", max_points=64, synthetic_size=4,
-                        num_workers=0),
-        train=TrainConfig(batch_size=2, num_epochs=epochs, iters=2,
-                          eval_iters=2, refine=refine, checkpoint_interval=1),
-        exp_path=str(tmp_path / "exp"),
-    )
+    from conftest import tiny_trainer_cfg
+
+    return tiny_trainer_cfg(tmp_path, refine=refine, epochs=epochs)
 
 
 def _tiny_trainer(cfg):

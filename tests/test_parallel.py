@@ -266,3 +266,33 @@ def test_graft_entry_dryrun_small_counts():
 
     ge.dryrun_multichip(2)
     ge.dryrun_multichip(4)
+
+
+def test_multihost_validation_paths(monkeypatch):
+    """The multi-process guards in shard_batch: the mesh data axis must be
+    a multiple of the process count, and indivisible batches must
+    hard-error instead of assembling per-process-different data into a
+    'replicated' array. (Single-process simulation: only the validation
+    layer is reachable.)"""
+    mesh = make_mesh(n_data=8, n_seq=1)
+    batch = {"pc1": np.zeros((3, 16, 3), np.float32)}
+
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    with pytest.raises(ValueError, match="multiple of the process count"):
+        shard_batch(batch, mesh)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # local_data = 4; leading axis 3 is indivisible -> hard error even in
+    # the default "warn" mode when multi-process.
+    with pytest.raises(ValueError, match="diverge"):
+        shard_batch(batch, mesh, on_indivisible="warn")
+
+
+def test_trainer_rejects_indivisible_global_batch_per_process(monkeypatch, tmp_path):
+    from conftest import tiny_trainer_cfg
+    from pvraft_tpu.engine.trainer import Trainer
+
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    cfg = tiny_trainer_cfg(tmp_path)  # batch_size=2 -> global batch 2 on 1-device mesh
+    with pytest.raises(ValueError, match="multiple of .* process count"):
+        Trainer(cfg, mesh=make_mesh(n_data=1))
