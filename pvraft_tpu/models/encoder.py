@@ -29,13 +29,20 @@ class PointEncoder(nn.Module):
     mesh: Optional[jax.sharding.Mesh] = None
 
     @nn.compact
-    def __call__(self, pc: jnp.ndarray) -> Tuple[jnp.ndarray, Graph]:
-        if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
-            from pvraft_tpu.parallel.ring import seq_sharded_graph
+    def __call__(
+        self, pc: jnp.ndarray, graph: Optional[Graph] = None
+    ) -> Tuple[jnp.ndarray, Graph]:
+        """``graph`` short-circuits the kNN build — callers encoding the
+        same cloud twice (feature + context extractors on pc1,
+        ``RAFTSceneFlow.py:25,31``) share one graph instead of relying on
+        XLA CSE to deduplicate the two identical builds."""
+        if graph is None:
+            if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
+                from pvraft_tpu.parallel.ring import seq_sharded_graph
 
-            graph = seq_sharded_graph(pc, self.graph_k, self.mesh)
-        else:
-            graph = build_graph(pc, self.graph_k, chunk=self.graph_chunk)
+                graph = seq_sharded_graph(pc, self.graph_k, self.mesh)
+            else:
+                graph = build_graph(pc, self.graph_k, chunk=self.graph_chunk)
         x = SetConv(self.width, dtype=self.dtype, name="conv1")(pc, graph)
         x = SetConv(2 * self.width, dtype=self.dtype, name="conv2")(x, graph)
         x = SetConv(4 * self.width, dtype=self.dtype, name="conv3")(x, graph)

@@ -120,11 +120,14 @@ class PVRaft(nn.Module):
 
         state = self._corr_init(fmap1, fmap2, xyz2)
 
+        # The reference context encoder rebuilds pc1's 32-NN graph
+        # (extractor.py:18 via RAFTSceneFlow.py:31); the graph is a pure
+        # function of the cloud, so share the feature extractor's.
         fct, graph_ctx = PointEncoder(
             cfg.encoder_width, cfg.graph_k, dtype=dtype,
             graph_chunk=cfg.graph_chunk, mesh=enc_mesh,
             name="context_extractor"
-        )(xyz1)
+        )(xyz1, graph=graph1)
         net, inp = jnp.split(fct, [cfg.hidden_dim], axis=-1)
         net = jnp.tanh(net)
         inp = jax.nn.relu(inp)

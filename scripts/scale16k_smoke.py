@@ -10,13 +10,18 @@ if "--sp" in sys.argv:
 import time
 import numpy as np
 import jax
-jax.config.update("jax_platforms", "cpu")
+if "--sp" in sys.argv and "--tpu" in sys.argv:
+    sys.exit("--sp needs the 8-device virtual CPU mesh; drop --tpu")
+if "--tpu" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 from pvraft_tpu.config import ModelConfig
 from pvraft_tpu.models import PVRaft
 
 # The BASELINE.json scale-up config shape (16,384 points) with every
-# streaming option on; CPU, 2 GRU iters, forward only.
+# streaming option on; 2 GRU iters, forward only. Default CPU; --tpu runs
+# the same program on the real chip (single-chip long-context evidence —
+# the memory wall this path removes is reference model/corr.py:96-99).
 cfg = ModelConfig(truncate_k=512, corr_chunk=2048, graph_chunk=2048,
                   remat=True)
 model = PVRaft(cfg)
@@ -30,7 +35,8 @@ print(f"init {time.time()-t0:.0f}s", flush=True)
 t0 = time.time()
 flows, _ = jax.jit(lambda p, a, b: model.apply(p, a, b, 2))(params, pc1, pc2)
 jax.block_until_ready(flows)
-print(f"16k fwd ok: {flows.shape} finite={bool(np.isfinite(np.asarray(flows)).all())} {time.time()-t0:.0f}s")
+print(f"16k fwd ok ({jax.devices()[0].platform}): {flows.shape} "
+      f"finite={bool(np.isfinite(np.asarray(flows)).all())} {time.time()-t0:.0f}s")
 
 if "--sp" in sys.argv:
     # Sequence-parallel training step at 16k points: the ppermute-ring

@@ -72,6 +72,7 @@ run chain_bisect   python scripts/chain_bisect.py
 run consistency    python scripts/tpu_consistency.py
 run kernel_bench   python scripts/kernel_bench.py --points 8192 --k 512
 run convergence    python scripts/convergence_record.py --out artifacts/convergence_tpu.json
+run scale16k       python scripts/scale16k_smoke.py --tpu
 run bench          python bench.py
 echo "[tpu_batch] done failed=$failed"
 exit $failed
