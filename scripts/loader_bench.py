@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Host data-pipeline benchmark: C++ batch assembler vs threaded numpy.
+
+The reference hides IO behind 8 DataLoader worker processes
+(``tools/engine.py:43-48``); here the native tier
+(``pvraft_tpu/native/npy_loader.cc``) reads, filters, and subsamples
+scenes with a C++ thread pool into preallocated arrays. This script
+measures both paths on a generated on-disk FT3D-layout dataset and prints
+one JSON line — committed as ``artifacts/loader_bench.json``.
+
+Run anywhere (pure host-side; jax not involved).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_ft3d_tree(root: str, n_scenes: int, n_points: int, seed: int = 0):
+    """Scenes with jittered sizes >= n_points (exact-N subsampling path)."""
+    rng = np.random.default_rng(seed)
+    train = os.path.join(root, "train")
+    os.makedirs(train, exist_ok=True)
+    for i in range(n_scenes):
+        d = os.path.join(train, f"{i:07d}")
+        os.makedirs(d, exist_ok=True)
+        n = n_points + int(rng.integers(0, n_points // 4))
+        pc1 = rng.uniform(-10, 10, (n, 3)).astype(np.float32)
+        np.save(os.path.join(d, "pc1.npy"), pc1)
+        np.save(os.path.join(d, "pc2.npy"),
+                pc1 + rng.normal(0, 0.1, (n, 3)).astype(np.float32))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenes", type=int, default=64)
+    ap.add_argument("--points", type=int, default=8192)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--out", default="artifacts/loader_bench.json")
+    args = ap.parse_args()
+
+    from pvraft_tpu.data import FT3D, PrefetchLoader
+
+    root = tempfile.mkdtemp(prefix="loaderbench_")
+    try:
+        make_ft3d_tree(root, args.scenes, args.points)
+        ds = FT3D(root, args.points, "train", strict_sizes=False)
+
+        def run(native: bool) -> dict:
+            loader = PrefetchLoader(
+                ds, args.batch, shuffle=True, num_workers=args.workers,
+                seed=0, native=native,
+            )
+            if native and not loader.native:
+                return {"available": False}
+            # Warm the page cache so both paths measure assembly, not disk.
+            for _ in loader.epoch(0):
+                pass
+            t0 = time.perf_counter()
+            n_batches = 0
+            checksum = 0.0
+            for e in range(args.epochs):
+                for b in loader.epoch(e):
+                    n_batches += 1
+                    checksum += float(b["pc1"][0, 0, 0])
+            dt = time.perf_counter() - t0
+            return {
+                "available": True,
+                "batches_per_sec": round(n_batches / dt, 2),
+                "scenes_per_sec": round(n_batches * args.batch / dt, 2),
+                "n_batches": n_batches,
+                "checksum": round(checksum, 3),
+            }
+
+        res = {
+            "config": {"scenes": args.scenes, "points": args.points,
+                       "batch": args.batch, "workers": args.workers,
+                       "epochs": args.epochs},
+            "numpy_threaded": run(native=False),
+            "native_cpp": run(native=True),
+        }
+        nat, py = res["native_cpp"], res["numpy_threaded"]
+        if nat.get("available"):
+            res["native_speedup"] = round(
+                nat["scenes_per_sec"] / py["scenes_per_sec"], 2
+            )
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=1)
+        print(json.dumps(res))
+        return 0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
