@@ -8,7 +8,10 @@ oracles computed with the plain XLA ops:
 
   * ``voxel_bin_means_pallas`` (compiled) == ``voxel_bin_means`` (XLA);
   * ``fused_corr_lookup`` (compiled) == voxel + knn XLA pair;
-  * one full ``PVRaft`` forward, TPU vs host CPU backend.
+  * one full ``PVRaft`` forward, TPU vs host CPU backend;
+  * model gradients with the compiled Pallas path (custom VJPs) vs the
+    host XLA oracle — meaningful only on TPU (on CPU both sides are the
+    same program; the check is vacuously 0.0).
 
 Writes ``artifacts/tpu_consistency.json`` and exits nonzero on mismatch.
 Must be launched with the TPU backend (no JAX_PLATFORMS override).
@@ -103,6 +106,31 @@ def main() -> int:
     # 4 GRU iterations compound fp reorderings; 5e-3 on the flow is well
     # inside training noise while still catching a broken kernel.
     record["checks"]["model_forward"] = d < 5e-3
+
+    # 4. Gradients through the model, device (compiled Pallas path when on
+    # TPU — exercises the kernels' custom VJPs) vs the host XLA oracle.
+    import dataclasses
+
+    def make_loss(m):
+        def loss_fn(p, a, b):
+            fl, _ = m.apply(p, a, b, 4)
+            return jnp.mean(fl ** 2)
+
+        return loss_fn
+
+    grad_model = PVRaft(dataclasses.replace(cfg, use_pallas=platform != "cpu"))
+    g_dev = jax.jit(jax.grad(make_loss(grad_model)))(params, pc1, pc2)
+    with jax.default_device(cpu):
+        # `model` (XLA fallback) is the host oracle.
+        g_host = jax.jit(jax.grad(make_loss(model)))(
+            params_h, jax.device_put(pc1, cpu), jax.device_put(pc2, cpu)
+        )
+    diff_tree = jax.tree_util.tree_map(_max_diff, g_dev, g_host)  # raises on
+    d = max(jax.tree_util.tree_leaves(diff_tree))  # structure mismatch
+    record["max_diffs"]["model_grad"] = d
+    # Gradient elements at this config are O(1e-1); 1e-2 max-abs headroom
+    # absorbs reduction reorderings while catching a wrong VJP outright.
+    record["checks"]["model_grad"] = d < 1e-2
 
     record["ok"] = all(record["checks"].values())
     os.makedirs("artifacts", exist_ok=True)
