@@ -227,3 +227,91 @@ def import_torch_state_dict(state_dict: Dict[str, np.ndarray]) -> Dict[str, Any]
         else:
             raise ValueError(f"unknown top-level module {key}")
     return out
+
+
+# ---------------------------------------------------------------- export ----
+
+_SETCONV_KIND = {"fc1": "conv2d", "fc2": "conv1d", "fc3": "conv1d",
+                 "gn1": "gn", "gn2": "gn", "gn3": "gn"}
+
+
+def _to_torch_leaves(kind: str, leaves: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Invert :func:`_convert_tensor` for one torch module of known kind."""
+    out: Dict[str, np.ndarray] = {}
+    if kind in ("conv1d", "conv2d"):
+        k = np.asarray(leaves["kernel"]).T          # (in,out) -> (out,in)
+        out["weight"] = k[:, :, None] if kind == "conv1d" else k[:, :, None, None]
+        if "bias" in leaves:
+            out["bias"] = np.asarray(leaves["bias"])
+    elif kind == "linear":
+        out["weight"] = np.asarray(leaves["kernel"]).T
+        if "bias" in leaves:
+            out["bias"] = np.asarray(leaves["bias"])
+    elif kind == "gn":
+        out["weight"] = np.asarray(leaves["scale"])
+        out["bias"] = np.asarray(leaves["bias"])
+    elif kind == "prelu":
+        out["weight"] = np.asarray(leaves["alpha"]).reshape(-1)
+    else:
+        raise ValueError(f"unknown module kind {kind}")
+    return out
+
+
+def export_torch_state_dict(
+    tree: Dict[str, Any], refine: bool = False
+) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`import_torch_state_dict`: convert this framework's
+    param tree (the ``{"params": ...}`` inner dict) into a state dict the
+    reference models load with ``strict=True`` — train here, evaluate in
+    the reference (``model/RAFTSceneFlow.py`` / ``RAFTSceneFlowRefine.py``).
+    ``refine=True`` expects the ``PVRaftRefine`` layout (stage-1 modules
+    under ``backbone``, head at top level) and emits ``refine_block.*``.
+
+    Conv dimensionality per reference module: ``SetConv.fc1`` and
+    ``corr_block.knn_conv.0`` are Conv2d (``model/flot/gconv.py:26``,
+    ``model/corr.py:23``); every other conv is a 1x1 Conv1d.
+
+    NB the module mapping is intentionally written out a second time here
+    rather than shared with the importer's parser: the two directions are
+    kept honest by ``tests/test_reference_parity.py`` (strict=True load +
+    import(export(x)) == x), which fails on any one-sided drift.
+    """
+    sd: Dict[str, np.ndarray] = {}
+
+    def emit(prefix, kind, leaves):
+        for nm, v in _to_torch_leaves(kind, leaves).items():
+            sd[f"{prefix}.{nm}"] = v
+
+    def emit_setconv(prefix, node):
+        for sub, leaves in node.items():
+            emit(f"{prefix}.{sub}", _SETCONV_KIND[sub], leaves)
+
+    backbone = tree["backbone"] if refine else tree
+    for enc in ("feature_extractor", "context_extractor"):
+        for theirs, ours in _ENCODER_CONV.items():
+            emit_setconv(f"{enc}.{theirs}", backbone[enc][ours])
+    cl = backbone["update_iter"]["corr_lookup"]
+    emit("corr_block.out_conv.0", "conv1d", cl["out_conv1"])
+    emit("corr_block.out_conv.1", "gn", cl["out_gn"])
+    emit("corr_block.out_conv.2", "prelu", cl["out_prelu"])
+    emit("corr_block.out_conv.3", "conv1d", cl["out_conv2"])
+    emit("corr_block.knn_conv.0", "conv2d", cl["knn_conv"])
+    emit("corr_block.knn_conv.1", "gn", cl["knn_gn"])
+    emit("corr_block.knn_conv.2", "prelu", cl["knn_prelu"])
+    emit("corr_block.knn_out", "conv1d", cl["knn_out"])
+    ub = backbone["update_iter"]["update_block"]
+    for nm in ("conv_corr", "conv_flow", "conv"):
+        emit(f"update_block.motion_encoder.{nm}", "conv1d",
+             ub["motion_encoder"][nm])
+    for nm in ("convz", "convr", "convq"):
+        emit(f"update_block.gru.{nm}", "conv1d", ub["gru"][nm])
+    fh = ub["flow_head"]
+    emit("update_block.flow_head.conv1", "conv1d", fh["conv1"])
+    emit_setconv("update_block.flow_head.setconv", fh["setconv"])
+    emit("update_block.flow_head.out_conv.0", "conv1d", fh["out_conv1"])
+    emit("update_block.flow_head.out_conv.2", "conv1d", fh["out_conv2"])
+    if refine:
+        for theirs, ours in _REFINE_CONV.items():
+            emit_setconv(f"refine_block.{theirs}", tree[ours])
+        emit("refine_block.fc", "linear", tree["fc"])
+    return sd

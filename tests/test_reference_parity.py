@@ -54,6 +54,21 @@ def ref_rsf():
     return RSF
 
 
+
+def _cloud_pair(seed, n=256):
+    rng = np.random.default_rng(seed)
+    xyz1 = rng.uniform(-1, 1, (1, n, 3)).astype(np.float32)
+    # pc2 = pc1 + small flow keeps voxel bins off rounding boundaries.
+    xyz2 = (xyz1 + 0.05 * rng.normal(size=(1, n, 3))).astype(np.float32)
+    return xyz1, xyz2
+
+
+def _ref_args(truncate_k=64):
+    return types.SimpleNamespace(
+        corr_levels=3, base_scales=0.25, truncate_k=truncate_k
+    )
+
+
 def _make_models(ref_rsf, truncate_k=64, seed=0):
     import torch
 
@@ -184,3 +199,95 @@ def test_refine_flow_matches_reference(ref_rsf, tmp_path):
     ))
     assert j_flow.shape == t_flow.shape
     np.testing.assert_allclose(j_flow, t_flow, atol=2e-4, rtol=1e-3)
+
+
+def test_export_loads_into_reference_strict(ref_rsf):
+    """Inverse interop: params trained HERE load into the actual reference
+    RSF with strict=True and produce the same flows — train in this
+    framework, evaluate in the reference."""
+    import torch
+
+    import jax
+    import jax.numpy as jnp
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.checkpoint import export_torch_state_dict
+    from pvraft_tpu.models.raft import PVRaft
+
+    truncate_k = 64
+    jmodel = PVRaft(ModelConfig(truncate_k=truncate_k))
+    xyz1, xyz2 = _cloud_pair(21)
+    variables = jmodel.init(
+        jax.random.key(2), jnp.asarray(xyz1), jnp.asarray(xyz2), 2
+    )
+
+    sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+          for k, v in export_torch_state_dict(variables["params"]).items()}
+    tmodel = ref_rsf(_ref_args(truncate_k))
+    tmodel.load_state_dict(sd, strict=True)  # exact key+shape coverage
+    tmodel.eval()
+
+    j_flows, _ = jmodel.apply(
+        variables, jnp.asarray(xyz1), jnp.asarray(xyz2), num_iters=4
+    )
+    with torch.no_grad():
+        t_flows = tmodel([torch.from_numpy(xyz1), torch.from_numpy(xyz2)],
+                         num_iters=4)
+    t_flows = np.stack([f.numpy() for f in t_flows])
+    np.testing.assert_allclose(np.asarray(j_flows), t_flows,
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_export_refine_loads_into_reference_strict(ref_rsf, tmp_path):
+    """Stage-2 inverse interop, plus import(export(x)) == x round-trip."""
+    import torch
+
+    import jax
+    import jax.numpy as jnp
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.checkpoint import (
+        export_torch_state_dict,
+        load_torch_checkpoint,
+    )
+    from pvraft_tpu.models.raft import PVRaftRefine
+
+    from model.RAFTSceneFlowRefine import RSF_refine
+
+    truncate_k = 64
+    jmodel = PVRaftRefine(ModelConfig(truncate_k=truncate_k))
+    xyz1, xyz2 = _cloud_pair(31)
+    variables = jmodel.init(
+        jax.random.key(5), jnp.asarray(xyz1), jnp.asarray(xyz2), 2
+    )
+
+    sd_np = export_torch_state_dict(variables["params"], refine=True)
+    tmodel = RSF_refine(_ref_args(truncate_k))
+    tmodel.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v))
+         for k, v in sd_np.items()},
+        strict=True,
+    )
+    tmodel.eval()
+
+    j_flow = np.asarray(jmodel.apply(
+        variables, jnp.asarray(xyz1), jnp.asarray(xyz2), num_iters=4
+    ))
+    with torch.no_grad():
+        t_flow = tmodel([torch.from_numpy(xyz1), torch.from_numpy(xyz2)],
+                        num_iters=4).numpy()
+    np.testing.assert_allclose(j_flow, t_flow, atol=2e-4, rtol=1e-3)
+
+    # Round-trip: exporting then importing reproduces the exact tree.
+    path = str(tmp_path / "exported.params")
+    torch.save({"epoch": 0, "state_dict": tmodel.state_dict()}, path)
+    tree, _ = load_torch_checkpoint(path, refine=True)
+    for (ka, a), (kb, b) in zip(
+        sorted(jax.tree_util.tree_leaves_with_path(tree),
+               key=lambda kv: jax.tree_util.keystr(kv[0])),
+        sorted(jax.tree_util.tree_leaves_with_path(variables["params"]),
+               key=lambda kv: jax.tree_util.keystr(kv[0])),
+        strict=True,
+    ):
+        assert jax.tree_util.keystr(ka) == jax.tree_util.keystr(kb)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
