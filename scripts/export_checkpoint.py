@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Convert a pvraft-tpu checkpoint (.msgpack) into a reference-format
+torch ``.params`` file (``{'epoch', 'state_dict'}`` pickle,
+``tools/utils.py:14-17``) that ``/root/reference`` ``test.py`` loads
+directly — train here, evaluate in the original PyTorch code.
+
+    python scripts/export_checkpoint.py experiments/exp/checkpoints/best_checkpoint.msgpack \
+        out/best_checkpoint.params [--refine]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("src", help="pvraft-tpu .msgpack checkpoint")
+    ap.add_argument("dst", help="output torch .params path")
+    ap.add_argument("--refine", action="store_true",
+                    help="assert the source is a PVRaftRefine (stage-2) "
+                         "checkpoint (the layout is auto-detected; this "
+                         "flag just fails fast on a stage-1 tree)")
+    args = ap.parse_args()
+
+    import torch
+    from flax import serialization
+
+    from pvraft_tpu.engine.checkpoint import export_torch_state_dict
+
+    with open(args.src, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    tree = payload["params"]
+    if set(tree.keys()) == {"params"}:  # flax variables dict -> inner tree
+        tree = tree["params"]
+    # The two layouts are self-identifying: PVRaftRefine nests the stage-1
+    # modules under "backbone" (engine/checkpoint.py:107-109).
+    refine = args.refine or "backbone" in tree
+    if refine and "backbone" not in tree:
+        sys.exit("error: --refine given but the checkpoint has no 'backbone' "
+                 "subtree (this looks like a stage-1 PVRaft checkpoint)")
+    sd = export_torch_state_dict(tree, refine=refine)
+    state_dict = {k: torch.from_numpy(v.copy()) for k, v in sd.items()}
+    os.makedirs(os.path.dirname(args.dst) or ".", exist_ok=True)
+    torch.save({"epoch": int(payload.get("epoch", 0)),
+                "state_dict": state_dict}, args.dst)
+    print(f"wrote {args.dst} ({len(state_dict)} tensors, "
+          f"epoch {int(payload.get('epoch', 0))})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
