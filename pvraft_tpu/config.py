@@ -92,6 +92,9 @@ class TrainConfig:
     iters: int = 8                 # GRU iterations during training
     eval_iters: int = 32           # GRU iterations at val/test (engine.py:198)
     checkpoint_interval: int = 5
+    # "msgpack" (single atomic file) or "orbax" (async multi-host-aware
+    # directory checkpoints); loads auto-detect (engine/checkpoint.py).
+    ckpt_backend: str = "msgpack"
     refine: bool = False           # stage-2 (frozen backbone) training
     seed: int = 0
     # The reference steps CosineAnnealingLR(T_max=epochs*len(dataset)) once
@@ -101,6 +104,14 @@ class TrainConfig:
     # When set, epoch 0 runs under jax.profiler.trace writing a
     # TensorBoard-viewable profile here (SURVEY.md §5 tracing).
     profile_dir: str = ""
+
+    def __post_init__(self):
+        # Fail before training, not at the end-of-epoch save.
+        if self.ckpt_backend not in ("msgpack", "orbax"):
+            raise ValueError(
+                f"ckpt_backend must be 'msgpack' or 'orbax', "
+                f"got {self.ckpt_backend!r}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

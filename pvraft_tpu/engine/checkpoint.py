@@ -1,13 +1,25 @@
 """Checkpoint save/load.
 
-Replaces ``tools/utils.py:6-29`` with flax msgpack serialization (no torch
-pickle). Same three name classes: ``last_checkpoint``, ``{epoch:03d}`` every
-``checkpoint_interval`` epochs, and ``best_checkpoint`` on val improvement;
-payload carries ``epoch`` alongside the parameter/optimizer pytrees like the
-reference's ``{'epoch', 'state_dict'}`` dict.
+Replaces ``tools/utils.py:6-29`` with two backends behind one API. Same
+three name classes as the reference: ``last_checkpoint``, ``{epoch:03d}``
+every ``checkpoint_interval`` epochs, and ``best_checkpoint`` on val
+improvement; the payload carries ``epoch`` alongside the parameter/optimizer
+pytrees like the reference's ``{'epoch', 'state_dict'}`` dict.
 
-Also provides the torch->jax converter so reference-published checkpoints
-can be imported for parity testing (SURVEY.md §5 checkpoint notes).
+- ``msgpack`` (default): one flax-serialized file per checkpoint, atomic
+  via tmp+rename. Zero extra dependencies, best for single-host runs.
+- ``orbax``: one ``.orbax`` directory per checkpoint written by an
+  orbax ``AsyncCheckpointer`` — the array snapshot is taken synchronously
+  but persistence runs in a background thread, overlapping the next
+  training epoch; on multi-host meshes orbax coordinates the per-process
+  writes and commit barrier (SURVEY.md §5: "orbax checkpointing with
+  save-interval + auto-resume").
+
+Loads auto-detect the backend from the path (directory => orbax), so
+``--weights``/``--resume`` work unchanged whichever backend wrote the file.
+
+Also provides the torch<->jax converters so reference-published checkpoints
+can be imported (and ours exported) for parity testing (SURVEY.md §5).
 """
 
 from __future__ import annotations
@@ -20,6 +32,11 @@ import numpy as np
 from flax import serialization
 
 SUFFIX = ".msgpack"
+ORBAX_SUFFIX = ".orbax"
+
+_orbax_writer = None
+# (tmp_dir, final_dir, extra_final_dirs) owed once the async write commits.
+_orbax_pending: list = []
 
 
 def _write(path: str, payload: Dict[str, Any]) -> None:
@@ -29,6 +46,114 @@ def _write(path: str, payload: Dict[str, Any]) -> None:
     os.replace(tmp, path)
 
 
+def _orbax():
+    """Lazy singleton AsyncCheckpointer (spawns a persistence thread)."""
+    global _orbax_writer
+    if _orbax_writer is None:
+        import orbax.checkpoint as ocp
+
+        _orbax_writer = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _orbax_writer
+
+
+def _swap_in(tmp: str, dst: str) -> None:
+    """Replace directory ``dst`` with ``tmp`` without ever deleting the
+    only copy: old dst is renamed aside, tmp renamed in, then the old one
+    removed. A crash leaves either dst or dst+'.old' intact."""
+    import shutil
+
+    old = dst + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(dst):
+        os.replace(dst, old)
+    os.replace(tmp, dst)
+    if os.path.exists(old):
+        shutil.rmtree(old)
+
+
+def _orbax_promote() -> None:
+    """Swap committed tmp directories into their final names and copy them
+    to the extra name classes (NNN/best). Caller must have settled the
+    async writer first. Filesystem mutation is process-0-only: on a
+    multi-host mesh every process calls save (orbax saves are collective)
+    but only one may touch the shared directory names."""
+    import shutil
+
+    if jax.process_index() != 0:
+        _orbax_pending.clear()
+        return
+    while _orbax_pending:
+        tmp, dst, extras = _orbax_pending.pop(0)
+        if not os.path.exists(tmp):
+            continue  # already recovered by find_checkpoint
+        _swap_in(tmp, dst)
+        for extra in extras:
+            ctmp = extra + ".tmp"
+            if os.path.exists(ctmp):
+                shutil.rmtree(ctmp)
+            shutil.copytree(dst, ctmp)
+            _swap_in(ctmp, extra)
+
+
+def _sync_hosts(tag: str) -> None:
+    """Barrier so non-0 processes never observe mid-rename filesystem
+    states (promotion/recovery is process-0-only)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def wait_for_saves() -> None:
+    """Block until pending async (orbax) checkpoint writes are durable and
+    visible under their final names. No-op for the msgpack backend. Call
+    before process exit."""
+    if _orbax_writer is not None:
+        _orbax_writer.wait_until_finished()
+        _orbax_promote()
+        _sync_hosts("pvraft-ckpt-promote")
+
+
+def _recover_leftover_tmp(dst: str) -> None:
+    """Promote a committed-but-unpromoted tmp directory left by a run that
+    died before its deferred promote (orbax's own commit is an atomic
+    rename, so an existing ``.tmp`` directory is always a complete
+    checkpoint — and always newer than the promoted name next to it)."""
+    tmp = dst + ".tmp"
+    if os.path.isdir(tmp) and jax.process_index() == 0:
+        _swap_in(tmp, dst)
+    _sync_hosts("pvraft-ckpt-recover")
+
+
+def _orbax_write(path: str, payload: Dict[str, Any], extras=()) -> None:
+    import glob
+    import shutil
+
+    import orbax.checkpoint as ocp
+
+    # Never overwrite the live checkpoint in place: orbax's force=True
+    # deletes the destination at save() but only commits the replacement
+    # when the background write finishes — a crash in between would leave
+    # no checkpoint at all. Write to a tmp name and rename after commit
+    # (the previous epoch's write settles first; that wait is what makes
+    # the async overlap one-epoch deep rather than unbounded). The extra
+    # name classes (NNN/best) become host-side copies at promote time, so
+    # each epoch issues exactly one serialization pass.
+    _orbax().wait_until_finished()
+    _orbax_promote()
+    _recover_leftover_tmp(path)
+    tmp = path + ".tmp"
+    if jax.process_index() == 0:
+        # A kill mid-background-write leaves orbax's own uncommitted temp
+        # next to our target (tmp.orbax-checkpoint-tmp-*); clear them so
+        # crashed runs don't accumulate multi-MB orphans.
+        for orphan in glob.glob(tmp + ".orbax-checkpoint-tmp-*"):
+            shutil.rmtree(orphan, ignore_errors=True)
+    _orbax().save(os.path.abspath(tmp), args=ocp.args.StandardSave(payload))
+    _orbax_pending.append((tmp, path, list(extras)))
+
+
 def save_checkpoint(
     ckpt_dir: str,
     params: Any,
@@ -36,19 +161,59 @@ def save_checkpoint(
     epoch: int,
     checkpoint_interval: int = 5,
     best: bool = False,
+    backend: str = "msgpack",
 ) -> None:
     """Write last/NNN/best checkpoints (naming of ``tools/utils.py:7-17``)."""
+    if backend not in ("msgpack", "orbax"):
+        raise ValueError(f"unknown checkpoint backend {backend!r}")
     os.makedirs(ckpt_dir, exist_ok=True)
     payload = {
         "epoch": epoch,
         "params": jax.tree_util.tree_map(np.asarray, params),
         "opt_state": serialization.to_state_dict(opt_state),
     }
-    _write(os.path.join(ckpt_dir, "last_checkpoint" + SUFFIX), payload)
+    suffix = SUFFIX if backend == "msgpack" else ORBAX_SUFFIX
+    names = ["last_checkpoint"]
     if checkpoint_interval and (epoch + 1) % checkpoint_interval == 0:
-        _write(os.path.join(ckpt_dir, f"{epoch:03d}" + SUFFIX), payload)
+        names.append(f"{epoch:03d}")
     if best:
-        _write(os.path.join(ckpt_dir, "best_checkpoint" + SUFFIX), payload)
+        names.append("best_checkpoint")
+    paths = [os.path.join(ckpt_dir, n + suffix) for n in names]
+    if backend == "msgpack":
+        for p in paths:
+            _write(p, payload)
+    else:
+        # orbax StandardSave takes arrays (incl. 0-d), not numpy scalars.
+        # One serialization pass; extra names become copies at promote.
+        payload = dict(payload, epoch=np.asarray(epoch, np.int32))
+        _orbax_write(paths[0], payload, extras=paths[1:])
+
+
+def _load_orbax(path: str, params_template: Any,
+                opt_state_template: Any) -> Tuple[Any, Any, int]:
+    import orbax.checkpoint as ocp
+
+    if opt_state_template is None:
+        # Eval-only load: orbax restore templates must match the full saved
+        # structure, so restore untemplated and take what we need. (The
+        # extra optimizer-state read is noise at this model's ~1 MB scale.)
+        restored = _orbax().restore(os.path.abspath(path))
+    else:
+        tmpl = {
+            "epoch": np.asarray(0, np.int32),
+            "params": jax.tree_util.tree_map(np.asarray, params_template),
+            "opt_state": serialization.to_state_dict(opt_state_template),
+        }
+        restored = _orbax().restore(
+            os.path.abspath(path), args=ocp.args.StandardRestore(tmpl)
+        )
+    params = serialization.from_state_dict(params_template, restored["params"])
+    opt_state = None
+    if opt_state_template is not None:
+        opt_state = serialization.from_state_dict(
+            opt_state_template, restored["opt_state"]
+        )
+    return params, opt_state, int(restored["epoch"])
 
 
 def load_checkpoint(
@@ -57,7 +222,15 @@ def load_checkpoint(
     opt_state_template: Any = None,
 ) -> Tuple[Any, Any, int]:
     """Restore (params, opt_state, epoch). ``opt_state_template=None`` skips
-    optimizer state (the reference's eval-only load, ``test.py:101-106``)."""
+    optimizer state (the reference's eval-only load, ``test.py:101-106``).
+    The backend is detected from the path: orbax checkpoints are
+    ``.orbax`` directories, msgpack ones are files."""
+    # A pending async save may still own this very path — settle writes
+    # before looking at the filesystem (no-op without orbax).
+    wait_for_saves()
+    if path.endswith(ORBAX_SUFFIX) or os.path.isdir(path):
+        _recover_leftover_tmp(path)  # --weights on a crashed run's dir
+        return _load_orbax(path, params_template, opt_state_template)
     with open(path, "rb") as f:
         payload = serialization.msgpack_restore(f.read())
     params = serialization.from_state_dict(params_template, payload["params"])
@@ -69,9 +242,38 @@ def load_checkpoint(
     return params, opt_state, int(payload["epoch"])
 
 
+def load_payload(path: str) -> Dict[str, Any]:
+    """Template-free read of a checkpoint written by either backend:
+    ``{"epoch", "params", "opt_state"}`` with numpy leaves (``opt_state``
+    in flax state-dict form). Used by tooling that doesn't hold a model
+    (e.g. ``scripts/export_checkpoint.py``)."""
+    wait_for_saves()
+    if path.endswith(ORBAX_SUFFIX) or os.path.isdir(path):
+        _recover_leftover_tmp(path)
+        return dict(_orbax().restore(os.path.abspath(path)))
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def find_checkpoint(ckpt_dir: str, name: str) -> Optional[str]:
+    """Path of checkpoint ``name`` (e.g. ``best_checkpoint``) under either
+    backend's naming, newest first if both exist. Settles pending async
+    writes and adopts a committed tmp directory a previous run left
+    unpromoted, so resume never silently loses the newest checkpoint."""
+    wait_for_saves()
+    _recover_leftover_tmp(os.path.join(ckpt_dir, name + ORBAX_SUFFIX))
+    cands = [
+        p for p in (os.path.join(ckpt_dir, name + SUFFIX),
+                    os.path.join(ckpt_dir, name + ORBAX_SUFFIX))
+        if os.path.exists(p)
+    ]
+    if not cands:
+        return None
+    return max(cands, key=os.path.getmtime)
+
+
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
-    p = os.path.join(ckpt_dir, "last_checkpoint" + SUFFIX)
-    return p if os.path.exists(p) else None
+    return find_checkpoint(ckpt_dir, "last_checkpoint")
 
 
 # ---------------------------------------------------------------------------

@@ -35,10 +35,11 @@ import optax
 from pvraft_tpu.config import Config
 from pvraft_tpu.data import FT3D, KITTI, PrefetchLoader, SyntheticDataset
 from pvraft_tpu.engine.checkpoint import (
-    SUFFIX,
+    find_checkpoint,
     latest_checkpoint,
     load_checkpoint,
     save_checkpoint,
+    wait_for_saves,
 )
 from pvraft_tpu.engine.schedule import make_lr_schedule
 from pvraft_tpu.engine.steps import (
@@ -287,14 +288,15 @@ class Trainer:
             jax.tree_util.tree_map(np.asarray, self.opt_state),
             epoch,
             cfg.train.checkpoint_interval,
+            backend=cfg.train.ckpt_backend,
         )
         return {"loss": mean_loss, "epe": mean_epe, "step_ms": step_ms}
 
     def val_test(self, epoch: int, mode: str = "val") -> Dict[str, float]:
         loader = self.val_loader if mode == "val" else self.test_loader
         if mode == "test":
-            best = os.path.join(self.ckpt_dir, "best_checkpoint" + SUFFIX)
-            if os.path.exists(best):
+            best = find_checkpoint(self.ckpt_dir, "best_checkpoint")
+            if best is not None:
                 self.load_weights(best)  # engine.py:191
         # Metric sums stay on device across the whole loop — a float() per
         # batch would stall dispatch once per scene (3,824 times on FT3D
@@ -331,6 +333,7 @@ class Trainer:
                 epoch,
                 checkpoint_interval=0,
                 best=True,
+                backend=self.cfg.train.ckpt_backend,
             )
         return means
 
@@ -340,4 +343,6 @@ class Trainer:
         for epoch in range(self.begin_epoch, self.cfg.train.num_epochs):
             self.training(epoch)
             self.val_test(epoch, "val")
-        return self.val_test(self.cfg.train.num_epochs - 1, "test")
+        result = self.val_test(self.cfg.train.num_epochs - 1, "test")
+        wait_for_saves()  # async (orbax) writes must land before exit
+        return result

@@ -18,7 +18,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("src", help="pvraft-tpu .msgpack checkpoint")
+    ap.add_argument("src", help="pvraft-tpu checkpoint "
+                                "(.msgpack file or .orbax directory)")
     ap.add_argument("dst", help="output torch .params path")
     ap.add_argument("--refine", action="store_true",
                     help="assert the source is a PVRaftRefine (stage-2) "
@@ -26,13 +27,22 @@ def main() -> int:
                          "flag just fails fast on a stage-1 tree)")
     args = ap.parse_args()
 
+    # Offline conversion needs no accelerator, but the orbax restore path
+    # initializes a jax backend — pin CPU so the tool never claims (or
+    # hangs on) a TPU. The config API is required: jax may be pre-imported
+    # by the interpreter, making JAX_PLATFORMS too late.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
     import torch
-    from flax import serialization
 
-    from pvraft_tpu.engine.checkpoint import export_torch_state_dict
+    from pvraft_tpu.engine.checkpoint import (
+        export_torch_state_dict,
+        load_payload,
+    )
 
-    with open(args.src, "rb") as f:
-        payload = serialization.msgpack_restore(f.read())
+    payload = load_payload(args.src)  # msgpack file or .orbax directory
     tree = payload["params"]
     if set(tree.keys()) == {"params"}:  # flax variables dict -> inner tree
         tree = tree["params"]

@@ -1,0 +1,165 @@
+"""Orbax checkpoint backend: directory checkpoints with async persistence
+(SURVEY.md §5: "orbax checkpointing with save-interval + auto-resume").
+The msgpack backend keeps its own roundtrip test in test_engine.py; here we
+certify the orbax path and that loads auto-detect the backend from the path.
+"""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import optax
+
+from pvraft_tpu.engine.checkpoint import (
+    find_checkpoint,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+    wait_for_saves,
+)
+from pvraft_tpu.parallel.mesh import make_mesh
+
+
+def test_orbax_roundtrip(tmp_path):
+    params = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+              "b": {"c": np.ones(4, np.float32)}}
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    save_checkpoint(str(tmp_path), params, opt_state, epoch=4,
+                    checkpoint_interval=5, best=True, backend="orbax")
+    wait_for_saves()
+    for name in ("last_checkpoint.orbax", "004.orbax", "best_checkpoint.orbax"):
+        assert os.path.isdir(tmp_path / name), name
+
+    # load_checkpoint detects the orbax backend from the directory path.
+    tmpl = jax.tree_util.tree_map(np.zeros_like, params)
+    p2, o2, epoch = load_checkpoint(
+        str(tmp_path / "last_checkpoint.orbax"), tmpl, tx.init(tmpl)
+    )
+    assert epoch == 4
+    np.testing.assert_array_equal(p2["a"], params["a"])
+    np.testing.assert_array_equal(p2["b"]["c"], params["b"]["c"])
+    for a, b in zip(jax.tree_util.tree_leaves(o2),
+                    jax.tree_util.tree_leaves(opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # The name-class resolvers see orbax checkpoints too.
+    assert latest_checkpoint(str(tmp_path)).endswith("last_checkpoint.orbax")
+    assert find_checkpoint(str(tmp_path), "best_checkpoint").endswith(".orbax")
+
+
+def test_orbax_overwrites_last(tmp_path):
+    params = {"w": np.zeros(3, np.float32)}
+    tx = optax.sgd(1e-2)
+    for epoch in (0, 1):
+        save_checkpoint(str(tmp_path), {"w": np.full(3, float(epoch))},
+                        tx.init(params), epoch=epoch, checkpoint_interval=0,
+                        backend="orbax")
+    p, _, epoch = load_checkpoint(
+        str(tmp_path / "last_checkpoint.orbax"),
+        jax.tree_util.tree_map(np.zeros_like, params),
+    )
+    assert epoch == 1
+    np.testing.assert_array_equal(p["w"], np.full(3, 1.0))
+
+
+def test_unknown_backend_rejected(tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError, match="backend"):
+        save_checkpoint(str(tmp_path), {"w": np.zeros(1)}, None, epoch=0,
+                        backend="pickle")
+
+    # Config-level validation fails before any training happens.
+    from pvraft_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="ckpt_backend"):
+        TrainConfig(ckpt_backend="msgpck")
+
+
+def test_orbax_no_tmp_left_behind(tmp_path):
+    """The overwrite path goes tmp-dir -> committed rename: after
+    wait_for_saves the final name exists and no .tmp remains (the crash
+    window of an in-place force-overwrite is what this guards against)."""
+    params = {"w": np.zeros(3, np.float32)}
+    tx = optax.sgd(1e-2)
+    for epoch in (0, 1):
+        save_checkpoint(str(tmp_path), params, tx.init(params), epoch=epoch,
+                        checkpoint_interval=0, backend="orbax")
+    wait_for_saves()
+    names = set(os.listdir(tmp_path))
+    assert "last_checkpoint.orbax" in names
+    assert not any(n.endswith(".tmp") for n in names), names
+
+
+def test_orbax_recovers_committed_tmp(tmp_path):
+    """A run that dies after the async write commits but before the
+    deferred promote leaves last_checkpoint.orbax.tmp; the next process
+    must adopt it instead of resuming from the older epoch."""
+    import pvraft_tpu.engine.checkpoint as ck
+
+    params = {"w": np.zeros(2, np.float32)}
+    tx = optax.sgd(1e-2)
+    # Epoch 0: fully promoted.
+    save_checkpoint(str(tmp_path), {"w": np.zeros(2, np.float32)},
+                    tx.init(params), epoch=0, checkpoint_interval=0,
+                    backend="orbax")
+    wait_for_saves()
+    # Epoch 1: committed by the writer but never promoted (process died).
+    save_checkpoint(str(tmp_path), {"w": np.ones(2, np.float32)},
+                    tx.init(params), epoch=1, checkpoint_interval=0,
+                    backend="orbax")
+    ck._orbax().wait_until_finished()
+    ck._orbax_pending.clear()  # simulate death before promote
+    assert os.path.isdir(tmp_path / "last_checkpoint.orbax.tmp")
+
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found.endswith("last_checkpoint.orbax")
+    p, _, epoch = load_checkpoint(
+        found, jax.tree_util.tree_map(np.zeros_like, params))
+    assert epoch == 1  # the committed-but-unpromoted epoch was adopted
+    np.testing.assert_array_equal(p["w"], np.ones(2))
+    assert not os.path.exists(tmp_path / "last_checkpoint.orbax.tmp")
+
+
+def test_load_payload_both_backends(tmp_path):
+    from pvraft_tpu.engine.checkpoint import load_payload
+
+    params = {"w": np.arange(3, dtype=np.float32)}
+    tx = optax.sgd(1e-2)
+    for backend, name in [("msgpack", "last_checkpoint.msgpack"),
+                          ("orbax", "last_checkpoint.orbax")]:
+        d = tmp_path / backend
+        save_checkpoint(str(d), params, tx.init(params), epoch=7,
+                        checkpoint_interval=0, backend=backend)
+        payload = load_payload(str(d / name))
+        assert int(payload["epoch"]) == 7
+        np.testing.assert_array_equal(payload["params"]["w"], params["w"])
+
+
+def test_trainer_orbax_backend(tmp_path):
+    """Trainer trains, checkpoints, and resumes entirely through orbax."""
+    from conftest import tiny_trainer_cfg
+    from pvraft_tpu.engine.trainer import Trainer
+
+    cfg = tiny_trainer_cfg(tmp_path)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, ckpt_backend="orbax")
+    )
+    tr = Trainer(cfg, mesh=make_mesh(n_data=1))
+    tr.training(0)
+    tr.val_test(0, "val")
+    wait_for_saves()
+    ckpts = set(os.listdir(os.path.join(cfg.exp_path, "checkpoints")))
+    assert "last_checkpoint.orbax" in ckpts
+    assert "best_checkpoint.orbax" in ckpts
+    assert not any(c.endswith(".msgpack") for c in ckpts)
+
+    tr2 = Trainer(cfg, mesh=make_mesh(n_data=1))
+    last = latest_checkpoint(os.path.join(cfg.exp_path, "checkpoints"))
+    tr2.load_weights(last, resume=True)
+    assert tr2.begin_epoch == 1
+    for a, b in zip(jax.tree_util.tree_leaves(tr.params),
+                    jax.tree_util.tree_leaves(tr2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))

@@ -46,6 +46,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--stage1_weights", default=None,
                    help="stage-1 checkpoint to import when --refine")
     p.add_argument("--checkpoint_interval", type=int, default=5)
+    p.add_argument("--ckpt_backend", default="msgpack",
+                   choices=["msgpack", "orbax"],
+                   help="msgpack: one atomic file; orbax: async "
+                        "multi-host-aware directory checkpoints")
     p.add_argument("--refine", action="store_true")
     p.add_argument("--num_workers", type=int, default=8)
     p.add_argument("--no_strict_sizes", action="store_true",
@@ -105,6 +109,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
             batch_size=a.batch_size, num_epochs=a.num_epochs, lr=a.lr,
             gamma=a.gamma, iters=a.iters, eval_iters=a.eval_iters,
             checkpoint_interval=a.checkpoint_interval, refine=a.refine,
+            ckpt_backend=a.ckpt_backend,
             seed=a.seed, lr_schedule=a.lr_schedule, profile_dir=a.profile_dir,
         ),
         parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel,
