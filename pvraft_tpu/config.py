@@ -126,6 +126,9 @@ class ParallelConfig:
     # pytree step (tests/test_packed_step.py); mitigates per-chained-leaf
     # dispatch overhead on remote-dispatch platforms (BENCHMARKS.md).
     packed_state: bool = False
+    # Batches kept in flight to the device (data/loader.py:device_prefetch):
+    # H2D transfers overlap compute. 1 disables the pipeline.
+    device_prefetch: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

@@ -12,13 +12,33 @@ Host-side replacement for the reference's ``DataLoader(num_workers=8)``
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
-from typing import Iterator, Optional
+from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
 from pvraft_tpu.data.generic import Item, SceneFlowDataset, collate
+
+
+def device_prefetch(
+    batches: Iterable[Item], put: Callable[[Item], Item], depth: int = 2
+) -> Iterator[Item]:
+    """Keep ``depth`` batches in flight to the device.
+
+    ``jax.device_put``/``jnp.asarray`` only *enqueue* the host->device
+    copy, so issuing the next batch's transfer before the current step is
+    consumed overlaps H2D with compute — the role the reference's
+    ``pin_memory``/``non_blocking`` copies play (``datasets/generic.py:
+    54-66``). ``depth<=1`` degenerates to the unpipelined loop."""
+    buf: "collections.deque[Item]" = collections.deque()
+    for b in batches:
+        buf.append(put(b))
+        if len(buf) >= max(1, depth):
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
 
 
 class PrefetchLoader:

@@ -17,6 +17,7 @@ import numpy as np
 
 from pvraft_tpu.config import Config
 from pvraft_tpu.data import FT3D, KITTI, PrefetchLoader, SyntheticDataset
+from pvraft_tpu.data.loader import device_prefetch
 from pvraft_tpu.engine.checkpoint import load_checkpoint, load_torch_checkpoint
 from pvraft_tpu.engine.steps import make_eval_step
 from pvraft_tpu.models import PVRaft, PVRaftRefine
@@ -81,9 +82,15 @@ class Evaluator:
         # part of the protocol being raced.
         dev_sums = None
         count = 0
-        for idx, batch in enumerate(self.loader.epoch(0)):
-            # bs=1 protocol (test.py:92): replication is intended here.
-            b = device_batch(batch, self.mesh, on_indivisible="replicate")
+        for idx, (batch, b) in enumerate(device_prefetch(
+            self.loader.epoch(0),
+            # bs=1 protocol (test.py:92): replication is intended here; the
+            # host batch rides along for --dump_dir. Keeping a batch in
+            # flight overlaps its H2D copy with the previous scene's eval.
+            lambda batch: (batch, device_batch(
+                batch, self.mesh, on_indivisible="replicate")),
+            depth=self.cfg.parallel.device_prefetch,
+        )):
             metrics, flow = self.eval_step(self.params, b)
             dev_sums = metrics if dev_sums is None else jax.tree_util.tree_map(
                 jnp.add, dev_sums, metrics

@@ -34,6 +34,7 @@ import optax
 
 from pvraft_tpu.config import Config
 from pvraft_tpu.data import FT3D, KITTI, PrefetchLoader, SyntheticDataset
+from pvraft_tpu.data.loader import device_prefetch
 from pvraft_tpu.engine.checkpoint import (
     find_checkpoint,
     latest_checkpoint,
@@ -252,8 +253,10 @@ class Trainer:
         with trace_context(profile or None):
             timer.start()
             last = None
-            for batch in self.train_loader.epoch(epoch):
-                b = self._device_batch(batch)
+            for b in device_prefetch(
+                self.train_loader.epoch(epoch), self._device_batch,
+                depth=cfg.parallel.device_prefetch,
+            ):
                 if self.packed:
                     self.flat, m = self.packed_step(self.flat, b)
                 else:
@@ -303,9 +306,12 @@ class Trainer:
         # test); one device->host transfer per epoch instead.
         dev_sums = None
         count = 0
-        for batch in loader.epoch(0):
+        for b in device_prefetch(
+            loader.epoch(0),
             # bs=1 protocol (test.py:92): replication is intended here.
-            b = self._device_batch(batch, on_indivisible="replicate")
+            lambda batch: self._device_batch(batch, on_indivisible="replicate"),
+            depth=self.cfg.parallel.device_prefetch,
+        ):
             metrics, _ = self.eval_step(self.params, b)
             dev_sums = metrics if dev_sums is None else jax.tree_util.tree_map(
                 jnp.add, dev_sums, metrics

@@ -170,3 +170,28 @@ def test_loader_shard_disjoint_and_covering():
                                 seed=7, shard=(rank, world))
         counts.append((len(loader), sum(1 for _ in loader.epoch(0))))
     assert counts == [(2, 2)] * world
+
+
+def test_device_prefetch_order_and_pipelining():
+    """device_prefetch yields every item in order and issues the put for
+    the NEXT item before the current one is consumed (the H2D overlap)."""
+    from pvraft_tpu.data.loader import device_prefetch
+
+    put_log = []
+
+    def put(x):
+        put_log.append(x)
+        return x * 10
+
+    out = []
+    ahead = []
+    for y in device_prefetch(iter(range(6)), put, depth=2):
+        ahead.append(len(put_log) - len(out))
+        out.append(y)
+    assert out == [x * 10 for x in range(6)]
+    # While the stream is live the put side runs one batch ahead.
+    assert all(a >= 2 for a in ahead[:4]), ahead
+
+    # depth=1 degenerates to the unpipelined loop, still order-preserving.
+    assert list(device_prefetch(iter(range(4)), lambda x: x, depth=1)) == [0, 1, 2, 3]
+    assert list(device_prefetch(iter([]), lambda x: x)) == []

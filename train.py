@@ -72,6 +72,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--packed_state", action="store_true",
                    help="carry params+opt_state between steps as one flat "
                         "buffer (fewer chained leaves; see BENCHMARKS.md)")
+    p.add_argument("--device_prefetch", type=int, default=2,
+                   help="batches kept in flight to the device "
+                        "(H2D overlaps compute; 1 disables)")
     p.add_argument("--scan_unroll", type=int, default=1,
                    help="unroll factor of the GRU iteration scan")
     p.add_argument("--synthetic_size", type=int, default=64)
@@ -113,7 +116,8 @@ def config_from_args(a: argparse.Namespace) -> Config:
             seed=a.seed, lr_schedule=a.lr_schedule, profile_dir=a.profile_dir,
         ),
         parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel,
-                                packed_state=a.packed_state),
+                                packed_state=a.packed_state,
+                                device_prefetch=a.device_prefetch),
         exp_path=a.exp_path,
     )
 
