@@ -91,6 +91,12 @@ class TrainConfig:
     gamma: float = 0.8             # sequence-loss decay (tools/loss.py:9)
     iters: int = 8                 # GRU iterations during training
     eval_iters: int = 32           # GRU iterations at val/test (engine.py:198)
+    # Scenes evaluated concurrently by the standalone eval (test.py). The
+    # reference protocol is 1 (test.py:92); sharding eval_batch scenes over
+    # the mesh data axis computes per-scene metrics so the running means
+    # match the protocol's up to float reassociation (~1e-6, test-checked
+    # at rel 1e-5). 0 = one scene per data-axis device.
+    eval_batch: int = 1
     checkpoint_interval: int = 5
     # "msgpack" (single atomic file) or "orbax" (async multi-host-aware
     # directory checkpoints); loads auto-detect (engine/checkpoint.py).

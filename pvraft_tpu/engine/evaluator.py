@@ -43,21 +43,28 @@ class Evaluator:
         self.mesh = mesh if mesh is not None else make_mesh(n_seq=1)
         self.log = ExperimentLog(cfg.exp_path, "TestAlone", cfg.data.dataset)
         self.dataset = build_eval_dataset(cfg)
+        # eval_batch scenes run concurrently, sharded over the mesh data
+        # axis; 0 = one scene per data-axis device. Per-scene metrics keep
+        # the bs=1 protocol's running means exact (test.py:92,128-142).
+        eb = cfg.train.eval_batch
+        self.eval_batch = max(1, self.mesh.shape["data"] if eb <= 0 else eb)
         self.loader = PrefetchLoader(
-            self.dataset, 1, num_workers=min(2, cfg.data.num_workers)
+            self.dataset, self.eval_batch, drop_last=False,
+            num_workers=min(2, cfg.data.num_workers),
         )
         refine = cfg.train.refine
         self.model = (PVRaftRefine if refine else PVRaft)(
             cfg.model, mesh=self.mesh if cfg.model.seq_shard else None
         )
-        sample = next(iter(self.loader.epoch(0)))
-        b = {k: jnp.asarray(v) for k, v in sample.items()}
+        sample = self.dataset[0]
+        b = {k: jnp.asarray(v)[None] for k, v in sample.items()}
         self.params = replicate(
             self.model.init(jax.random.key(0), b["pc1"], b["pc2"], 2),
             self.mesh,
         )
         self.eval_step = make_eval_step(
-            self.model, cfg.train.eval_iters, cfg.train.gamma, refine=refine
+            self.model, cfg.train.eval_iters, cfg.train.gamma, refine=refine,
+            per_scene=True,
         )
 
     def load(self, path: str) -> None:
@@ -79,37 +86,56 @@ class Evaluator:
         # Metric sums accumulate on device; the host syncs only every
         # ``log_every`` scenes (the reference's tqdm-style running means,
         # test.py:128-142) instead of once per scene — eval wall-clock is
-        # part of the protocol being raced.
+        # part of the protocol being raced. Each eval step returns per-
+        # scene values, so batching/sharding scenes over the mesh leaves
+        # the running means identical to the reference's bs=1 loop.
         dev_sums = None
         count = 0
-        for idx, (batch, b) in enumerate(device_prefetch(
+        n_scenes = len(self.dataset)
+        for batch, b in device_prefetch(
             self.loader.epoch(0),
-            # bs=1 protocol (test.py:92): replication is intended here; the
-            # host batch rides along for --dump_dir. Keeping a batch in
-            # flight overlaps its H2D copy with the previous scene's eval.
+            # A tail batch smaller than the data axis replicates — per-
+            # scene metrics make that exact, just not parallel. The host
+            # batch rides along for --dump_dir; keeping one in flight
+            # overlaps its H2D copy with the previous batch's eval.
             lambda batch: (batch, device_batch(
                 batch, self.mesh, on_indivisible="replicate")),
             depth=self.cfg.parallel.device_prefetch,
-        )):
+        ):
             metrics, flow = self.eval_step(self.params, b)
-            dev_sums = metrics if dev_sums is None else jax.tree_util.tree_map(
-                jnp.add, dev_sums, metrics
+            bsize = batch["pc1"].shape[0]
+            # mean*bsize rather than sum: on multi-host the unsharded eval
+            # loader contributes the same scenes from every process, so the
+            # global batch axis can hold each scene process_count times —
+            # the mean over it is duplication-invariant, a raw sum is not.
+            summed = jax.tree_util.tree_map(
+                lambda v: jnp.mean(v, axis=0) * bsize, metrics
             )
-            count += 1
-            if log_every and count % log_every == 0:
+            dev_sums = summed if dev_sums is None else jax.tree_util.tree_map(
+                jnp.add, dev_sums, summed
+            )
+            if dump_dir is not None:
+                flow_host = np.asarray(flow)
+                for row in range(bsize):
+                    scene = os.path.join(
+                        dump_dir, self.cfg.data.dataset, str(count + row)
+                    )
+                    os.makedirs(scene, exist_ok=True)
+                    np.save(os.path.join(scene, "pc1.npy"), batch["pc1"][row])
+                    np.save(os.path.join(scene, "pc2.npy"), batch["pc2"][row])
+                    np.save(os.path.join(scene, "flow.npy"), flow_host[row])
+            crossed = (
+                log_every and count // log_every != (count + bsize) // log_every
+            )
+            count += bsize
+            if crossed:
                 self.log.info(
-                    f"[{count}/{len(self.loader)}] "
+                    f"[{count}/{n_scenes}] "
                     + " ".join(
                         f"{k}={float(v) / count:.4f}"
                         for k, v in sorted(dev_sums.items())
                     )
                 )
-            if dump_dir is not None:
-                scene = os.path.join(dump_dir, self.cfg.data.dataset, str(idx))
-                os.makedirs(scene, exist_ok=True)
-                np.save(os.path.join(scene, "pc1.npy"), batch["pc1"][0])
-                np.save(os.path.join(scene, "pc2.npy"), batch["pc2"][0])
-                np.save(os.path.join(scene, "flow.npy"), np.asarray(flow)[0])
         means = {
             k: float(v) / max(1, count) for k, v in (dev_sums or {}).items()
         }

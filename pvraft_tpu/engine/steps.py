@@ -127,20 +127,44 @@ def make_packed_train_step(
     )
 
 
-def make_eval_step(model, num_iters: int, gamma: float, refine: bool = False):
+def make_eval_step(model, num_iters: int, gamma: float, refine: bool = False,
+                   per_scene: bool = False):
     """Eval step returning loss + the full metric set
-    (``tools/engine.py:197-234``, ``test.py:117-126``)."""
+    (``tools/engine.py:197-234``, ``test.py:117-126``).
+
+    ``per_scene=True`` returns every metric as a ``(B,)`` array (one value
+    per scene) instead of a pooled batch mean — what keeps the reference's
+    bs=1 running means exact when the standalone eval batches scenes
+    across the device mesh (``test.py:128-142`` semantics at any batch)."""
 
     def step(params, batch):
+        mask, gt = batch["mask"], batch["flow"]
         if refine:
             flow = model.apply(params, batch["pc1"], batch["pc2"], num_iters)
-            loss = compute_loss(flow, batch["mask"], batch["flow"])
+            if per_scene:
+                loss = jax.vmap(
+                    lambda f, m, g: compute_loss(f[None], m[None], g[None])
+                )(flow, mask, gt)
+            else:
+                loss = compute_loss(flow, mask, gt)
         else:
             flows, _ = model.apply(params, batch["pc1"], batch["pc2"], num_iters)
-            loss = sequence_loss(flows, batch["mask"], batch["flow"], gamma)
+            if per_scene:
+                loss = jax.vmap(
+                    lambda fl, m, g: sequence_loss(
+                        fl[:, None], m[None], g[None], gamma),
+                    in_axes=(1, 0, 0),
+                )(flows, mask, gt)
+            else:
+                loss = sequence_loss(flows, mask, gt, gamma)
             flow = flows[-1]
         out = {"loss": loss}
-        out.update(flow_metrics(flow, batch["mask"], batch["flow"]))
+        if per_scene:
+            out.update(jax.vmap(
+                lambda f, m, g: flow_metrics(f[None], m[None], g[None])
+            )(flow, mask, gt))
+        else:
+            out.update(flow_metrics(flow, mask, gt))
         return out, flow
 
     return jax.jit(step)

@@ -23,6 +23,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--truncate_k", type=int, default=512)
     p.add_argument("--corr_knn", type=int, default=32)
     p.add_argument("--eval_iters", type=int, default=32)
+    p.add_argument("--eval_batch", type=int, default=1,
+                   help="scenes evaluated concurrently, sharded over the "
+                        "mesh data axis with per-scene metrics (identical "
+                        "running means; 0 = one scene per device)")
     p.add_argument("--weights", required=False, default=None)
     p.add_argument("--torch_weights", default=None,
                    help="reference-published torch .params checkpoint")
@@ -62,7 +66,8 @@ def main(argv=None) -> None:
                         max_points=a.max_points, num_workers=a.num_workers,
                         synthetic_size=a.synthetic_size,
                         strict_sizes=not a.no_strict_sizes),
-        train=TrainConfig(refine=a.refine, eval_iters=a.eval_iters),
+        train=TrainConfig(refine=a.refine, eval_iters=a.eval_iters,
+                          eval_batch=a.eval_batch),
         exp_path=a.exp_path,
     )
 
