@@ -48,12 +48,16 @@ PROBE_TIMEOUT_S = float(os.environ.get("PVRAFT_BENCH_PROBE_TIMEOUT_S", 240))
 # the TPU claim, so variant children get a generous window.
 VARIANT_TIMEOUT_S = float(os.environ.get("PVRAFT_BENCH_VARIANT_TIMEOUT_S", 1200))
 
+# use_pallas pinned explicitly per variant (the config's None-auto default
+# would silently turn Pallas on for every TPU variant, making the fallback
+# ladder meaningless).
 VARIANTS = [
     ("bf16+pallas+approx", dict(compute_dtype="bfloat16", use_pallas=True,
                                 approx_topk=True)),
-    ("bf16+approx", dict(compute_dtype="bfloat16", approx_topk=True)),
-    ("bf16", dict(compute_dtype="bfloat16")),
-    ("fp32", dict()),
+    ("bf16+approx", dict(compute_dtype="bfloat16", use_pallas=False,
+                         approx_topk=True)),
+    ("bf16", dict(compute_dtype="bfloat16", use_pallas=False)),
+    ("fp32", dict(use_pallas=False)),
 ]
 
 

@@ -35,7 +35,11 @@ class ModelConfig:
 
     # Numerics.
     compute_dtype: str = "float32"   # "bfloat16" for the fast path
-    use_pallas: bool = False         # Pallas voxel kernel vs XLA fallback
+    # Pallas voxel/lookup kernels vs the XLA fallback. None = auto: True
+    # on TPU (the certified fast path — scripts/tpu_consistency.py), False
+    # elsewhere (CPU/GPU run the oracle XLA path; the Pallas kernels are
+    # TPU-shaped). Explicit True/False overrides.
+    use_pallas: Optional[bool] = None
     corr_chunk: Optional[int] = None  # chunked/streaming top-k over N2 if set
     remat: bool = False              # rematerialize each GRU iteration
     # lax.approx_max_k for the correlation truncation: much faster on TPU
@@ -91,12 +95,16 @@ class TrainConfig:
     gamma: float = 0.8             # sequence-loss decay (tools/loss.py:9)
     iters: int = 8                 # GRU iterations during training
     eval_iters: int = 32           # GRU iterations at val/test (engine.py:198)
-    # Scenes evaluated concurrently by the standalone eval (test.py). The
-    # reference protocol is 1 (test.py:92); sharding eval_batch scenes over
-    # the mesh data axis computes per-scene metrics so the running means
-    # match the protocol's up to float reassociation (~1e-6, test-checked
-    # at rel 1e-5). 0 = one scene per data-axis device.
-    eval_batch: int = 1
+    # Scenes evaluated concurrently at val/test (Trainer per-epoch val and
+    # the standalone test.py eval). The reference protocol is 1
+    # (test.py:92); sharding eval_batch scenes over the mesh data axis
+    # computes per-scene metrics so the running means match the protocol's
+    # up to float reassociation (~1e-6, test-checked at rel 1e-5).
+    # 0 (default) = one scene per data-axis device — the per-epoch val
+    # loop parallelizes across the mesh instead of replicating bs=1
+    # (reference behavior tools/engine.py:197-198 being serial is a
+    # torch-era artifact, not part of the protocol).
+    eval_batch: int = 0
     checkpoint_interval: int = 5
     # "msgpack" (single atomic file) or "orbax" (async multi-host-aware
     # directory checkpoints); loads auto-detect (engine/checkpoint.py).
@@ -147,6 +155,16 @@ class Config:
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
+
+
+def resolve_use_pallas(cfg: ModelConfig) -> bool:
+    """``use_pallas`` with the auto default resolved: None means "the
+    compiled Pallas kernels on TPU, the XLA oracle path elsewhere"."""
+    if cfg.use_pallas is None:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    return cfg.use_pallas
 
 
 def compute_dtype(cfg: ModelConfig):

@@ -88,12 +88,29 @@ def _orbax_promote() -> None:
         if not os.path.exists(tmp):
             continue  # already recovered by find_checkpoint
         _swap_in(tmp, dst)
-        for extra in extras:
-            ctmp = extra + ".tmp"
-            if os.path.exists(ctmp):
-                shutil.rmtree(ctmp)
-            shutil.copytree(dst, ctmp)
-            _swap_in(ctmp, extra)
+        _copy_extras(dst, extras)
+        sidecar = tmp + ".extras.json"
+        if os.path.isfile(sidecar):  # owed copies delivered; retire it
+            os.unlink(sidecar)
+
+
+def _copy_extras(dst: str, extras) -> None:
+    """Copy checkpoint directory ``dst`` to each extra name (NNN/best).
+
+    The intermediate name is ``.copytmp``, NOT ``.tmp``: recovery adopts
+    ``.tmp`` directories as complete checkpoints (orbax's commit makes
+    them so atomically), but ``shutil.copytree`` is not atomic — a
+    half-written copy temp must never be mistakable for a checkpoint.
+    ``_swap_in`` makes the final rename atomic and refreshes any stale
+    pre-existing copy."""
+    import shutil
+
+    for extra in extras:
+        ctmp = extra + ".copytmp"
+        if os.path.exists(ctmp):
+            shutil.rmtree(ctmp)
+        shutil.copytree(dst, ctmp)
+        _swap_in(ctmp, extra)
 
 
 def _sync_hosts(tag: str) -> None:
@@ -120,9 +137,52 @@ def _recover_leftover_tmp(dst: str) -> None:
     died before its deferred promote (orbax's own commit is an atomic
     rename, so an existing ``.tmp`` directory is always a complete
     checkpoint — and always newer than the promoted name next to it)."""
+    import json
+    import shutil
+
     tmp = dst + ".tmp"
-    if os.path.isdir(tmp) and jax.process_index() == 0:
-        _swap_in(tmp, dst)
+    sidecar = tmp + ".extras.json"
+    if jax.process_index() == 0:
+        if os.path.isdir(tmp):
+            _swap_in(tmp, dst)
+        # Re-create the NNN/best copies the dying run still owed (the
+        # sidecar records them at save time; without it only
+        # last_checkpoint would survive a crash between the async commit
+        # and the deferred promote). Two crash shapes reach here: tmp
+        # still present (death before promote — adopted above) and tmp
+        # already swapped in (death mid-promote, before the extras
+        # copies). Both leave dst holding the owed payload; the epoch
+        # gate rejects the third shape — death before the async write
+        # ever committed — where dst is an OLDER checkpoint that must not
+        # be recorded under the owed NNN/best names.
+        if os.path.isfile(sidecar):
+            try:
+                with open(sidecar) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                meta = {}
+            extras = meta.get("extras", []) if isinstance(meta, dict) else meta
+            owed_epoch = meta.get("epoch") if isinstance(meta, dict) else None
+            if extras and os.path.isdir(dst):
+                dst_epoch = None
+                try:
+                    dst_epoch = int(_orbax().restore(
+                        os.path.abspath(dst))["epoch"])
+                except Exception:
+                    pass
+                if owed_epoch is None or dst_epoch == owed_epoch:
+                    _copy_extras(dst, extras)
+            os.unlink(sidecar)
+        old = dst + ".old"
+        if os.path.isdir(old):
+            if os.path.isdir(dst):
+                # Crash between _swap_in's final rename and its rmtree:
+                # dst is the newer copy; the aside-rename is stale.
+                shutil.rmtree(old)
+            else:
+                # Crash between the aside-rename and tmp's rename with no
+                # surviving tmp: the aside copy is the only checkpoint.
+                os.replace(old, dst)
     _sync_hosts("pvraft-ckpt-recover")
 
 
@@ -150,6 +210,16 @@ def _orbax_write(path: str, payload: Dict[str, Any], extras=()) -> None:
         # crashed runs don't accumulate multi-MB orphans.
         for orphan in glob.glob(tmp + ".orbax-checkpoint-tmp-*"):
             shutil.rmtree(orphan, ignore_errors=True)
+    if extras and jax.process_index() == 0:
+        # Sidecar so a crash after the async commit but before promote can
+        # still re-create the NNN/best copies from the adopted tmp
+        # (_recover_leftover_tmp reads and removes it). The epoch lets
+        # recovery verify dst actually holds the owed payload.
+        import json
+
+        with open(tmp + ".extras.json", "w") as f:
+            json.dump({"epoch": int(payload["epoch"]),
+                       "extras": list(extras)}, f)
     _orbax().save(os.path.abspath(tmp), args=ocp.args.StandardSave(payload))
     _orbax_pending.append((tmp, path, list(extras)))
 
@@ -180,8 +250,35 @@ def save_checkpoint(
         names.append("best_checkpoint")
     paths = [os.path.join(ckpt_dir, n + suffix) for n in names]
     if backend == "msgpack":
-        for p in paths:
-            _write(p, payload)
+        # Process-0-only on shared filesystems: every process calls save
+        # (the payload is replicated), but concurrent truncating writes to
+        # the same '<path>.tmp' can interleave one process's truncate with
+        # another's rename, corrupting last_checkpoint. Mirror the orbax
+        # path: one writer, then a barrier so no process proceeds past an
+        # epoch boundary before the checkpoint is durable.
+        if jax.process_index() == 0:
+            for p in paths:
+                _write(p, payload)
+        if jax.process_count() > 1:
+            # Without a shared filesystem, the process-0-only write means
+            # every other host has no checkpoint and a later resume would
+            # silently diverge (host 0 at epoch N, the rest from scratch).
+            # Gather visibility so EVERY process raises together — a
+            # single-process raise would leave the others blocking in the
+            # next collective (a distributed hang, not a clean error).
+            # The allgather doubles as the write-completion barrier.
+            from jax.experimental import multihost_utils
+
+            visible = multihost_utils.process_allgather(
+                np.asarray([os.path.exists(paths[0])])
+            )
+            if not bool(np.asarray(visible).all()):
+                raise RuntimeError(
+                    f"msgpack checkpoint {paths[0]} written by process 0 "
+                    "is not visible on every process: multi-host msgpack "
+                    "checkpoints require a shared exp_path; use a shared "
+                    "filesystem or ckpt_backend='orbax'"
+                )
     else:
         # orbax StandardSave takes arrays (incl. 0-d), not numpy scalars.
         # One serialization pass; extra names become copies at promote.

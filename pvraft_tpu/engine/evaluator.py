@@ -89,6 +89,15 @@ class Evaluator:
         # part of the protocol being raced. Each eval step returns per-
         # scene values, so batching/sharding scenes over the mesh leaves
         # the running means identical to the reference's bs=1 loop.
+        if dump_dir is not None and jax.process_count() > 1:
+            # On multi-host runs `flow` is globally sharded (np.asarray on a
+            # non-fully-addressable array raises) and the unsharded eval
+            # loader would have every process write the same scene files
+            # concurrently. Dumping is a single-host visualization feature.
+            raise ValueError(
+                "--dump_dir is single-host only; re-run eval on one host "
+                "to dump scenes for visualization"
+            )
         dev_sums = None
         count = 0
         n_scenes = len(self.dataset)

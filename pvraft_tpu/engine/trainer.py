@@ -103,8 +103,9 @@ class Trainer:
         # Multi-host: each process loads only the slice of the global batch
         # its local devices consume (PrefetchLoader shard + the
         # make_array_from_process_local_data path in parallel/mesh.py);
-        # val/test loaders stay unsharded — bs=1 eval replicates, which
-        # needs identical data on every process.
+        # val/test loaders are scene-sharded per process too when the
+        # counts divide evenly (see _eval_shard below), else they feed
+        # identical data on every process and replication stays exact.
         n_proc = jax.process_count()
         if self.global_batch % max(1, n_proc) != 0:
             raise ValueError(
@@ -134,11 +135,47 @@ class Trainer:
             native=cfg.data.native_loader,
             shard=(jax.process_index(), n_proc),
         )
+        # Per-epoch val/test parallelize across the mesh data axis:
+        # eval_batch scenes per step with per-scene metrics, so the means
+        # stay exactly the bs=1 protocol's (tools/engine.py:197-198 runs
+        # one replicated scene at a time — 8 chips doing 1 chip's work in
+        # the loop that dominates epoch wall-clock on FT3D's 2,000-scene
+        # val; the sharded loop is the same protocol, just parallel).
+        eb = cfg.train.eval_batch
+        self.eval_batch = max(1, n_data if eb <= 0 else eb)
+        # Multi-host: also split the SCENES across processes — but only
+        # when every per-process step is a full eval_batch (scene count
+        # divisible by eval_batch * process_count). That keeps all ranks
+        # in collective lockstep with no partial tail, whose per-process-
+        # distinct rows would be assembled under a "replicated" sharding
+        # and silently diverge. When it doesn't divide (e.g. KITTI's 142
+        # scenes), every process feeds the same scenes and the mean*count
+        # accumulation stays exact — redundant compute, never wrong.
+        def _eval_shard(ds):
+            # Besides the dataset dividing evenly, every per-process batch
+            # must actually SHARD over the local devices (eval_batch a
+            # multiple of the per-process slice of the data axis) — an
+            # indivisible batch would fall into shard_batch's "replicate"
+            # path, which on multi-host assembles per-process-DISTINCT
+            # rows under a sharding JAX believes is replicated.
+            local_data = max(1, n_data // n_proc)
+            if (n_proc > 1
+                    and len(ds) % (self.eval_batch * n_proc) == 0
+                    and self.eval_batch % local_data == 0):
+                return (jax.process_index(), n_proc)
+            return (0, 1)
+
+        self._val_shard = _eval_shard(self.val_ds)
+        self._test_shard = _eval_shard(self.test_ds)
         self.val_loader = PrefetchLoader(
-            self.val_ds, 1, num_workers=min(2, cfg.data.num_workers)
+            self.val_ds, self.eval_batch, drop_last=False,
+            num_workers=min(2, cfg.data.num_workers),
+            shard=self._val_shard,
         )
         self.test_loader = PrefetchLoader(
-            self.test_ds, 1, num_workers=min(2, cfg.data.num_workers)
+            self.test_ds, self.eval_batch, drop_last=False,
+            num_workers=min(2, cfg.data.num_workers),
+            shard=self._test_shard,
         )
 
         refine = cfg.train.refine
@@ -181,7 +218,8 @@ class Trainer:
             # Stage-1 val/test run 32 iters (engine.py:197-198).
             self.eval_iters = cfg.train.eval_iters
         self.eval_step = make_eval_step(
-            self.model, self.eval_iters, cfg.train.gamma, refine=refine
+            self.model, self.eval_iters, cfg.train.gamma, refine=refine,
+            per_scene=True,
         )
         # Packed-state mode: the train loop carries one flat buffer instead
         # of the ~300-leaf (params, opt_state) tree; unpacked back into
@@ -297,6 +335,12 @@ class Trainer:
 
     def val_test(self, epoch: int, mode: str = "val") -> Dict[str, float]:
         loader = self.val_loader if mode == "val" else self.test_loader
+        # Distinct scenes per step: with scene-sharded loaders the global
+        # batch holds bsize scenes from EACH process; unsharded loaders
+        # duplicate the same bsize scenes process_count times (the mean
+        # over the global axis is duplication-invariant either way).
+        shard_world = (self._val_shard if mode == "val"
+                       else self._test_shard)[1]
         if mode == "test":
             best = find_checkpoint(self.ckpt_dir, "best_checkpoint")
             if best is not None:
@@ -304,22 +348,40 @@ class Trainer:
         # Metric sums stay on device across the whole loop — a float() per
         # batch would stall dispatch once per scene (3,824 times on FT3D
         # test); one device->host transfer per epoch instead.
+        import time as _time
+
+        t0 = _time.perf_counter()
         dev_sums = None
         count = 0
-        for b in device_prefetch(
+        for bsize, b in device_prefetch(
             loader.epoch(0),
-            # bs=1 protocol (test.py:92): replication is intended here.
-            lambda batch: self._device_batch(batch, on_indivisible="replicate"),
+            # eval_batch scenes sharded over the data axis; a tail batch
+            # smaller than the axis replicates (exact, just not parallel).
+            lambda batch: (batch["pc1"].shape[0], self._device_batch(
+                batch, on_indivisible="replicate")),
             depth=self.cfg.parallel.device_prefetch,
         ):
             metrics, _ = self.eval_step(self.params, b)
-            dev_sums = metrics if dev_sums is None else jax.tree_util.tree_map(
-                jnp.add, dev_sums, metrics
+            # mean * (distinct scenes in the global batch): exact for both
+            # the scene-sharded case (bsize * world distinct rows) and the
+            # duplicated case (bsize distinct rows, each world times).
+            eff = bsize * shard_world
+            summed = jax.tree_util.tree_map(
+                lambda v: jnp.mean(v, axis=0) * eff, metrics
             )
-            count += 1
+            dev_sums = summed if dev_sums is None else jax.tree_util.tree_map(
+                jnp.add, dev_sums, summed
+            )
+            count += eff
         means = {
             k: float(v) / max(1, count) for k, v in (dev_sums or {}).items()
         }
+        eval_s = _time.perf_counter() - t0
+        self.log.info(
+            f"{mode} epoch {epoch}: {count} scenes in {eval_s:.1f}s "
+            f"({count / max(eval_s, 1e-9):.1f} scenes/s, "
+            f"eval_batch={self.eval_batch})"
+        )
         tag = mode.capitalize()
         for k, t in [
             ("loss", "Loss"), ("epe3d", "EPE"), ("outlier", "Outlier"),

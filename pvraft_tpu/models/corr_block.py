@@ -12,7 +12,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from pvraft_tpu.config import ModelConfig, compute_dtype
+from pvraft_tpu.config import ModelConfig, compute_dtype, resolve_use_pallas
 from pvraft_tpu.models.layers import PReLU, group_norm
 from pvraft_tpu.ops.corr import CorrState, knn_lookup
 from pvraft_tpu.ops.voxel import voxel_bin_means
@@ -26,7 +26,7 @@ class CorrLookup(nn.Module):
         cfg = self.cfg
         dtype = compute_dtype(cfg)
 
-        if cfg.use_pallas:
+        if resolve_use_pallas(cfg):
             # Fused kernel: one VMEM pass produces both branches; the
             # (B, N, K, 3) rel tensor never hits HBM.
             from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup

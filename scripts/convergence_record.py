@@ -135,8 +135,12 @@ def main() -> int:
     platform = jax.devices()[0].platform
     steps = args.steps or (200 if platform != "cpu" else 60)
 
-    variants = [("fp32", {})]
-    fast = {"compute_dtype": "bfloat16", "approx_topk": True}
+    # use_pallas pinned on both variants: the config's None-auto default
+    # would silently run the fp32 "XLA baseline" through Pallas on TPU,
+    # mislabeling the artifact's fp32-vs-fast comparison.
+    variants = [("fp32", {"use_pallas": False})]
+    fast = {"compute_dtype": "bfloat16", "approx_topk": True,
+            "use_pallas": False}
     if platform == "tpu":
         fast["use_pallas"] = True
     variants.append(

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Eval-protocol throughput on the live backend, committed as an artifact.
+
+The reference eval protocol (``/root/reference/test.py:92,120``): bs=1,
+32 GRU iterations, 8,192 points per scene, 3,824 FT3D test scenes. This
+script measures scenes/sec at exactly that per-scene shape on whatever
+backend is live (the TPU queue runs it with the claim held), plus the
+batched variant (``test.py --eval_batch``) that our framework adds, and
+writes one JSON artifact.
+
+Each timed call gets a DISTINCT batch: the axon remote executor memoizes
+identical-input executions (BENCHMARKS.md), so a same-batch loop would
+time cache hits.
+
+Usage: python scripts/eval_bench.py [--out artifacts/eval_tpu.json]
+                                    [--cpu] [--points N] [--iters N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--out", default="artifacts/eval_tpu.json")
+parser.add_argument("--cpu", action="store_true")
+parser.add_argument("--points", type=int, default=8192)
+parser.add_argument("--iters", type=int, default=32)
+parser.add_argument("--k", type=int, default=512)
+parser.add_argument("--steps", type=int, default=8)
+parser.add_argument("--batched", type=int, default=8,
+                    help="also time this eval_batch size (0 to skip)")
+args = parser.parse_args()
+
+import jax  # noqa: E402
+
+if args.cpu:
+    # Env vars are too late under the axon sitecustomize; pin via config.
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from pvraft_tpu.config import ModelConfig  # noqa: E402
+from pvraft_tpu.engine.steps import make_eval_step  # noqa: E402
+from pvraft_tpu.models import PVRaft  # noqa: E402
+
+platform = jax.devices()[0].platform
+n, iters = args.points, args.iters
+if args.cpu and n > 2048:
+    n, iters = 2048, 8  # CPU smoke of the script itself, clearly labeled
+
+cfg = ModelConfig(truncate_k=min(args.k, n), compute_dtype="bfloat16",
+                  approx_topk=True)
+model = PVRaft(cfg)
+rng = np.random.default_rng(0)
+
+
+def make_batch(bs):
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (bs, n, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (bs, n, 3)).astype(np.float32))
+    return {"pc1": pc1, "pc2": pc2,
+            "mask": jnp.ones((bs, n), jnp.float32), "flow": pc2 - pc1}
+
+
+b0 = make_batch(1)
+n_init = min(n, max(256, cfg.truncate_k))
+params = model.init(jax.random.key(0), b0["pc1"][:, :n_init],
+                    b0["pc2"][:, :n_init], 2)
+step = make_eval_step(model, iters, 0.8)
+
+out = {"platform": platform, "points": n, "iters": iters,
+       "truncate_k": cfg.truncate_k, "protocol": "test.py:92,120 (bs=1)"}
+
+
+def time_scenes(bs):
+    batches = [make_batch(bs) for _ in range(args.steps + 1)]
+    t0 = time.perf_counter()
+    metrics, flow = step(params, batches[0])  # compile
+    jax.block_until_ready(flow)
+    out.setdefault("compile_s", round(time.perf_counter() - t0, 1))
+    if not np.isfinite(float(metrics["epe3d"] if "epe3d" in metrics
+                             else metrics["loss"])):
+        raise FloatingPointError("non-finite eval metric")
+    t0 = time.perf_counter()
+    for b in batches[1:]:
+        metrics, flow = step(params, b)
+    jax.block_until_ready(flow)
+    dt = (time.perf_counter() - t0) / args.steps
+    return bs / dt, dt
+
+
+scenes_per_sec, dt = time_scenes(1)
+out["eval_scenes_per_sec"] = round(scenes_per_sec, 3)
+out["sec_per_scene"] = round(dt, 4)
+out["ft3d_test_3824_scenes_min"] = round(3824 / scenes_per_sec / 60, 1)
+
+if args.batched:
+    try:
+        bsps, bdt = time_scenes(args.batched)
+        out["batched"] = {"eval_batch": args.batched,
+                          "eval_scenes_per_sec": round(bsps, 3),
+                          "speedup_vs_bs1": round(bsps / scenes_per_sec, 2)}
+    except Exception as e:  # batched leg is a bonus, not the artifact
+        out["batched"] = {"error": repr(e)[:200]}
+
+out["ok"] = True
+os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+with open(args.out, "w") as f:
+    json.dump(out, f, indent=1)
+print(json.dumps(out))

@@ -22,8 +22,12 @@ from pvraft_tpu.models import PVRaft
 # streaming option on; 2 GRU iters, forward only. Default CPU; --tpu runs
 # the same program on the real chip (single-chip long-context evidence —
 # the memory wall this path removes is reference model/corr.py:96-99).
+# use_pallas pinned False: this artifact certifies the corr_chunk/
+# graph_chunk XLA streaming path at 16k points (the None-auto default
+# would silently swap in the Pallas kernel on --tpu, measuring a
+# different code path than the CPU leg).
 cfg = ModelConfig(truncate_k=512, corr_chunk=2048, graph_chunk=2048,
-                  remat=True)
+                  remat=True, use_pallas=False)
 model = PVRaft(cfg)
 rng = np.random.default_rng(0)
 n = 16384

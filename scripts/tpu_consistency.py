@@ -91,7 +91,14 @@ def main() -> int:
     from pvraft_tpu.models import PVRaft
 
     n_model = 512 if platform != "cpu" else 64
-    cfg = ModelConfig(truncate_k=32, corr_knn=16, graph_k=8)
+    # use_pallas pinned False: `model` is the XLA oracle on BOTH sides of
+    # checks 3 and 4 (the None-auto default would resolve by
+    # jax.default_backend(), which stays "tpu" even under
+    # jax.default_device(cpu) — the host oracle would try to lower a TPU
+    # Pallas kernel for CPU and the certification would compare Pallas to
+    # itself). Check 4's grad_model opts back in explicitly.
+    cfg = ModelConfig(truncate_k=32, corr_knn=16, graph_k=8,
+                      use_pallas=False)
     model = PVRaft(cfg)
     pc1 = jnp.asarray(rng.uniform(-1, 1, (1, n_model, 3)).astype(np.float32))
     pc2 = jnp.asarray(rng.uniform(-1, 1, (1, n_model, 3)).astype(np.float32))

@@ -23,15 +23,19 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--truncate_k", type=int, default=512)
     p.add_argument("--corr_knn", type=int, default=32)
     p.add_argument("--eval_iters", type=int, default=32)
-    p.add_argument("--eval_batch", type=int, default=1,
+    p.add_argument("--eval_batch", type=int, default=0,
                    help="scenes evaluated concurrently, sharded over the "
                         "mesh data axis with per-scene metrics (identical "
-                        "running means; 0 = one scene per device)")
+                        "running means; 0 = one scene per device, 1 = the "
+                        "reference's serial bs=1 loop)")
     p.add_argument("--weights", required=False, default=None)
     p.add_argument("--torch_weights", default=None,
                    help="reference-published torch .params checkpoint")
     p.add_argument("--refine", action="store_true")
-    p.add_argument("--use_pallas", action="store_true")
+    p.add_argument("--use_pallas", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="Pallas kernels vs XLA fallback (default: auto — "
+                        "Pallas on TPU, XLA elsewhere)")
     p.add_argument("--corr_chunk", type=int, default=None)
     p.add_argument("--graph_chunk", type=int, default=None)
     p.add_argument("--approx_topk", action="store_true")

@@ -58,3 +58,15 @@ def test_cli_test_config_roundtrip():
     )
     assert cfg.truncate_k == 32 and cfg.corr_knn == 8
     assert args.refine and args.eval_iters == 4
+
+
+def test_use_pallas_auto_default_resolves_by_platform():
+    """use_pallas=None means Pallas-on-TPU / XLA-elsewhere; on the CPU
+    test backend it must resolve False (the oracle path), and explicit
+    settings must pass through untouched."""
+    from pvraft_tpu.config import ModelConfig, resolve_use_pallas
+
+    assert ModelConfig().use_pallas is None
+    assert resolve_use_pallas(ModelConfig()) is False  # CPU backend here
+    assert resolve_use_pallas(ModelConfig(use_pallas=True)) is True
+    assert resolve_use_pallas(ModelConfig(use_pallas=False)) is False

@@ -63,6 +63,7 @@ def test_checkpoint_roundtrip(tmp_path):
     assert o2 is not None
 
 
+@pytest.mark.slow
 def test_trainer_end_to_end(tmp_path):
     cfg = _tiny_cfg(tmp_path, epochs=2)
     tr = _tiny_trainer(cfg)
@@ -80,6 +81,7 @@ def test_trainer_end_to_end(tmp_path):
     assert tr.tb.history["Val/EPE"]
 
 
+@pytest.mark.slow
 def test_trainer_resume(tmp_path):
     cfg = _tiny_cfg(tmp_path, epochs=2)
     tr = _tiny_trainer(cfg)
@@ -95,6 +97,7 @@ def test_trainer_resume(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_refine_trainer_freezes_backbone(tmp_path):
     cfg = _tiny_cfg(tmp_path, refine=True)
     tr = _tiny_trainer(cfg)
@@ -116,6 +119,7 @@ def test_refine_trainer_freezes_backbone(tmp_path):
     assert moved
 
 
+@pytest.mark.slow
 def test_stage1_weight_import(tmp_path):
     cfg1 = _tiny_cfg(tmp_path)
     tr1 = _tiny_trainer(cfg1)
@@ -131,6 +135,7 @@ def test_stage1_weight_import(tmp_path):
         np.testing.assert_array_equal(x, y)
 
 
+@pytest.mark.slow
 def test_trainer_per_device_batch_scales_with_mesh(tmp_path):
     """bs is per-device: an 8-way data mesh trains 8x the samples per step
     (the role DataParallel's split plays at tools/engine.py:63-64)."""
@@ -160,6 +165,7 @@ def test_trainer_rejects_oversized_global_batch(tmp_path):
         Trainer(cfg, mesh=make_mesh(n_data=8))  # wants 16 > 4
 
 
+@pytest.mark.slow
 def test_trainer_seq_shard_end_to_end(tmp_path):
     """Full Trainer epoch on a 2x2 (data x seq) mesh with the ring
     correlation + ring kNN active inside the jitted train step."""
@@ -181,6 +187,7 @@ def test_trainer_seq_shard_end_to_end(tmp_path):
     assert np.isfinite(v["epe3d"])
 
 
+@pytest.mark.slow
 def test_evaluator_runs_and_dumps(tmp_path):
     from pvraft_tpu.engine.evaluator import Evaluator
 
@@ -195,6 +202,7 @@ def test_evaluator_runs_and_dumps(tmp_path):
     assert np.load(scene0 / "flow.npy").shape == (64, 3)
 
 
+@pytest.mark.slow
 def test_evaluator_sharded_batch_matches_protocol(tmp_path):
     """eval_batch>1 shards scenes over the mesh data axis with per-scene
     metrics: running means must equal the reference bs=1 protocol's
@@ -252,6 +260,7 @@ def test_visual_render(tmp_path):
     assert os.path.exists(out) and os.path.getsize(out) > 1000
 
 
+@pytest.mark.slow
 def test_trainer_packed_state_matches_unpacked(tmp_path):
     import dataclasses
 
@@ -279,3 +288,41 @@ def test_trainer_packed_state_matches_unpacked(tmp_path):
     last = os.path.join(cfg_p.exp_path, "checkpoints", "last_checkpoint.msgpack")
     tr_p.load_weights(last, resume=True)
     assert tr_p.begin_epoch == 1
+
+
+@pytest.mark.slow
+def test_trainer_val_sharded_matches_bs1_protocol(tmp_path):
+    """The trainer's per-epoch val loop shards eval_batch scenes over the
+    mesh data axis (per-scene metrics); its means must equal the bs=1
+    reference protocol's (tools/engine.py:197-198) up to float
+    reassociation."""
+    import dataclasses
+
+    from pvraft_tpu.engine.trainer import Trainer
+    from pvraft_tpu.parallel.mesh import replicate
+
+    cfg = _tiny_cfg(tmp_path)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, batch_size=1)
+    )
+    tr_sharded = Trainer(cfg, mesh=make_mesh(n_data=4))  # eval_batch 0 -> 4
+    assert tr_sharded.eval_batch == 4
+
+    cfg1 = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(cfg.train, eval_batch=1),
+        exp_path=str(tmp_path / "exp_bs1"),
+    )
+    tr_bs1 = Trainer(cfg1, mesh=make_mesh(n_data=1))
+    assert tr_bs1.eval_batch == 1
+    # Identical weights in both trainers so the comparison is pure loop
+    # semantics.
+    host = jax.tree_util.tree_map(np.asarray, tr_sharded.params)
+    tr_bs1.params = replicate(host, tr_bs1.mesh)
+
+    m_sharded = tr_sharded.val_test(0, "val")
+    m_bs1 = tr_bs1.val_test(0, "val")
+    assert set(m_sharded) == set(m_bs1)
+    for k in m_bs1:
+        np.testing.assert_allclose(m_sharded[k], m_bs1[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)

@@ -7,6 +7,10 @@ checkpoints (SURVEY.md §4). Tolerances absorb backend differences (CPU vs
 TPU matmul order), not semantic changes.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-model golden regressions (~2 min)
+
 import numpy as np
 import jax
 import jax.numpy as jnp

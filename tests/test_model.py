@@ -1,6 +1,7 @@
 """End-to-end model tests on tiny shapes."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -40,6 +41,7 @@ def test_iters_change_prediction_but_not_params():
     np.testing.assert_allclose(np.asarray(f2), np.asarray(f4[:2]), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_backbone_gradients_flow():
     xyz1, xyz2 = _clouds(2)
     model = PVRaft(CFG)
@@ -61,6 +63,7 @@ def test_backbone_gradients_flow():
     assert any("context_extractor" in k for k in nonzero)
 
 
+@pytest.mark.slow
 def test_refine_freezes_backbone():
     xyz1, xyz2 = _clouds(3)
     model = PVRaftRefine(CFG)
@@ -85,6 +88,7 @@ def test_refine_freezes_backbone():
     assert any("fc" in k for k in nonzero)
 
 
+@pytest.mark.slow
 def test_remat_matches_baseline():
     xyz1, xyz2 = _clouds(4)
     base = PVRaft(CFG)
@@ -95,6 +99,7 @@ def test_remat_matches_baseline():
     np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bf16_forward_close_to_fp32():
     import dataclasses
 

@@ -7,6 +7,8 @@ certify the orbax path and that loads auto-detect the backend from the path.
 import dataclasses
 import os
 
+import pytest
+
 import jax
 import numpy as np
 import optax
@@ -138,6 +140,7 @@ def test_load_payload_both_backends(tmp_path):
         np.testing.assert_array_equal(payload["params"]["w"], params["w"])
 
 
+@pytest.mark.slow
 def test_trainer_orbax_backend(tmp_path):
     """Trainer trains, checkpoints, and resumes entirely through orbax."""
     from conftest import tiny_trainer_cfg
@@ -163,3 +166,137 @@ def test_trainer_orbax_backend(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(tr.params),
                     jax.tree_util.tree_leaves(tr2.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_orbax_recovery_recreates_extras_from_sidecar(tmp_path):
+    """Dying after the async commit but before promote used to lose the
+    NNN/best copies (only last_checkpoint was adopted); the extras sidecar
+    written at save time lets recovery re-create them."""
+    import pvraft_tpu.engine.checkpoint as ck
+
+    params = {"w": np.zeros(2, np.float32)}
+    tx = optax.sgd(1e-2)
+    save_checkpoint(str(tmp_path), {"w": np.ones(2, np.float32)},
+                    tx.init(params), epoch=4, checkpoint_interval=5,
+                    best=True, backend="orbax")
+    ck._orbax().wait_until_finished()
+    ck._orbax_pending.clear()  # simulate death before promote
+    assert os.path.isfile(
+        tmp_path / "last_checkpoint.orbax.tmp.extras.json")
+
+    found = latest_checkpoint(str(tmp_path))
+    assert found.endswith("last_checkpoint.orbax")
+    names = set(os.listdir(tmp_path))
+    assert "004.orbax" in names and "best_checkpoint.orbax" in names, names
+    assert not any(".tmp" in n for n in names), names
+    p, _, epoch = load_checkpoint(
+        str(tmp_path / "best_checkpoint.orbax"),
+        jax.tree_util.tree_map(np.zeros_like, params))
+    assert epoch == 4
+    np.testing.assert_array_equal(p["w"], np.ones(2))
+
+
+def test_orbax_recovery_sweeps_stale_old_dir(tmp_path):
+    """A crash between _swap_in's final rename and its cleanup leaves a
+    stale '<name>.orbax.old'; recovery removes it (dst is newer)."""
+    import shutil
+
+    params = {"w": np.zeros(2, np.float32)}
+    tx = optax.sgd(1e-2)
+    save_checkpoint(str(tmp_path), params, tx.init(params), epoch=0,
+                    checkpoint_interval=0, backend="orbax")
+    wait_for_saves()
+    dst = tmp_path / "last_checkpoint.orbax"
+    shutil.copytree(dst, tmp_path / "last_checkpoint.orbax.old")
+
+    assert latest_checkpoint(str(tmp_path)).endswith("last_checkpoint.orbax")
+    assert not os.path.exists(tmp_path / "last_checkpoint.orbax.old")
+
+
+def test_orbax_recovery_adopts_orphaned_old_dir(tmp_path):
+    """A crash between the aside-rename and tmp's rename (tmp since
+    promoted/gone) can leave only '<name>.orbax.old': it is the sole
+    surviving copy and must be adopted, not deleted."""
+    params = {"w": np.full(2, 3.0, np.float32)}
+    tx = optax.sgd(1e-2)
+    save_checkpoint(str(tmp_path), params, tx.init(params), epoch=2,
+                    checkpoint_interval=0, backend="orbax")
+    wait_for_saves()
+    os.replace(tmp_path / "last_checkpoint.orbax",
+               tmp_path / "last_checkpoint.orbax.old")
+
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found.endswith("last_checkpoint.orbax")
+    p, _, epoch = load_checkpoint(
+        found, jax.tree_util.tree_map(np.zeros_like, params))
+    assert epoch == 2
+    np.testing.assert_array_equal(p["w"], np.full(2, 3.0))
+
+
+def test_orbax_recovery_extras_after_partial_promote(tmp_path):
+    """Death MID-promote — tmp already swapped into dst but the extras
+    copies not yet made — leaves only the sidecar; recovery must still
+    re-create the owed NNN/best from dst (whose epoch matches)."""
+    import pvraft_tpu.engine.checkpoint as ck
+
+    params = {"w": np.full(2, 5.0, np.float32)}
+    tx = optax.sgd(1e-2)
+    save_checkpoint(str(tmp_path), params, tx.init(params), epoch=9,
+                    checkpoint_interval=5, best=True, backend="orbax")
+    ck._orbax().wait_until_finished()
+    ck._orbax_pending.clear()
+    # Simulate the promote dying right after the dst swap.
+    ck._swap_in(str(tmp_path / "last_checkpoint.orbax.tmp"),
+                str(tmp_path / "last_checkpoint.orbax"))
+    assert os.path.isfile(tmp_path / "last_checkpoint.orbax.tmp.extras.json")
+
+    latest_checkpoint(str(tmp_path))
+    names = set(os.listdir(tmp_path))
+    assert "009.orbax" in names and "best_checkpoint.orbax" in names, names
+    p, _, epoch = load_checkpoint(
+        str(tmp_path / "best_checkpoint.orbax"),
+        jax.tree_util.tree_map(np.zeros_like, params))
+    assert epoch == 9
+    np.testing.assert_array_equal(p["w"], np.full(2, 5.0))
+
+
+def test_orbax_recovery_ignores_sidecar_for_stale_dst(tmp_path):
+    """If the new payload never committed (no tmp) and dst holds an OLDER
+    epoch than the sidecar owes, recovery must NOT record the old data
+    under the owed NNN/best names."""
+    import json
+
+    params = {"w": np.zeros(2, np.float32)}
+    tx = optax.sgd(1e-2)
+    save_checkpoint(str(tmp_path), params, tx.init(params), epoch=3,
+                    checkpoint_interval=0, backend="orbax")
+    wait_for_saves()
+    # Forge a sidecar owing epoch-7 extras; dst is epoch 3.
+    with open(tmp_path / "last_checkpoint.orbax.tmp.extras.json", "w") as f:
+        json.dump({"epoch": 7,
+                   "extras": [str(tmp_path / "007.orbax")]}, f)
+
+    latest_checkpoint(str(tmp_path))
+    names = set(os.listdir(tmp_path))
+    assert "007.orbax" not in names, names
+    assert not os.path.isfile(tmp_path / "last_checkpoint.orbax.tmp.extras.json")
+
+
+def test_orbax_half_written_copytmp_never_adopted(tmp_path):
+    """A half-written .copytmp (non-atomic copytree) must never be swapped
+    in as a checkpoint — only orbax-committed .tmp dirs are complete."""
+    params = {"w": np.full(2, 2.0, np.float32)}
+    tx = optax.sgd(1e-2)
+    save_checkpoint(str(tmp_path), params, tx.init(params), epoch=1,
+                    checkpoint_interval=0, best=True, backend="orbax")
+    wait_for_saves()
+    # Garbage copy-temp next to a good best_checkpoint.
+    bad = tmp_path / "best_checkpoint.orbax.copytmp"
+    bad.mkdir()
+    (bad / "junk").write_text("partial")
+
+    found = find_checkpoint(str(tmp_path), "best_checkpoint")
+    p, _, epoch = load_checkpoint(
+        found, jax.tree_util.tree_map(np.zeros_like, params))
+    assert epoch == 1
+    np.testing.assert_array_equal(p["w"], np.full(2, 2.0))

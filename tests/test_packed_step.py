@@ -4,6 +4,8 @@ exactly: same losses and same parameters after several chained steps."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # chained full train steps, both stages
+
 import jax
 import jax.numpy as jnp
 import optax

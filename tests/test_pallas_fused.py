@@ -2,6 +2,9 @@
 
 import numpy as np
 import jax
+import pytest
+
+pytestmark = pytest.mark.slow  # interpret-mode Pallas on CPU (~2 min); compiled numerics certified on TPU by scripts/tpu_consistency.py
 import jax.numpy as jnp
 
 from pvraft_tpu.ops.corr import CorrState, knn_lookup

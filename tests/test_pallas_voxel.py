@@ -2,6 +2,9 @@
 
 import numpy as np
 import jax
+import pytest
+
+pytestmark = pytest.mark.slow  # interpret-mode Pallas on CPU (~1.5 min)
 import jax.numpy as jnp
 
 from pvraft_tpu.ops.voxel import voxel_bin_means

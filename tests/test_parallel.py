@@ -4,6 +4,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+
+pytestmark = pytest.mark.slow  # jitted train steps over the 8-device mesh
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 

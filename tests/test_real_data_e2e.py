@@ -6,6 +6,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # trainer/evaluator e2e over on-disk trees
+
 from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
 
 

@@ -7,6 +7,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # torch checkpoint converters (~1 min)
+
 from pvraft_tpu.config import ModelConfig
 from pvraft_tpu.engine.checkpoint import import_torch_state_dict
 from pvraft_tpu.models.raft import PVRaft

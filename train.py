@@ -59,8 +59,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="devices on the data mesh axis (-1: all)")
     p.add_argument("--seq_parallel", type=int, default=1,
                    help="devices on the sequence mesh axis")
-    p.add_argument("--use_pallas", action="store_true",
-                   help="Pallas voxel kernel instead of the XLA fallback")
+    p.add_argument("--use_pallas", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="Pallas voxel/lookup kernels vs the XLA fallback "
+                        "(default: auto — Pallas on TPU, XLA elsewhere)")
     p.add_argument("--corr_chunk", type=int, default=None,
                    help="streaming top-k chunk over N2 (memory saver)")
     p.add_argument("--graph_chunk", type=int, default=None,
