@@ -21,7 +21,12 @@ from pvraft_tpu.data.loader import device_prefetch
 from pvraft_tpu.engine.checkpoint import load_checkpoint, load_torch_checkpoint
 from pvraft_tpu.engine.steps import make_eval_step
 from pvraft_tpu.models import PVRaft, PVRaftRefine
-from pvraft_tpu.parallel.mesh import device_batch, make_mesh, replicate
+from pvraft_tpu.parallel.mesh import (
+    device_batch,
+    eval_scene_shard,
+    make_mesh,
+    replicate,
+)
 from pvraft_tpu.utils.logging import ExperimentLog
 
 
@@ -47,10 +52,18 @@ class Evaluator:
         # axis; 0 = one scene per data-axis device. Per-scene metrics keep
         # the bs=1 protocol's running means exact (test.py:92,128-142).
         eb = cfg.train.eval_batch
-        self.eval_batch = max(1, self.mesh.shape["data"] if eb <= 0 else eb)
+        n_data = self.mesh.shape["data"]
+        self.eval_batch = max(1, n_data if eb <= 0 else eb)
+        # Multi-host: scene-shard across processes when safe (the shared
+        # gate encodes why — see eval_scene_shard); otherwise every
+        # process feeds the same scenes and the mean*count accumulation
+        # stays exact, just redundant.
+        self.shard = eval_scene_shard(
+            len(self.dataset), self.eval_batch, self.mesh)
         self.loader = PrefetchLoader(
             self.dataset, self.eval_batch, drop_last=False,
             num_workers=min(2, cfg.data.num_workers),
+            shard=self.shard,
         )
         refine = cfg.train.refine
         self.model = (PVRaftRefine if refine else PVRaft)(
@@ -112,11 +125,12 @@ class Evaluator:
             depth=self.cfg.parallel.device_prefetch,
         ):
             metrics, flow = self.eval_step(self.params, b)
-            bsize = batch["pc1"].shape[0]
-            # mean*bsize rather than sum: on multi-host the unsharded eval
-            # loader contributes the same scenes from every process, so the
-            # global batch axis can hold each scene process_count times —
-            # the mean over it is duplication-invariant, a raw sum is not.
+            bsize = batch["pc1"].shape[0] * self.shard[1]
+            # mean * (distinct scenes in the global batch): exact for both
+            # the scene-sharded case (local_bsize * world distinct rows)
+            # and the unsharded multi-host case, where the global batch
+            # axis holds each scene process_count times (the mean over it
+            # is duplication-invariant, a raw sum is not).
             summed = jax.tree_util.tree_map(
                 lambda v: jnp.mean(v, axis=0) * bsize, metrics
             )

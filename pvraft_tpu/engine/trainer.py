@@ -50,7 +50,12 @@ from pvraft_tpu.engine.steps import (
     make_train_step,
 )
 from pvraft_tpu.models import PVRaft, PVRaftRefine
-from pvraft_tpu.parallel.mesh import device_batch, make_mesh, replicate
+from pvraft_tpu.parallel.mesh import (
+    device_batch,
+    eval_scene_shard,
+    make_mesh,
+    replicate,
+)
 from pvraft_tpu.utils.logging import ExperimentLog, TBWriter
 from pvraft_tpu.utils.profiling import StepTimer, trace_context
 
@@ -151,22 +156,10 @@ class Trainer:
         # and silently diverge. When it doesn't divide (e.g. KITTI's 142
         # scenes), every process feeds the same scenes and the mean*count
         # accumulation stays exact — redundant compute, never wrong.
-        def _eval_shard(ds):
-            # Besides the dataset dividing evenly, every per-process batch
-            # must actually SHARD over the local devices (eval_batch a
-            # multiple of the per-process slice of the data axis) — an
-            # indivisible batch would fall into shard_batch's "replicate"
-            # path, which on multi-host assembles per-process-DISTINCT
-            # rows under a sharding JAX believes is replicated.
-            local_data = max(1, n_data // n_proc)
-            if (n_proc > 1
-                    and len(ds) % (self.eval_batch * n_proc) == 0
-                    and self.eval_batch % local_data == 0):
-                return (jax.process_index(), n_proc)
-            return (0, 1)
-
-        self._val_shard = _eval_shard(self.val_ds)
-        self._test_shard = _eval_shard(self.test_ds)
+        self._val_shard = eval_scene_shard(
+            len(self.val_ds), self.eval_batch, self.mesh)
+        self._test_shard = eval_scene_shard(
+            len(self.test_ds), self.eval_batch, self.mesh)
         self.val_loader = PrefetchLoader(
             self.val_ds, self.eval_batch, drop_last=False,
             num_workers=min(2, cfg.data.num_workers),

@@ -54,6 +54,27 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def eval_scene_shard(n_scenes: int, eval_batch: int, mesh: Mesh) -> tuple:
+    """``(rank, world)`` for scene-sharding an eval loader across processes.
+
+    Shards only when every per-process step is a full, locally-shardable
+    batch: the scene count must divide ``eval_batch * process_count`` (no
+    partial tail) and ``eval_batch`` must be a multiple of the per-process
+    slice of the mesh data axis (so batches truly shard). Anything else
+    returns ``(0, 1)`` — all processes feed the same scenes, which is
+    redundant but exact; a partial or indivisible batch would instead
+    fall into ``shard_batch``'s "replicate" path and assemble
+    per-process-DISTINCT rows under a sharding JAX believes is replicated
+    (silent divergence)."""
+    n_proc = jax.process_count()
+    local_data = max(1, mesh.shape[DATA_AXIS] // max(1, n_proc))
+    if (n_proc > 1
+            and n_scenes % (eval_batch * n_proc) == 0
+            and eval_batch % local_data == 0):
+        return (jax.process_index(), n_proc)
+    return (0, 1)
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
