@@ -140,9 +140,24 @@ class ParallelConfig:
     # pytree step (tests/test_packed_step.py); mitigates per-chained-leaf
     # dispatch overhead on remote-dispatch platforms (BENCHMARKS.md).
     packed_state: bool = False
+    # With packed_state: round-trip the flat state buffer through the host
+    # between steps (D2H+H2D of a few MB). Strictly slower on a directly
+    # attached TPU; on remote-dispatch tunnels whose chained-executable
+    # bookkeeping costs seconds per step (BENCHMARKS.md) the round-trip is
+    # the fastest TRUE training loop — identical floats, state evolving
+    # every step. bench.py auto-tries it; this flag makes the same loop
+    # available to real training runs.
+    host_roundtrip: bool = False
     # Batches kept in flight to the device (data/loader.py:device_prefetch):
     # H2D transfers overlap compute. 1 disables the pipeline.
     device_prefetch: int = 2
+
+    def __post_init__(self):
+        if self.host_roundtrip and not self.packed_state:
+            raise ValueError(
+                "host_roundtrip requires packed_state (the round-trip "
+                "moves the single flat state buffer)"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

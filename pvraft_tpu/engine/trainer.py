@@ -289,6 +289,11 @@ class Trainer:
                 depth=cfg.parallel.device_prefetch,
             ):
                 if self.packed:
+                    if self.cfg.parallel.host_roundtrip:
+                        # Break the chained-executable dependency through
+                        # the host: D2H+H2D of one flat buffer per step
+                        # (identical floats; see ParallelConfig).
+                        self.flat = jnp.asarray(np.asarray(self.flat))
                     self.flat, m = self.packed_step(self.flat, b)
                 else:
                     self.params, self.opt_state, m = self.train_step(

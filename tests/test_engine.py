@@ -326,3 +326,37 @@ def test_trainer_val_sharded_matches_bs1_protocol(tmp_path):
     for k in m_bs1:
         np.testing.assert_allclose(m_sharded[k], m_bs1[k], rtol=1e-5,
                                    atol=1e-6, err_msg=k)
+
+
+@pytest.mark.slow
+def test_trainer_host_roundtrip_matches_packed(tmp_path):
+    """--host_roundtrip round-trips the flat state through the host each
+    step; the floats must be bit-identical to the plain packed loop."""
+    import dataclasses
+
+    from pvraft_tpu.engine.trainer import Trainer
+
+    cfg = _tiny_cfg(tmp_path)
+    cfg_p = dataclasses.replace(
+        cfg, parallel=dataclasses.replace(cfg.parallel, packed_state=True)
+    )
+    cfg_rt = dataclasses.replace(
+        cfg_p,
+        parallel=dataclasses.replace(cfg_p.parallel, host_roundtrip=True),
+        exp_path=str(tmp_path / "exp_rt"),
+    )
+    tr_p = Trainer(cfg_p, mesh=make_mesh(n_data=1))
+    tr_rt = Trainer(cfg_rt, mesh=make_mesh(n_data=1))
+    m_p = tr_p.training(0)
+    m_rt = tr_rt.training(0)
+    assert np.isclose(m_p["loss"], m_rt["loss"])
+    for a, b in zip(jax.tree_util.tree_leaves(tr_p.params),
+                    jax.tree_util.tree_leaves(tr_rt.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_host_roundtrip_requires_packed():
+    from pvraft_tpu.config import ParallelConfig
+
+    with pytest.raises(ValueError, match="packed_state"):
+        ParallelConfig(host_roundtrip=True)

@@ -298,3 +298,24 @@ def test_trainer_rejects_indivisible_global_batch_per_process(monkeypatch, tmp_p
     cfg = tiny_trainer_cfg(tmp_path)  # batch_size=2 -> global batch 2 on 1-device mesh
     with pytest.raises(ValueError, match="multiple of .* process count"):
         Trainer(cfg, mesh=make_mesh(n_data=1))
+
+
+def test_eval_scene_shard_gates(monkeypatch):
+    """Scene-sharding must engage only when every per-process step is a
+    full, locally-shardable batch; anything else falls back to (0, 1)
+    (all processes feed the same scenes — redundant but exact)."""
+    from pvraft_tpu.parallel.mesh import eval_scene_shard
+
+    mesh = make_mesh(n_data=8)
+    # Single process: never shards.
+    assert eval_scene_shard(400, 8, mesh) == (0, 1)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    # 400 scenes, eval_batch 8, 2 procs: 400 % 16 == 0 and 8 % 4 == 0.
+    assert eval_scene_shard(400, 8, mesh) == (1, 2)
+    # Partial tail (402 % 16 != 0): no shard.
+    assert eval_scene_shard(402, 8, mesh) == (0, 1)
+    # eval_batch 2 not a multiple of local_data 4: per-process batches
+    # would hit the replicate path with distinct rows — no shard.
+    assert eval_scene_shard(400, 2, mesh) == (0, 1)

@@ -71,6 +71,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--approx_topk", action="store_true",
                    help="approximate correlation truncation (faster on TPU)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--host_roundtrip", action="store_true",
+                   help="with --packed_state: round-trip the flat train "
+                        "state through the host between steps (fastest "
+                        "true loop on remote-dispatch tunnels; slower on "
+                        "directly attached chips)")
     p.add_argument("--packed_state", action="store_true",
                    help="carry params+opt_state between steps as one flat "
                         "buffer (fewer chained leaves; see BENCHMARKS.md)")
@@ -119,6 +124,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
         ),
         parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel,
                                 packed_state=a.packed_state,
+                                host_roundtrip=a.host_roundtrip,
                                 device_prefetch=a.device_prefetch),
         exp_path=a.exp_path,
     )
