@@ -85,3 +85,35 @@ def test_approx_with_chunk_rejected_regardless_of_size():
         with pytest.raises(ValueError, match="approx_topk"):
             corr_init(jnp.asarray(f1), jnp.asarray(f2), jnp.asarray(xyz2),
                       4, chunk=chunk, approx=True)
+
+
+def test_chunked_equals_full_randomized_shapes():
+    """Streaming top-k sweep over random (N1, N2, K, chunk) combinations:
+    the chunked scan must be exactly the dense truncation for every
+    divisor chunk size, including chunk == K and single-chunk edges."""
+    rng = np.random.default_rng(123)
+    for trial in range(8):
+        n1 = int(rng.integers(4, 40))
+        n2 = int(rng.choice([32, 48, 64, 96]))
+        k = int(rng.integers(4, min(24, n2) + 1))
+        # c < n2 keeps every trial genuinely chunked (chunk >= N2 falls
+        # back to the dense path); chunk < k is a supported regime and
+        # the sentinel-handling edge case, so it is NOT filtered out.
+        divisors = [c for c in (4, 8, 16, 24, 32, 48)
+                    if n2 % c == 0 and c < n2]
+        if not divisors:
+            continue
+        chunk = int(rng.choice(divisors))
+        f1 = jnp.asarray(rng.normal(size=(1, n1, 8)).astype(np.float32))
+        f2 = jnp.asarray(rng.normal(size=(1, n2, 8)).astype(np.float32))
+        xyz2 = jnp.asarray(rng.normal(size=(1, n2, 3)).astype(np.float32))
+        full = corr_init(f1, f2, xyz2, k)
+        chunked = corr_init(f1, f2, xyz2, k, chunk=chunk)
+        np.testing.assert_allclose(
+            np.asarray(full.corr), np.asarray(chunked.corr), atol=1e-5,
+            err_msg=f"trial {trial}: n1={n1} n2={n2} k={k} chunk={chunk}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(full.xyz), np.asarray(chunked.xyz), atol=1e-5,
+            err_msg=f"trial {trial}: n1={n1} n2={n2} k={k} chunk={chunk}",
+        )

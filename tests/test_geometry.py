@@ -94,3 +94,29 @@ def test_chunked_graph_in_model():
     f1, _ = PVRaft(cfg).apply(params, xyz1, xyz2, num_iters=2)
     f2, _ = PVRaft(cfgc).apply(params, xyz1, xyz2, num_iters=2)
     np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), atol=1e-4)
+
+
+def test_chunked_knn_randomized_shapes():
+    """Streaming kNN sweep over random (Nq, Np, k, chunk): the chunked
+    merge must reproduce the dense result exactly (continuous random
+    coordinates make ties improbable, so order must match too)."""
+    rng = np.random.default_rng(321)
+    for trial in range(8):
+        nq = int(rng.integers(4, 40))
+        npts = int(rng.choice([32, 48, 64, 96]))
+        k = int(rng.integers(2, 13))
+        # c < npts keeps trials genuinely chunked; chunk < k (the
+        # sentinel-merge edge) is supported and deliberately included.
+        divisors = [c for c in (4, 8, 16, 24, 32, 48)
+                    if npts % c == 0 and c < npts]
+        if not divisors:
+            continue
+        chunk = int(rng.choice(divisors))
+        q = jnp.asarray(rng.normal(size=(1, nq, 3)).astype(np.float32))
+        p = jnp.asarray(rng.normal(size=(1, npts, 3)).astype(np.float32))
+        full = np.asarray(knn_indices(q, p, k))
+        chunked = np.asarray(knn_indices(q, p, k, chunk=chunk))
+        np.testing.assert_array_equal(
+            full, chunked,
+            err_msg=f"trial {trial}: nq={nq} np={npts} k={k} chunk={chunk}",
+        )
