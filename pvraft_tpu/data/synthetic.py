@@ -25,6 +25,14 @@ def _random_rotation(rng: np.random.Generator, max_angle: float) -> np.ndarray:
 
 
 class SyntheticDataset(SceneFlowDataset):
+    """``n_objects=1`` (default): one global rigid transform — the original
+    fixture every committed trajectory/threshold is calibrated on.
+    ``n_objects>1``: FT3D-like scenes — points cluster into spatial blobs,
+    each moved by its OWN rigid transform, so the flow field is only
+    piecewise rigid and the correlation volume must disambiguate
+    independently moving objects (the structure of FT3D's multi-object
+    scenes, ``datasets/flyingthings3d_hplflownet.py`` data)."""
+
     def __init__(
         self,
         size: int = 64,
@@ -34,6 +42,7 @@ class SyntheticDataset(SceneFlowDataset):
         max_shift: float = 0.3,
         noise: float = 0.0,
         seed: int = 0,
+        n_objects: int = 1,
     ):
         super().__init__(nb_points=nb_points, seed=seed)
         self.size = size
@@ -42,6 +51,9 @@ class SyntheticDataset(SceneFlowDataset):
         self.max_shift = max_shift
         self.noise = noise
         self.seed = seed
+        if n_objects < 1:
+            raise ValueError(f"n_objects must be >= 1, got {n_objects}")
+        self.n_objects = n_objects
 
     def __len__(self) -> int:
         return self.size
@@ -49,12 +61,32 @@ class SyntheticDataset(SceneFlowDataset):
     def load_sequence(self, idx: int):
         rng = np.random.default_rng(self.seed * 100003 + idx)
         n = self.nb_points + (rng.integers(0, self.extra_points + 1) if self.extra_points else 0)
-        pc1 = rng.uniform(-1.0, 1.0, size=(n, 3)).astype(np.float32)
-        rot = _random_rotation(rng, self.max_angle)
-        shift = rng.uniform(-self.max_shift, self.max_shift, size=3).astype(np.float32)
-        pc2 = pc1 @ rot.T + shift
+        if self.n_objects == 1:
+            pc1 = rng.uniform(-1.0, 1.0, size=(n, 3)).astype(np.float32)
+            rot = _random_rotation(rng, self.max_angle)
+            shift = rng.uniform(-self.max_shift, self.max_shift, size=3)
+            pc2 = pc1 @ rot.T + shift.astype(np.float32)
+        else:
+            # Blobs around random centers, each with its own rigid motion.
+            # Rotation is applied about the object's center (a rotation
+            # about the origin would fling off-center blobs far away).
+            counts = np.full(self.n_objects, n // self.n_objects)
+            counts[: n % self.n_objects] += 1
+            parts1, parts2 = [], []
+            for c in counts:
+                center = rng.uniform(-0.8, 0.8, size=3).astype(np.float32)
+                blob = (center + rng.normal(0, 0.2, size=(c, 3))).astype(
+                    np.float32)
+                rot = _random_rotation(rng, self.max_angle)
+                shift = rng.uniform(-self.max_shift, self.max_shift, size=3)
+                moved = (blob - center) @ rot.T + center + shift
+                parts1.append(blob)
+                parts2.append(moved.astype(np.float32))
+            order = rng.permutation(n)  # no block structure in the index
+            pc1 = np.concatenate(parts1)[order]
+            pc2 = np.concatenate(parts2)[order]
         if self.noise:
             pc2 = pc2 + rng.normal(0, self.noise, size=pc2.shape).astype(np.float32)
         flow = (pc2 - pc1).astype(np.float32)
         mask = np.ones((n,), np.float32)
-        return pc1, pc2.astype(np.float32), mask, flow
+        return pc1.astype(np.float32), pc2.astype(np.float32), mask, flow

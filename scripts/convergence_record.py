@@ -71,7 +71,8 @@ def quarters_nonincreasing(traj):
 
 
 def run_variant(name: str, kwargs: dict, steps: int, n_points: int,
-                batch: int, truncate_k: int, iters: int, log_every: int):
+                batch: int, truncate_k: int, iters: int, log_every: int,
+                n_objects: int = 1):
     import jax
     import jax.numpy as jnp
     import optax
@@ -82,7 +83,8 @@ def run_variant(name: str, kwargs: dict, steps: int, n_points: int,
 
     cfg = ModelConfig(truncate_k=truncate_k, **kwargs)
     model = PVRaft(cfg)
-    ds = SyntheticDataset(size=64, nb_points=n_points, noise=0.01, seed=0)
+    ds = SyntheticDataset(size=64, nb_points=n_points, noise=0.01, seed=0,
+                          n_objects=n_objects)
     loader = PrefetchLoader(ds, batch, shuffle=True, num_workers=2, seed=0)
 
     sample = next(iter(loader.epoch(0)))
@@ -157,6 +159,10 @@ def main() -> int:
     ap.add_argument("--truncate_k", type=int, default=256)
     ap.add_argument("--iters", type=int, default=4)
     ap.add_argument("--log_every", type=int, default=10)
+    ap.add_argument("--objects", type=int, default=1,
+                    help="independently moving rigid objects per scene "
+                         "(FT3D-like piecewise-rigid flow when > 1; "
+                         "thresholds are calibrated for 1)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU backend (config API — env vars are "
                          "overridden by the TPU plugin's sitecustomize)")
@@ -189,14 +195,15 @@ def main() -> int:
 
     results = [
         run_variant(name, kw, steps, args.points, args.batch,
-                    args.truncate_k, args.iters, args.log_every)
+                    args.truncate_k, args.iters, args.log_every,
+                    n_objects=args.objects)
         for name, kw in variants
     ]
 
     record = make_record(platform,
                          {"points": args.points, "batch": args.batch,
                           "truncate_k": args.truncate_k, "iters": args.iters,
-                          "steps": steps},
+                          "steps": steps, "n_objects": args.objects},
                          results)
     return write_and_report(record, args.out)
 
