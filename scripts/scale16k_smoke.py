@@ -39,8 +39,31 @@ print(f"init {time.time()-t0:.0f}s", flush=True)
 t0 = time.time()
 flows, _ = jax.jit(lambda p, a, b: model.apply(p, a, b, 2))(params, pc1, pc2)
 jax.block_until_ready(flows)
-print(f"16k fwd ok ({jax.devices()[0].platform}): {flows.shape} "
-      f"finite={bool(np.isfinite(np.asarray(flows)).all())} {time.time()-t0:.0f}s")
+wall = time.time() - t0
+platform = jax.devices()[0].platform
+finite = bool(np.isfinite(np.asarray(flows)).all())
+print(f"16k fwd ok ({platform}): {flows.shape} finite={finite} {wall:.0f}s")
+
+# Committed long-context evidence (VERDICT r2 item 9): one JSON per
+# platform so the CPU and TPU legs don't clobber each other.
+import json
+
+record = {"platform": platform, "points": n, "iters": 2,
+          "truncate_k": cfg.truncate_k, "corr_chunk": cfg.corr_chunk,
+          "graph_chunk": cfg.graph_chunk, "remat": True,
+          "use_pallas": False, "finite": finite,
+          # First jitted call: trace+compile+execute. The claim this
+          # artifact makes is feasibility (the 16k program compiles and
+          # produces finite flows), not steady-state throughput.
+          "fwd_first_call_s": round(wall, 1),
+          "includes_compile": True, "ok": finite}
+out = f"artifacts/scale16k_{platform}.json"
+os.makedirs("artifacts", exist_ok=True)
+with open(out, "w") as f:
+    json.dump(record, f, indent=1)
+print(json.dumps(record))
+if not finite:
+    sys.exit(1)
 
 if "--sp" in sys.argv:
     # Sequence-parallel training step at 16k points: the ppermute-ring
@@ -80,5 +103,21 @@ if "--sp" in sys.argv:
         pr, opr, batch["pc1"], batch["pc2"], batch["mask"], batch["gt"]
     )
     jax.block_until_ready(loss)
-    print(f"16k seq-parallel train step ok: loss={float(loss):.4f} "
-          f"{time.time()-t0:.0f}s")
+    sp_wall = time.time() - t0
+    sp_loss = float(loss)
+    print(f"16k seq-parallel train step ok: loss={sp_loss:.4f} "
+          f"{sp_wall:.0f}s")
+    record["seq_parallel"] = {
+        "mesh": "1x8 (data x seq)",
+        # The SP leg's actual config differs from the top-level record:
+        # the ppermute ring replaces chunked correlation entirely.
+        "corr_chunk": None, "seq_shard": True,
+        "train_step_first_call_s": round(sp_wall, 1),
+        "includes_compile": True,
+        "loss": round(sp_loss, 4), "finite": bool(np.isfinite(sp_loss)),
+    }
+    record["ok"] = record["ok"] and record["seq_parallel"]["finite"]
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+    if not record["ok"]:
+        sys.exit(1)
