@@ -77,6 +77,9 @@ class DataConfig:
     max_points: int = 8192         # exact-N sampling target
     num_workers: int = 8           # host-side prefetch threads
     synthetic_size: int = 64       # samples in the synthetic dataset
+    # Independently moving rigid objects per synthetic scene (1 = one
+    # global transform; >1 = FT3D-like piecewise-rigid flow).
+    synthetic_objects: int = 1
     # Use the C++ batch assembler (pvraft_tpu/native) when the dataset
     # supports it and the library builds; falls back to numpy otherwise.
     native_loader: bool = True

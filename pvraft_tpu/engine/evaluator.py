@@ -38,7 +38,8 @@ def build_eval_dataset(cfg: Config):
         return KITTI(d.root, d.max_points, strict_sizes=d.strict_sizes)
     if d.dataset == "synthetic":
         return SyntheticDataset(size=d.synthetic_size, nb_points=d.max_points,
-                                noise=0.01, seed=2)
+                                noise=0.01, seed=2,
+                                n_objects=d.synthetic_objects)
     raise ValueError(f"unknown dataset {d.dataset!r}")
 
 

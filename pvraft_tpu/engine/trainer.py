@@ -64,7 +64,8 @@ def build_datasets(cfg: Config):
     d = cfg.data
     if d.dataset == "synthetic":
         mk = lambda seed: SyntheticDataset(
-            size=d.synthetic_size, nb_points=d.max_points, noise=0.01, seed=seed
+            size=d.synthetic_size, nb_points=d.max_points, noise=0.01,
+            seed=seed, n_objects=d.synthetic_objects,
         )
         return mk(0), mk(1), mk(2)
     if d.dataset == "FT3D":
