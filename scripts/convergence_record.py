@@ -218,8 +218,12 @@ def make_record(platform: str, config: dict, results: list) -> dict:
     # keep the looser pre-calibration 0.5 rel factor there.
     rel_thr = EPE_REL_THRESHOLD if steps >= 100 else 0.5
     quarters = quarters_nonincreasing(fp32["trajectory"])
+    # The absolute floor is calibrated on the 1-object generator; multi-
+    # object (piecewise-rigid) scenes are a harder task with a different
+    # floor, so they are judged on the relative/shape gates only.
+    abs_applies = steps >= 100 and config.get("n_objects", 1) == 1
     checks = {
-        "fp32_abs": tb32 <= EPE_ABS_THRESHOLD or steps < 100,
+        "fp32_abs": tb32 <= EPE_ABS_THRESHOLD or not abs_applies,
         "fp32_rel": tb32 <= rel_thr * fp32["initial_epe"],
         "fp32_quarters_nonincreasing": True if quarters is None else quarters,
         "fast_matches_fp32": tbf <= FAST_VARIANT_RATIO * max(tb32, 1e-3),
