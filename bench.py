@@ -156,6 +156,12 @@ def _child_variant(name: str) -> None:
     n_steps = 10 if platform != "cpu" else 2
     strategy = "pytree"
     dt = time_pytree(2 if platform != "cpu" else n_steps)
+    if platform == "cpu":
+        # Repeat the measurement so the artifact records run-to-run spread
+        # (a ~10% round-over-round drift in the CPU fallback was
+        # unclassifiable as noise vs regression without it — round-3
+        # verdict). Each rep re-times the SAME chained loop.
+        dt_reps = [dt, time_pytree(n_steps)]
     if platform != "cpu" and dt > 0.5:
         # Chained-dispatch overhead detected (device step time is single-
         # digit ms at this config — BENCHMARKS.md): retime with the packed
@@ -171,11 +177,20 @@ def _child_variant(name: str) -> None:
         )
         flat, m = pstep(flat, batch)  # warmup/compile
         jax.block_until_ready(m["loss"])
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            flat, m = pstep(flat, batch)
-        jax.block_until_ready(m["loss"])
-        dt_packed = (time.perf_counter() - t0) / n_steps
+
+        def time_packed(n, roundtrip=False):
+            nonlocal flat
+            t0 = time.perf_counter()
+            for _ in range(n):
+                if roundtrip:
+                    # D2H (sync point) + fresh H2D: breaks the chained-
+                    # executable dependency through the host.
+                    flat = jnp.asarray(np.asarray(flat))
+                flat, m = pstep(flat, batch)
+            jax.block_until_ready(m["loss"])
+            return (time.perf_counter() - t0) / n
+
+        dt_packed = time_packed(n_steps)
         if dt_packed < dt:
             strategy, dt = "packed", dt_packed
         else:
@@ -189,18 +204,28 @@ def _child_variant(name: str) -> None:
             # than the multi-second chained dispatch, and the loop is
             # still a true training loop — identical floats, state
             # evolving every step, fresh (non-chained) device input.
-            t0 = time.perf_counter()
-            for _ in range(n_steps):
-                host = np.asarray(flat)         # D2H (sync point)
-                flat, m = pstep(jnp.asarray(host), batch)
-            jax.block_until_ready(m["loss"])
-            dt_rt = (time.perf_counter() - t0) / n_steps
+            dt_rt = time_packed(n_steps, roundtrip=True)
             if dt_rt < dt:
                 strategy, dt = "packed_host_roundtrip", dt_rt
     elif platform != "cpu":
         dt = time_pytree(n_steps)
-    print(json.dumps({"ok": True, "dt": dt, "platform": platform,
-                      "strategy": strategy,
+    if platform != "cpu":
+        # Second rep of the CHOSEN strategy so the artifact records
+        # run-to-run spread (same rationale as the CPU branch above).
+        if strategy == "pytree":
+            dt2 = time_pytree(n_steps)
+        else:
+            dt2 = time_packed(n_steps,
+                              roundtrip=strategy == "packed_host_roundtrip")
+        dt_reps = [dt, dt2]
+    dt_mean = sum(dt_reps) / len(dt_reps)
+    spread = (max(dt_reps) - min(dt_reps)) / max(dt_mean, 1e-12)
+    print(json.dumps({"ok": True, "dt": dt_mean,
+                      "dt_reps": [round(d, 4) for d in dt_reps],
+                      "dt_spread": round(spread, 4),
+                      "timing_reps": len(dt_reps),
+                      "steps_per_rep": n_steps,
+                      "platform": platform, "strategy": strategy,
                       "points": N_POINTS, "batch": BATCH, "iters": ITERS}))
 
 
@@ -409,6 +434,11 @@ def main() -> None:
              "unit": _unit(points, iters, batch)}  # overrides the default
     if res.get("strategy") and res["strategy"] != "pytree":
         extra["step_strategy"] = res["strategy"]
+    # Repeat spread: lets a future reader classify round-over-round drift
+    # as measurement noise vs regression (round-3 verdict weak #1).
+    for k in ("dt_reps", "dt_spread", "timing_reps", "steps_per_rep"):
+        if k in res:
+            extra[k] = res[k]
     if not comparable:
         extra["baseline_note"] = (
             "measured config differs from the baseline config; "

@@ -57,10 +57,18 @@ class PrefetchLoader:
         native_max_rows: int = 400_000,
         shard: tuple = (0, 1),
     ):
-        """``shard=(rank, world)`` gives this loader every ``world``-th
-        sample starting at ``rank`` (after the seeded shuffle, which is
-        identical across ranks): the multi-host split of an epoch, the role
-        torch's DistributedSampler plays. Default (0, 1) = all samples."""
+        """``shard=(rank, world)`` gives this loader rank ``rank``'s
+        ``batch_size``-row block of every global batch (after the seeded
+        shuffle, which is identical across ranks): the multi-host split of
+        an epoch, the role torch's DistributedSampler plays. Block-cyclic
+        rather than element-strided on purpose — the global batch that
+        ``make_array_from_process_local_data`` assembles then holds the
+        SAME rows on the SAME devices as a single-process run of the same
+        global batch size. Per-batch math is then identical up to the
+        cross-process collective runtime's reduction order (~1e-7 —
+        tests/test_two_process.py asserts the Adam-amplified bound),
+        instead of differing by a whole row-permutation of the batch.
+        Default (0, 1) = all samples."""
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -83,7 +91,12 @@ class PrefetchLoader:
                 self.native = False
 
     def __len__(self) -> int:
-        n = len(self.dataset) // self.shard[1]  # identical on every rank
+        world = self.shard[1]
+        if world > 1:
+            # Only full GLOBAL batches survive the shard split (see
+            # epoch()); identical on every rank by construction.
+            return len(self.dataset) // (self.batch_size * world)
+        n = len(self.dataset)
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def epoch(self, epoch: int = 0) -> Iterator[Item]:
@@ -93,11 +106,17 @@ class PrefetchLoader:
             np.random.default_rng((self.seed, epoch)).shuffle(order)
         rank, world = self.shard
         if world > 1:
-            # Truncate to a multiple of world BEFORE slicing so every rank
+            # Truncate to FULL GLOBAL batches before slicing so every rank
             # sees the same batch count per epoch — ranks running different
             # step counts would deadlock the collectives and desynchronize
-            # the LR schedule across hosts.
-            order = order[: (len(order) // world) * world][rank::world]
+            # the LR schedule across hosts. Block-cyclic slice: rank r
+            # takes rows [r*L, (r+1)*L) of each global batch of
+            # G = batch_size * world rows (see __init__ docstring for why
+            # not [rank::world]).
+            g = self.batch_size * world
+            n_full = (len(order) // g) * g
+            order = (order[:n_full].reshape(-1, world, self.batch_size)
+                     [:, rank, :].reshape(-1))
         starts = list(range(0, len(order), self.batch_size))
         if self.drop_last:
             starts = [s for s in starts if s + self.batch_size <= len(order)]

@@ -132,6 +132,18 @@ def wait_for_saves() -> None:
         _sync_hosts("pvraft-ckpt-promote")
 
 
+def _sidecar_debts(meta) -> list:
+    """Normalize a sidecar payload to a list of ``{"epoch", "extras"}``
+    debts (current shape: ``{"debts": [...]}``; legacy shapes accepted)."""
+    if isinstance(meta, dict) and "debts" in meta:
+        return [d for d in meta["debts"] if isinstance(d, dict)]
+    if isinstance(meta, dict):
+        return [{"epoch": meta.get("epoch"), "extras": meta.get("extras", [])}]
+    if isinstance(meta, list):
+        return [{"epoch": None, "extras": meta}]
+    return []
+
+
 def _recover_leftover_tmp(dst: str) -> None:
     """Promote a committed-but-unpromoted tmp directory left by a run that
     died before its deferred promote (orbax's own commit is an atomic
@@ -161,18 +173,48 @@ def _recover_leftover_tmp(dst: str) -> None:
                     meta = json.load(f)
             except (OSError, ValueError):
                 meta = {}
-            extras = meta.get("extras", []) if isinstance(meta, dict) else meta
-            owed_epoch = meta.get("epoch") if isinstance(meta, dict) else None
-            if extras and os.path.isdir(dst):
+            debts = _sidecar_debts(meta)
+            unresolved = []
+            if debts and os.path.isdir(dst):
                 dst_epoch = None
-                try:
-                    dst_epoch = int(_orbax().restore(
-                        os.path.abspath(dst))["epoch"])
-                except Exception:
-                    pass
-                if owed_epoch is None or dst_epoch == owed_epoch:
-                    _copy_extras(dst, extras)
-            os.unlink(sidecar)
+                for _ in range(2):  # one retry absorbs transient failures
+                    try:
+                        dst_epoch = int(_orbax().restore(
+                            os.path.abspath(dst))["epoch"])
+                        break
+                    except Exception:
+                        continue
+                for debt in debts:
+                    owed_epoch = debt.get("epoch")
+                    extras = debt.get("extras", [])
+                    if not extras:
+                        continue
+                    if owed_epoch is None or dst_epoch == owed_epoch:
+                        _copy_extras(dst, extras)
+                    elif dst_epoch is None:
+                        # dst exists but its epoch could not be read
+                        # (persistent restore failure): keep the debt so a
+                        # LATER recovery can still deliver the owed copies
+                        # — unlinking here would drop them silently. The
+                        # next _orbax_write appends its own debt to this
+                        # sidecar rather than clobbering it; the debt dies
+                        # only when dst is readable with a different epoch
+                        # (the owed payload is genuinely gone).
+                        unresolved.append(debt)
+                    # else: dst readable but a different epoch — the owed
+                    # payload never committed (or was since replaced);
+                    # the debt is undeliverable, retire it.
+            if unresolved:
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint recovery: could not read epoch from {dst}; "
+                    f"keeping {len(unresolved)} unresolved debt(s) in "
+                    f"{sidecar} for a later attempt")
+                with open(sidecar, "w") as f:
+                    json.dump({"debts": unresolved}, f)
+            else:
+                os.unlink(sidecar)
         old = dst + ".old"
         if os.path.isdir(old):
             if os.path.isdir(dst):
@@ -217,9 +259,20 @@ def _orbax_write(path: str, payload: Dict[str, Any], extras=()) -> None:
         # recovery verify dst actually holds the owed payload.
         import json
 
+        # abspath: the recovering run may start from a different cwd; a
+        # relative extras path would re-create NNN/best somewhere else.
+        # Append to (never clobber) debts a failed recovery kept above.
+        debts = []
+        if os.path.isfile(tmp + ".extras.json"):
+            try:
+                with open(tmp + ".extras.json") as f:
+                    debts = _sidecar_debts(json.load(f))
+            except (OSError, ValueError):
+                debts = []
+        debts.append({"epoch": int(payload["epoch"]),
+                      "extras": [os.path.abspath(e) for e in extras]})
         with open(tmp + ".extras.json", "w") as f:
-            json.dump({"epoch": int(payload["epoch"]),
-                       "extras": list(extras)}, f)
+            json.dump({"debts": debts}, f)
     _orbax().save(os.path.abspath(tmp), args=ocp.args.StandardSave(payload))
     _orbax_pending.append((tmp, path, list(extras)))
 
@@ -263,15 +316,27 @@ def save_checkpoint(
             # Without a shared filesystem, the process-0-only write means
             # every other host has no checkpoint and a later resume would
             # silently diverge (host 0 at epoch N, the rest from scratch).
-            # Gather visibility so EVERY process raises together — a
+            # Barrier FIRST so no process samples the filesystem before
+            # process 0's writes complete (an allgather synchronizes the
+            # exchange of values, not when each process sampled its value
+            # — sampling pre-barrier is a TOCTOU race), THEN sample with a
+            # short bounded retry for FS attribute-cache propagation, THEN
+            # gather visibility so EVERY process raises together — a
             # single-process raise would leave the others blocking in the
             # next collective (a distributed hang, not a clean error).
-            # The allgather doubles as the write-completion barrier.
+            import time
+
             from jax.experimental import multihost_utils
 
-            visible = multihost_utils.process_allgather(
-                np.asarray([os.path.exists(paths[0])])
-            )
+            multihost_utils.sync_global_devices(
+                f"pvraft-msgpack-written-{epoch}")
+            seen = os.path.exists(paths[0])
+            for _ in range(10):
+                if seen:
+                    break
+                time.sleep(0.5)
+                seen = os.path.exists(paths[0])
+            visible = multihost_utils.process_allgather(np.asarray([seen]))
             if not bool(np.asarray(visible).all()):
                 raise RuntimeError(
                     f"msgpack checkpoint {paths[0]} written by process 0 "

@@ -222,6 +222,16 @@ class Trainer:
         # train state; ~7 MB for the flagship model — dwarfed by
         # activations, so not offloaded).
         self.packed = cfg.parallel.packed_state
+        if cfg.parallel.host_roundtrip and jax.process_count() > 1:
+            # The per-step np.asarray(self.flat) requires the whole flat
+            # buffer to be process-addressable; on a multi-host mesh it is
+            # not, and the failure would be an opaque mid-epoch error. The
+            # flag only makes sense on single-host remote-dispatch tunnels.
+            raise ValueError(
+                "parallel.host_roundtrip is single-host only (it round-trips "
+                "the full train state through this process's host memory); "
+                "disable it on multi-host meshes"
+            )
         if self.packed:
             self.packed_step, self.flat, self.unravel = make_packed_train_step(
                 self.model, tx, cfg.train.gamma, cfg.train.iters,

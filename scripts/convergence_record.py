@@ -39,8 +39,29 @@ import numpy as np
 # non-increasing (5% noise tolerance), rejecting diverging or
 # late-regressing trajectories that a final-value test can miss.
 EPE_ABS_THRESHOLD = 0.25
+# Multi-object (piecewise-rigid) is a harder task with its own floor:
+# calibrated against the committed 120-step run
+# (artifacts/convergence_cpu_multiobj.json: fp32 tail-best 0.2431).
+EPE_ABS_THRESHOLD_MULTIOBJ = 0.30
 EPE_REL_THRESHOLD = 0.2          # tail-best <= 0.2 x initial
 FAST_VARIANT_RATIO = 1.6         # bf16 tail-best <= 1.6 x fp32 tail-best
+
+# Calibration provenance (also embedded in every artifact): these gates
+# were set from this repo's own committed baseline runs, sitting just
+# above each observed converged floor. They are REGRESSION TRIPWIRES —
+# "the model still converges like the committed baseline" — not
+# independent accuracy evidence; the independent evidence is the
+# reference-parity suite (tests/test_reference_parity.py,
+# tests/test_protocol_parity.py, tests/test_grad_parity.py).
+CALIBRATION = {
+    "epe_abs": "0.25: just above the 200-step fp32 floor 0.2216 of "
+               "artifacts/convergence_cpu.json (1-object, 2048 pts)",
+    "epe_abs_multiobj": "0.30: just above the 120-step fp32 floor 0.2431 "
+                        "of artifacts/convergence_cpu_multiobj.json",
+    "epe_rel": "0.2: requires a 5x drop; the committed 200-step run drops "
+               "8.2x",
+    "fast_ratio": "1.6: committed bf16/fp32 tail-best ratios are 0.87-1.04",
+}
 
 
 def tail_best(traj) -> float:
@@ -214,32 +235,37 @@ def make_record(platform: str, config: dict, results: list) -> dict:
     tb32, tbf = tail_best(fp32["trajectory"]), tail_best(fastr["trajectory"])
     fp32["tail_best_epe"], fastr["tail_best_epe"] = tb32, tbf
     # Short smoke runs (< 100 steps) haven't converged and log too few
-    # entries for tail-best to smooth spikes: exempt the abs gate and
-    # keep the looser pre-calibration 0.5 rel factor there.
+    # entries for tail-best to smooth spikes: the abs gate does not apply
+    # and the rel gate keeps the looser pre-calibration 0.5 factor.
     rel_thr = EPE_REL_THRESHOLD if steps >= 100 else 0.5
     quarters = quarters_nonincreasing(fp32["trajectory"])
-    # The absolute floor is calibrated on the 1-object generator; multi-
-    # object (piecewise-rigid) scenes are a harder task with a different
-    # floor, so they are judged on the relative/shape gates only.
-    abs_applies = steps >= 100 and config.get("n_objects", 1) == 1
+    # Each generator family gets the absolute floor calibrated on ITS OWN
+    # committed baseline (see CALIBRATION).
+    multiobj = config.get("n_objects", 1) > 1
+    abs_thr = EPE_ABS_THRESHOLD_MULTIOBJ if multiobj else EPE_ABS_THRESHOLD
+    # A check that did not apply records "n/a", never a vacuous True; the
+    # aggregate `ok` is all(applied checks) and `applied_checks` names them
+    # (round-3 verdict: green-for-checks-that-never-ran is misleading).
     checks = {
-        "fp32_abs": tb32 <= EPE_ABS_THRESHOLD or not abs_applies,
+        "fp32_abs": tb32 <= abs_thr if steps >= 100 else "n/a",
         "fp32_rel": tb32 <= rel_thr * fp32["initial_epe"],
-        "fp32_quarters_nonincreasing": True if quarters is None else quarters,
+        "fp32_quarters_nonincreasing": "n/a" if quarters is None else quarters,
         "fast_matches_fp32": tbf <= FAST_VARIANT_RATIO * max(tb32, 1e-3),
     }
+    applied = [k for k, v in checks.items() if v != "n/a"]
     return {
         "platform": platform,
         "config": config,
-        "thresholds": {"epe_abs": EPE_ABS_THRESHOLD,
+        "thresholds": {"epe_abs": abs_thr,
                        "epe_rel": EPE_REL_THRESHOLD,
                        "fast_ratio": FAST_VARIANT_RATIO,
                        "gate": "tail-best EPE (last-quarter min); "
                                "quarter medians non-increasing"},
+        "calibration": CALIBRATION,
         "results": results,
         "checks": checks,
-        "quarters_check_applied": quarters is not None,
-        "ok": all(checks.values()),
+        "applied_checks": applied,
+        "ok": all(checks[k] for k in applied),
     }
 
 
@@ -247,6 +273,11 @@ def write_and_report(record: dict, path: str) -> int:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as f:
         json.dump(record, f, indent=1)
+    # A passing record supersedes any earlier failing recheck's side file;
+    # leaving it would read as failure evidence against a green artifact.
+    stale = path + ".recheck_failed.json"
+    if os.path.isfile(stale):
+        os.unlink(stale)
     print(json.dumps({k: v for k, v in record.items() if k != "results"}))
     return 0 if record["ok"] else 1
 
@@ -261,8 +292,14 @@ def recheck(path: str) -> int:
     record = make_record(old["platform"], old["config"], old["results"])
     record["rechecked"] = True
     if not record["ok"]:
+        # Keep the committed evidence, but persist the failing re-derived
+        # record beside it so the failure is inspectable, not just printed.
+        side = path + ".recheck_failed.json"
+        with open(side, "w") as f:
+            json.dump(record, f, indent=1)
         print(json.dumps({k: v for k, v in record.items() if k != "results"}))
-        print(f"recheck failed; {path} left untouched", file=sys.stderr)
+        print(f"recheck failed; {path} left untouched, failing record "
+              f"written to {side}", file=sys.stderr)
         return 1
     return write_and_report(record, path)
 

@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""End-to-end eval-protocol parity vs the reference implementation.
+
+Runs BOTH full eval pipelines over the same on-disk FT3D-layout scenes with
+identical weights and compares the four final running-mean metrics plus the
+mean loss:
+
+  * reference side: the ACTUAL reference code path — ``datasets/
+    flyingthings3d_hplflownet.py::FT3D`` (its ``__getitem__`` subsampling,
+    ``generic.py:95-110``), ``Batch`` collate, torch ``DataLoader`` bs=1,
+    ``RSF`` forward at 32 GRU iterations, ``tools/loss.py::sequence_loss``
+    and ``tools/metric.py::compute_epe`` accumulated exactly like
+    ``test.py:110-156`` (``np.array(xs).mean()`` over per-scene values);
+  * our side: ``pvraft_tpu.engine.evaluator.Evaluator`` over the same root
+    directory, weights imported through ``load_torch_checkpoint`` from a
+    real ``.params`` file written by the torch model.
+
+This upgrades parity evidence from "model forward" to "whole pipeline
+including dataset load, subsampling, the 32-iter loop, and metric
+accumulation" — the strongest FT3D-EPE de-risk available without the
+dataset itself (no network access here).
+
+Scenes are generated with EXACTLY ``nb_points`` points so the reference's
+``np.random.permutation(N)[:nb_points]`` and our per-(seed,epoch,idx)
+sampler both reduce to permutations of the same point set: the two
+pipelines then evaluate identical scenes (metrics are means over point
+sets, which are permutation-invariant up to fp reassociation). Ground-truth
+flow magnitudes are drawn from bands with >=0.02 margin around every
+threshold the Acc3DS/Acc3DR/Outliers metrics test (0.05/0.1/0.3 absolute,
+0.05/0.1 relative — ``tools/metric.py:70-78``), so fp-order noise cannot
+flip a point's classification and the threshold metrics must agree
+EXACTLY, not just within tolerance.
+
+CPU-only by design (runs in the slow test tier and as an artifact
+producer): ``python scripts/protocol_parity.py --out
+artifacts/protocol_parity.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import types
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+REF_ROOT = "/root/reference"
+
+
+def _pin_cpu():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def install_reference(ref_root: str = REF_ROOT):
+    """Make the reference package importable with the torch-scatter shim
+    (the CUDA extension at ``model/corr.py:50`` is not installable here;
+    the shim reproduces its documented contract)."""
+    import torch
+
+    if "torch_scatter" not in sys.modules:
+        shim = types.ModuleType("torch_scatter")
+
+        def scatter_add(src, index, dim=-1, dim_size=None):
+            n = int(index.max()) + 1 if dim_size is None else dim_size
+            shape = list(src.shape)
+            shape[dim] = n
+            out = torch.zeros(shape, dtype=src.dtype, device=src.device)
+            return out.scatter_add_(dim, index, src)
+
+        shim.scatter_add = scatter_add
+        sys.modules["torch_scatter"] = shim
+    if ref_root not in sys.path:
+        sys.path.insert(0, ref_root)
+    # tools/metric.py:73-78 uses np.float, removed in numpy>=1.24; restore
+    # the alias so the reference's own metric code runs unmodified.
+    if not hasattr(np, "float"):
+        np.float = float  # noqa: NPY001
+
+
+def load_reference_datasets(ref_root: str = REF_ROOT):
+    """Load the reference ``datasets/`` modules by file path.
+
+    ``import datasets`` cannot be used: the reference ships ``datasets`` as
+    an ``__init__``-less namespace package, and Python resolves a REGULAR
+    package of the same name anywhere on sys.path (here: HuggingFace
+    ``datasets`` in site-packages) ahead of every namespace package
+    regardless of path order. A synthetic package anchor keeps the
+    reference's own relative imports (``from .generic import ...``)
+    working unmodified."""
+    import importlib.util
+
+    pkg_name = "ref_datasets"
+    if pkg_name + ".flyingthings3d_hplflownet" in sys.modules:
+        return {
+            "generic": sys.modules[pkg_name + ".generic"],
+            "flyingthings3d_hplflownet":
+                sys.modules[pkg_name + ".flyingthings3d_hplflownet"],
+        }
+    pkg = types.ModuleType(pkg_name)
+    pkg.__path__ = [os.path.join(ref_root, "datasets")]
+    sys.modules[pkg_name] = pkg
+    out = {}
+    for mod in ("generic", "flyingthings3d_hplflownet"):
+        spec = importlib.util.spec_from_file_location(
+            f"{pkg_name}.{mod}", os.path.join(ref_root, "datasets",
+                                              f"{mod}.py"))
+        m = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = m
+        spec.loader.exec_module(m)
+        out[mod] = m
+    return out
+
+
+def make_scene_root(root: str, n_scenes: int, n_points: int, seed: int) -> str:
+    """Write an FT3D-test-layout directory tree (``val/0*`` scene dirs of
+    ``pc1.npy``/``pc2.npy``, the format both datasets read) with
+    threshold-margin flow magnitudes.
+
+    The on-disk clouds are pre-flip (both loaders negate x and z on load,
+    ``flyingthings3d_hplflownet.py:100-102``). gt flow = pc2 - pc1 with
+    index-aligned points (``:104-107``)."""
+    rng = np.random.default_rng(seed)
+    val = os.path.join(root, "val")
+    os.makedirs(val, exist_ok=True)
+    for s in range(n_scenes):
+        pc1 = rng.uniform(-2.0, 2.0, (n_points, 3)).astype(np.float32)
+        # Flow magnitude bands, each >=0.02 from the 0.05/0.1/0.3 absolute
+        # thresholds: tiny (strict+relax hit), small (relax hit), medium
+        # (no hit, not outlier by l2), large (l2 outlier). Note with a
+        # random-init model the PREDICTED flow also moves each point's
+        # error; margins are re-checked empirically by the caller, which
+        # asserts the reference and our pipeline classify identically.
+        mags = rng.choice([0.02, 0.075, 0.2, 0.5], size=n_points,
+                          p=[0.3, 0.3, 0.2, 0.2])
+        dirs = rng.normal(size=(n_points, 3)).astype(np.float32)
+        dirs /= np.linalg.norm(dirs, axis=-1, keepdims=True) + 1e-12
+        flow = (mags[:, None] * dirs).astype(np.float32)
+        pc2 = pc1 + flow
+        scene = os.path.join(val, f"{s:07d}")
+        os.makedirs(scene, exist_ok=True)
+        np.save(os.path.join(scene, "pc1.npy"), pc1)
+        np.save(os.path.join(scene, "pc2.npy"), pc2)
+    return root
+
+
+def reference_eval(root: str, weights: str, n_points: int, iters: int = 32,
+                   truncate_k: int = 64):
+    """The reference standalone eval loop (``test.py:82-156``) on CPU:
+    FT3D(mode='test') -> DataLoader(bs=1, collate_fn=Batch) -> RSF at
+    ``iters`` GRU iterations -> sequence_loss + compute_epe running means."""
+    import torch
+    from torch.utils.data import DataLoader
+
+    install_reference()
+    ref_ds = load_reference_datasets()
+    RefFT3D = ref_ds["flyingthings3d_hplflownet"].FT3D
+    Batch = ref_ds["generic"].Batch
+    from model.RAFTSceneFlow import RSF
+    from tools.loss import sequence_loss
+    from tools.metric import compute_epe
+
+    # The reference asserts the full 3,824-scene test set
+    # (flyingthings3d_hplflownet.py:71); build the instance around that
+    # incidental size check, keeping every data-path method real.
+    ds = RefFT3D.__new__(RefFT3D)
+    ds.nb_points = n_points
+    ds.mode = "test"
+    ds.root_dir = root
+    ds.filenames = sorted(
+        os.path.join(root, "val", d) for d in os.listdir(os.path.join(root, "val"))
+    )
+    loader = DataLoader(ds, 1, shuffle=False, num_workers=0,
+                        collate_fn=Batch, drop_last=False)
+
+    args = types.SimpleNamespace(corr_levels=3, base_scales=0.25,
+                                 truncate_k=truncate_k)
+    model = RSF(args)
+    ckpt = torch.load(weights, map_location="cpu", weights_only=True)
+    model.load_state_dict(ckpt["state_dict"])
+    model.eval()
+
+    loss_test, epe_test, outlier_test = [], [], []
+    acc3dRelax_test, acc3dStrict_test = [], []
+    for batch_data in loader:
+        with torch.no_grad():
+            est_flow = model(batch_data["sequence"], iters)
+        loss = sequence_loss(est_flow, batch_data)
+        epe, acc3d_strict, acc3d_relax, outlier = compute_epe(
+            est_flow[-1], batch_data)
+        loss_test.append(loss.cpu())
+        epe_test.append(epe)
+        outlier_test.append(outlier)
+        acc3dRelax_test.append(acc3d_relax)
+        acc3dStrict_test.append(acc3d_strict)
+    return {
+        "loss": float(np.array(loss_test).mean()),
+        "epe3d": float(np.array(epe_test).mean()),
+        "outlier": float(np.array(outlier_test).mean()),
+        "acc3d_relax": float(np.array(acc3dRelax_test).mean()),
+        "acc3d_strict": float(np.array(acc3dStrict_test).mean()),
+    }
+
+
+def our_eval(root: str, torch_weights: str, n_points: int, iters: int = 32,
+             truncate_k: int = 64, eval_batch: int = 1):
+    """Our full standalone pipeline: ``Evaluator`` (FT3D dataset, prefetch
+    loader, jitted 32-iter eval step, on-device running means) with the
+    same torch ``.params`` file imported through the checkpoint
+    converter."""
+    _pin_cpu()
+    from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from pvraft_tpu.engine.evaluator import Evaluator
+
+    cfg = Config(
+        model=ModelConfig(truncate_k=truncate_k),
+        data=DataConfig(dataset="FT3D", root=root, max_points=n_points,
+                        num_workers=0, strict_sizes=False),
+        train=TrainConfig(eval_iters=iters, eval_batch=eval_batch),
+        exp_path=os.path.join(root, "exp"),
+    )
+    ev = Evaluator(cfg)
+    ev.load_torch(torch_weights)
+    return ev.run(log_every=0)
+
+
+def run_parity(workdir: str, n_scenes: int = 4, n_points: int = 256,
+               iters: int = 32, truncate_k: int = 64, seed: int = 2024,
+               pretrain_steps: int = 40):
+    """Generate scenes + weights, run both pipelines, return the record.
+
+    The torch model is briefly pretrained on the generated scenes first: a
+    random-init model drifts to ~9 EPE over 32 GRU iterations, which makes
+    every point an outlier and the Acc3DS/Acc3DR/Outliers comparison
+    degenerate (0%/0%/100% on both sides proves little). A few dozen Adam
+    steps pull predictions into the gt-flow range so the per-point errors
+    spread across all four metric classes and the threshold metrics carry
+    real information. Training is done by the REFERENCE's own loss/step
+    (``tools/engine.py:135-143``) — the weights both pipelines then load
+    are a genuine reference checkpoint."""
+    import torch
+
+    install_reference()
+    from model.RAFTSceneFlow import RSF
+    from tools.loss import sequence_loss as t_sequence_loss
+
+    root = make_scene_root(os.path.join(workdir, "ft3d"), n_scenes,
+                           n_points, seed)
+    args = types.SimpleNamespace(corr_levels=3, base_scales=0.25,
+                                 truncate_k=truncate_k)
+    torch.manual_seed(seed)
+    model = RSF(args)
+    if pretrain_steps:
+        ref_ds = load_reference_datasets()
+        ds = ref_ds["flyingthings3d_hplflownet"].FT3D.__new__(
+            ref_ds["flyingthings3d_hplflownet"].FT3D)
+        ds.nb_points = n_points
+        ds.mode = "test"
+        ds.root_dir = root
+        ds.filenames = sorted(
+            os.path.join(root, "val", d)
+            for d in os.listdir(os.path.join(root, "val")))
+        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+        model.train()
+        np.random.seed(seed)
+        for step in range(pretrain_steps):
+            item = ds[step % len(ds.filenames)]
+            batch = ref_ds["generic"].Batch([item])
+            est = model(batch["sequence"], 4)
+            loss = t_sequence_loss(est, batch)
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+    weights = os.path.join(workdir, "parity.params")
+    torch.save({"epoch": 0, "state_dict": model.state_dict()}, weights)
+
+    ref = reference_eval(root, weights, n_points, iters, truncate_k)
+    ours = our_eval(root, weights, n_points, iters, truncate_k)
+    deltas = {k: abs(ref[k] - ours.get(k, float("nan"))) for k in ref}
+    return {
+        "config": {"n_scenes": n_scenes, "n_points": n_points,
+                   "iters": iters, "truncate_k": truncate_k, "seed": seed},
+        "reference": ref,
+        "ours": {k: ours[k] for k in ref if k in ours},
+        "abs_delta": deltas,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/protocol_parity.json")
+    ap.add_argument("--workdir", default="/tmp/protocol_parity")
+    ap.add_argument("--n_scenes", type=int, default=4)
+    ap.add_argument("--n_points", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=32)
+    ap.add_argument("--truncate_k", type=int, default=64)
+    ap.add_argument("--pretrain_steps", type=int, default=300,
+                    help="reference-side Adam steps before the comparison "
+                         "(enough to pull some points under the Acc/rel "
+                         "thresholds so all four metrics are informative)")
+    args = ap.parse_args()
+    _pin_cpu()
+
+    os.makedirs(args.workdir, exist_ok=True)
+    rec = run_parity(args.workdir, args.n_scenes, args.n_points, args.iters,
+                     args.truncate_k, pretrain_steps=args.pretrain_steps)
+    # Gates: continuous metrics within 1e-4; threshold metrics exact by the
+    # margin construction (recorded as their own check so a flip is loud).
+    checks = {
+        "loss_atol_1e-4": rec["abs_delta"]["loss"] <= 1e-4,
+        "epe3d_atol_1e-4": rec["abs_delta"]["epe3d"] <= 1e-4,
+        "threshold_metrics_equal": all(
+            rec["abs_delta"][k] <= 1e-6
+            for k in ("acc3d_strict", "acc3d_relax", "outlier")
+        ),
+    }
+    rec["checks"] = checks
+    rec["ok"] = all(checks.values())
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=2)
+    print(json.dumps(rec, indent=2))
+    if not rec["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -105,30 +105,48 @@ def main() -> int:
         print(f"[stage2] epoch {epoch}: {m}", flush=True)
     v2_after = tr2.val_test(args.epochs2 - 1, "val")
 
+    # The refine stage is the reference's headline accuracy contribution
+    # (model/RAFTSceneFlowRefine.py; README table) — the gate demands a
+    # real MARGIN over the frozen stage-1 level, not merely "not worse".
+    # 0.97 (>=3% val-EPE improvement) is calibrated under the committed
+    # baseline's observed ratio (artifacts/refine_convergence.json:
+    # 0.2969/0.3176 = 0.935 at 1,024 pts / 2 epochs). Checks that do not
+    # apply at smoke sizes record "n/a", never a vacuous pass; `ok`
+    # aggregates the applied checks only (round-3 verdict).
+    refine_margin = 0.97
     checks = {
         # Stage 1 genuinely learned (halved its first-epoch train EPE).
         # Needs >= 2 epochs to compare across; 1-epoch smokes are exempt.
-        "stage1_learns": args.epochs1 < 2
-        or s1_epochs[-1]["epe"] <= 0.5 * s1_epochs[0]["epe"],
+        "stage1_learns": (
+            "n/a" if args.epochs1 < 2
+            else s1_epochs[-1]["epe"] <= 0.5 * s1_epochs[0]["epe"]),
         # Refine training improved the refined model's val EPE...
         "stage2_improves": v2_after["epe3d"] < v2_before["epe3d"],
-        # ...and the result does not degrade the stage-1 backbone's level
-        # (the residual head starts near-zero, so large regression means
-        # the freeze or import is broken). 1.1 allows val noise; 1-epoch
-        # smokes are exempt (the head hasn't had time to catch up).
-        "refined_not_worse_than_stage1": args.epochs2 < 2
-        or v2_after["epe3d"] <= 1.1 * v1["epe3d"],
+        # ...and beats the stage-1 backbone's level by the margin. The
+        # residual head starts near-zero, so failure means the freeze,
+        # the import, or the head itself is broken. 1-epoch smokes are
+        # exempt (the head hasn't had time to catch up).
+        "refined_beats_stage1_by_margin": (
+            "n/a" if args.epochs2 < 2
+            else v2_after["epe3d"] <= refine_margin * v1["epe3d"]),
     }
+    applied = [k for k, v in checks.items() if v != "n/a"]
     record = {
         "platform": platform,
         "config": {"points": args.points, "objects": args.objects,
                    "epochs1": args.epochs1, "epochs2": args.epochs2},
+        "thresholds": {
+            "refine_margin": refine_margin,
+            "calibration": "committed baseline ratio 0.935 "
+                           "(artifacts/refine_convergence.json)",
+        },
         "stage1": {"epochs": s1_epochs, "val_epe3d": round(v1["epe3d"], 4)},
         "stage2": {"epochs": s2_epochs,
                    "val_epe3d_before": round(v2_before["epe3d"], 4),
                    "val_epe3d_after": round(v2_after["epe3d"], 4)},
         "checks": checks,
-        "ok": all(checks.values()),
+        "applied_checks": applied,
+        "ok": all(checks[k] for k in applied),
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:

@@ -1,3 +1,27 @@
+"""Long-context (16,384-point) evidence, v2.
+
+v1 proved feasibility only ("compiles, finite flows, first call 124 s
+incl. compile"). v2 makes the claim mean something (round-3 verdict
+weak #5):
+
+  * steady-state forward time — post-compile, fresh inputs per call (the
+    axon remote executor memoizes identical-input executions);
+  * a loss-decreasing TRAIN smoke at the full 16,384 points (default 20
+    steps, fwd+bwd+Adam on one fixed scene — overfitting it must drive
+    the loss down if the streaming paths carry gradients correctly);
+  * a chunked-vs-dense numerics assertion AT 16k: the streaming running
+    top-k (``ops/corr.py::corr_init`` with ``chunk=2048``) against the
+    dense one-shot path on a row subset (dense over all 16k rows would
+    need the O(N*M) volume this path exists to avoid — the subset keeps
+    the dense reference cheap while still comparing at the real M).
+
+The memory wall this path removes is reference ``model/corr.py:96-99``
+(full N x M volume) / ``model/flot/graph.py:53-57`` (N x M kNN).
+
+Usage: python scripts/scale16k_smoke.py [--tpu] [--sp]
+       [--smoke_steps N] [--points N]
+"""
+
 import sys, os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 if "--sp" in sys.argv:
@@ -7,67 +31,165 @@ if "--sp" in sys.argv:
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     ).strip()
+import argparse
+import json
 import time
+
 import numpy as np
 import jax
-if "--sp" in sys.argv and "--tpu" in sys.argv:
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--tpu", action="store_true")
+ap.add_argument("--sp", action="store_true")
+ap.add_argument("--points", type=int, default=16384)
+ap.add_argument("--smoke_steps", type=int, default=20,
+                help="train-smoke steps at full size (0 disables)")
+ap.add_argument("--steady_calls", type=int, default=2,
+                help="post-compile forward timings (fresh inputs each)")
+args = ap.parse_args()
+if args.sp and args.tpu:
     sys.exit("--sp needs the 8-device virtual CPU mesh; drop --tpu")
-if "--tpu" not in sys.argv:
+if not args.tpu:
     jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 from pvraft_tpu.config import ModelConfig
 from pvraft_tpu.models import PVRaft
 
 # The BASELINE.json scale-up config shape (16,384 points) with every
-# streaming option on; 2 GRU iters, forward only. Default CPU; --tpu runs
-# the same program on the real chip (single-chip long-context evidence —
-# the memory wall this path removes is reference model/corr.py:96-99).
-# use_pallas pinned False: this artifact certifies the corr_chunk/
-# graph_chunk XLA streaming path at 16k points (the None-auto default
-# would silently swap in the Pallas kernel on --tpu, measuring a
+# streaming option on; 2 GRU iters. use_pallas pinned False: this artifact
+# certifies the corr_chunk/graph_chunk XLA streaming path (the None-auto
+# default would silently swap in the Pallas kernel on --tpu, measuring a
 # different code path than the CPU leg).
 cfg = ModelConfig(truncate_k=512, corr_chunk=2048, graph_chunk=2048,
                   remat=True, use_pallas=False)
 model = PVRaft(cfg)
 rng = np.random.default_rng(0)
-n = 16384
-pc1 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
-pc2 = jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+n = args.points
+
+
+def cloud():
+    return jnp.asarray(rng.uniform(-1, 1, (1, n, 3)).astype(np.float32))
+
+
+pc1, pc2 = cloud(), cloud()
 t0 = time.time()
 params = model.init(jax.random.key(0), pc1[:, :1024], pc2[:, :1024], 2)
 print(f"init {time.time()-t0:.0f}s", flush=True)
+
+fwd = jax.jit(lambda p, a, b: model.apply(p, a, b, 2))
 t0 = time.time()
-flows, _ = jax.jit(lambda p, a, b: model.apply(p, a, b, 2))(params, pc1, pc2)
+flows, _ = fwd(params, pc1, pc2)
 jax.block_until_ready(flows)
-wall = time.time() - t0
+first_call = time.time() - t0
 platform = jax.devices()[0].platform
 finite = bool(np.isfinite(np.asarray(flows)).all())
-print(f"16k fwd ok ({platform}): {flows.shape} finite={finite} {wall:.0f}s")
+print(f"16k fwd ok ({platform}): {flows.shape} finite={finite} "
+      f"{first_call:.0f}s (incl. compile)", flush=True)
 
-# Committed long-context evidence (VERDICT r2 item 9): one JSON per
-# platform so the CPU and TPU legs don't clobber each other.
-import json
+# Steady state: fresh clouds per call (identical inputs would be memoized
+# by the axon remote executor and time a cache hit).
+steady = []
+for _ in range(max(1, args.steady_calls)):
+    a, b = cloud(), cloud()
+    t0 = time.time()
+    out, _ = fwd(params, a, b)
+    jax.block_until_ready(out)
+    steady.append(time.time() - t0)
+print(f"16k fwd steady-state: {steady}", flush=True)
 
 record = {"platform": platform, "points": n, "iters": 2,
           "truncate_k": cfg.truncate_k, "corr_chunk": cfg.corr_chunk,
           "graph_chunk": cfg.graph_chunk, "remat": True,
           "use_pallas": False, "finite": finite,
-          # First jitted call: trace+compile+execute. The claim this
-          # artifact makes is feasibility (the 16k program compiles and
-          # produces finite flows), not steady-state throughput.
-          "fwd_first_call_s": round(wall, 1),
-          "includes_compile": True, "ok": finite}
+          "fwd_first_call_s": round(first_call, 1),
+          "includes_compile": True,
+          "fwd_steady_s": [round(s, 2) for s in steady],
+          "fwd_steady_mean_s": round(float(np.mean(steady)), 2)}
+checks = {"finite": finite}
+
+# ---- chunked-vs-dense numerics at the real M (row subset) ---------------
+from pvraft_tpu.ops.corr import corr_init
+
+n_rows = 128
+fdim = 64
+frng = np.random.default_rng(7)
+f1 = jnp.asarray(frng.normal(size=(1, n_rows, fdim)).astype(np.float32))
+f2 = jnp.asarray(frng.normal(size=(1, n, fdim)).astype(np.float32))
+x2 = cloud()
+dense = corr_init(f1, f2, x2, truncate_k=512, chunk=None)
+stream = corr_init(f1, f2, x2, truncate_k=512, chunk=2048)
+corr_diff = float(np.max(np.abs(np.asarray(dense.corr)
+                                - np.asarray(stream.corr))))
+xyz_diff = float(np.max(np.abs(np.asarray(dense.xyz)
+                               - np.asarray(stream.xyz))))
+record["chunked_vs_dense_16k"] = {
+    "rows": n_rows, "cols": n, "truncate_k": 512, "chunk": 2048,
+    "corr_max_abs_diff": corr_diff, "xyz_max_abs_diff": xyz_diff,
+}
+# Values must agree to fp32 top-k exactness; xyz may differ only where
+# equal corr values tie (continuous random features make ties measure-
+# zero, so exact agreement is demanded).
+checks["chunked_vs_dense_corr"] = corr_diff <= 1e-5
+checks["chunked_vs_dense_xyz"] = xyz_diff <= 1e-5
+print(f"chunked-vs-dense @16k: corr {corr_diff:.2e} xyz {xyz_diff:.2e}",
+      flush=True)
+
+# ---- loss-decreasing train smoke at full size ---------------------------
+if args.smoke_steps > 0:
+    import optax
+
+    from pvraft_tpu.engine.loss import sequence_loss
+
+    gt = (0.1 * frng.normal(size=(1, n, 3))).astype(np.float32)
+    s_pc2 = pc1 + jnp.asarray(gt)
+    mask = jnp.ones((1, n), jnp.float32)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(p, o):
+        def loss_fn(pp):
+            fl, _ = model.apply(pp, pc1, s_pc2, 2)
+            return sequence_loss(fl, mask, jnp.asarray(gt), 0.8)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        up, o = tx.update(grads, o)
+        return optax.apply_updates(p, up), o, loss
+
+    losses = []
+    step_times = []
+    p_s, o_s = params, opt_state
+    for i in range(args.smoke_steps):
+        t0 = time.time()
+        p_s, o_s, loss = train_step(p_s, o_s)
+        jax.block_until_ready(loss)
+        step_times.append(time.time() - t0)
+        losses.append(float(loss))
+        print(f"smoke step {i}: loss {losses[-1]:.4f} "
+              f"({step_times[-1]:.0f}s)", flush=True)
+    record["train_smoke"] = {
+        "steps": args.smoke_steps,
+        "loss_first": round(losses[0], 4),
+        "loss_last": round(losses[-1], 4),
+        "losses": [round(l, 4) for l in losses],
+        "step_first_call_s": round(step_times[0], 1),
+        "step_steady_mean_s": round(float(np.mean(step_times[1:])), 1)
+        if len(step_times) > 1 else None,
+    }
+    checks["smoke_loss_decreases"] = losses[-1] < losses[0]
+    checks["smoke_finite"] = bool(np.isfinite(losses).all())
+
+record["checks"] = checks
+record["ok"] = all(checks.values())
 out = f"artifacts/scale16k_{platform}.json"
 os.makedirs("artifacts", exist_ok=True)
 with open(out, "w") as f:
     json.dump(record, f, indent=1)
-if not finite:
+if not record["ok"] and not args.sp:
     print(json.dumps(record))
     sys.exit(1)
-# The final record (incl. the --sp leg when requested) is printed once at
-# the end of the script so stdout always matches the written artifact.
 
-if "--sp" in sys.argv:
+if args.sp:
     # Sequence-parallel training step at 16k points: the ppermute-ring
     # correlation (parallel/ring.py) over a 1x8 seq mesh — the multi-chip
     # long-context path actually training, not just the op in isolation.
@@ -118,7 +240,8 @@ if "--sp" in sys.argv:
         "includes_compile": True,
         "loss": round(sp_loss, 4), "finite": bool(np.isfinite(sp_loss)),
     }
-    record["ok"] = record["ok"] and record["seq_parallel"]["finite"]
+    record["checks"]["sp_finite"] = record["seq_parallel"]["finite"]
+    record["ok"] = all(record["checks"].values())
     with open(out, "w") as f:
         json.dump(record, f, indent=1)
 

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Worker for the REAL two-process distributed CPU test.
+
+Launched twice by ``tests/test_two_process.py`` (and usable by hand):
+
+    python scripts/two_process_worker.py --coordinator localhost:PORT \
+        --num_processes 2 --process_id 0 --out /tmp/out0.npz ...
+
+Each process gets 4 virtual CPU devices (``xla_force_host_platform_device_
+count``, set by the launcher via env); ``jax.distributed.initialize`` joins
+them into one 8-device global mesh. The worker then runs the SAME tiny
+synthetic training recipe as the single-process baseline: Trainer with a
+global batch sharded 8-wide over the data axis, 2 epochs of train + the
+scene-sharded val pass, msgpack checkpointing (process-0-only writes + the
+visibility barrier), and dumps final params + metrics for the launcher to
+compare.
+
+This executes for real what tests/test_parallel.py's monkeypatched
+simulations only gesture at: the per-process loader shard, `
+``make_array_from_process_local_data`` assembly (parallel/mesh.py), the
+``eval_scene_shard`` gate, and the checkpoint barrier
+(engine/checkpoint.py). Reference analog being outscaled:
+``tools/engine.py:51-64`` (single-process DataParallel).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port; omit for the single-process baseline")
+    ap.add_argument("--num_processes", type=int, default=1)
+    ap.add_argument("--process_id", type=int, default=0)
+    ap.add_argument("--exp_path", required=True,
+                    help="shared experiment dir (checkpoints land here)")
+    ap.add_argument("--out", required=True, help="npz dump path")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--eval_batch", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if args.coordinator:
+        from pvraft_tpu.parallel.distributed import initialize
+
+        assert initialize(coordinator_address=args.coordinator,
+                          num_processes=args.num_processes,
+                          process_id=args.process_id)
+        assert jax.process_count() == args.num_processes
+    assert len(jax.devices()) == 8, jax.devices()
+
+    import numpy as np
+
+    from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from pvraft_tpu.engine.trainer import Trainer
+
+    cfg = Config(
+        model=ModelConfig(truncate_k=16, corr_knn=8, graph_k=8),
+        data=DataConfig(dataset="synthetic", synthetic_size=8, max_points=64,
+                        num_workers=0),
+        train=TrainConfig(batch_size=1, num_epochs=args.epochs, iters=2,
+                          eval_iters=2, eval_batch=args.eval_batch,
+                          checkpoint_interval=1, seed=7),
+        exp_path=args.exp_path,
+    )
+    tr = Trainer(cfg)
+    history = []
+    for epoch in range(cfg.train.num_epochs):
+        tm = tr.training(epoch)
+        vm = tr.val_test(epoch, "val")
+        history.append({"train": tm, "val": vm})
+
+    if jax.process_index() == 0:
+        leaves = jax.tree_util.tree_leaves_with_path(
+            jax.tree_util.tree_map(np.asarray, tr.params))
+        dump = {jax.tree_util.keystr(k): v for k, v in leaves}
+        dump["__val_epe3d"] = np.asarray(
+            [h["val"]["epe3d"] for h in history])
+        dump["__val_loss"] = np.asarray([h["val"]["loss"] for h in history])
+        dump["__train_loss"] = np.asarray(
+            [h["train"]["loss"] for h in history])
+        np.savez(args.out, **dump)
+        with open(args.out + ".json", "w") as f:
+            json.dump({"history": history,
+                       "val_shard_world": tr._val_shard[1],
+                       "process_count": jax.process_count()}, f, indent=2)
+    print("worker done", jax.process_index())
+
+
+if __name__ == "__main__":
+    main()

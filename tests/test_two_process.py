@@ -1,0 +1,144 @@
+"""REAL two-process distributed test (slow tier).
+
+Launches 2 localhost processes (subprocess + ``jax.distributed.initialize``,
+4 virtual CPU devices each -> one 8-device global mesh) running the full
+Trainer recipe — sharded train batches, scene-sharded val, msgpack
+checkpoints with the process-0 write + visibility barrier — and asserts
+params and metrics equal a single-process 8-device run of the identical
+config.
+
+This executes the code the monkeypatched guards in tests/test_parallel.py
+only simulate: the per-process loader shard, the
+``make_array_from_process_local_data`` assembly (parallel/mesh.py:98-141),
+``eval_scene_shard`` (mesh.py:57-75), and the checkpoint barrier
+(engine/checkpoint.py). Reference analog: the single-process DataParallel
+at ``tools/engine.py:51-64`` — this framework claims strictly more, so it
+must prove strictly more.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "scripts", "two_process_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _env(n_devices: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    # The conftest's 8-device setting must not leak into the workers.
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    return env
+
+
+def test_two_process_matches_single_process(tmp_path):
+    port = _free_port()
+    coord = f"localhost:{port}"
+
+    # --- 2 processes x 4 devices ------------------------------------------
+    # Workers write stdout to FILES, not PIPEs: both processes run in
+    # collective lockstep, so if one blocked on a full 64 KB pipe buffer
+    # while the other was being drained first, both would deadlock until
+    # the timeout.
+    outs = [str(tmp_path / f"two_{i}.npz") for i in range(2)]
+    log_paths = [tmp_path / f"worker_{i}.log" for i in range(2)]
+    log_files = [open(p, "w") for p in log_paths]
+    try:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, WORKER,
+                 "--coordinator", coord, "--num_processes", "2",
+                 "--process_id", str(i),
+                 "--exp_path", str(tmp_path / "exp_two"),
+                 "--out", outs[i]],
+                env=_env(4), stdout=log_files[i],
+                stderr=subprocess.STDOUT,
+            )
+            for i in range(2)
+        ]
+        try:
+            for p in procs:
+                p.wait(timeout=1500)
+        finally:
+            for p in procs:
+                if p.poll() is None:  # a hung peer would leak otherwise
+                    p.kill()
+    finally:
+        for f in log_files:
+            f.close()
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, (
+            f"worker {i} failed:\n{log_paths[i].read_text()[-4000:]}")
+
+    # --- 1 process x 8 devices (identical recipe) -------------------------
+    single_out = str(tmp_path / "single.npz")
+    p = subprocess.run(
+        [sys.executable, WORKER,
+         "--exp_path", str(tmp_path / "exp_single"), "--out", single_out],
+        env=_env(8), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=1500,
+    )
+    assert p.returncode == 0, p.stdout.decode(errors="replace")[-4000:]
+
+    two = np.load(outs[0])
+    single = np.load(single_out)
+    assert set(two.files) == set(single.files)
+
+    # Metrics: train losses and the scene-sharded val means must agree.
+    np.testing.assert_allclose(two["__train_loss"], single["__train_loss"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(two["__val_epe3d"], single["__val_epe3d"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(two["__val_loss"], single["__val_loss"],
+                               rtol=1e-5, atol=1e-6)
+
+    # Params after 2 epochs: the block-cyclic loader shard puts the SAME
+    # rows on the SAME devices as the single-process run, so the only
+    # remaining divergence source is the cross-process collective runtime
+    # itself: an 8-way psum spanning 2 processes reduces in a different
+    # order than the intra-process one, giving ~1e-7 fp noise in grads.
+    # Adam turns near-zero-grad elements' sign flips into ~lr-scale update
+    # differences (observed: 2/32 elements of one GN bias at 1.1e-4 after
+    # 2 epochs, every other element bitwise-equal), so the gate is an
+    # lr-amplification bound plus a mean bound that keeps the drift
+    # confined to isolated near-zero elements — a sharding bug
+    # (duplicated/missing rows) moves grads at O(grad) and fails both.
+    for k in single.files:
+        if k.startswith("__"):
+            continue
+        diff = np.abs(two[k] - single[k])
+        assert diff.max() <= 5e-4, (
+            f"param leaf {k} diverged between 2-process and single-process "
+            f"runs: max {diff.max()}")
+        assert diff.mean() <= 2e-5, (
+            f"param leaf {k} drifted broadly (mean {diff.mean()}): not "
+            f"isolated near-zero Adam flips")
+
+    # The val pass really was scene-sharded in the 2-process run (the gate
+    # fired), not silently redundant.
+    import json
+
+    with open(outs[0] + ".json") as f:
+        meta = json.load(f)
+    assert meta["process_count"] == 2
+    assert meta["val_shard_world"] == 2, meta
+
+    # The shared checkpoint dir was written by process 0 and passed the
+    # post-barrier visibility check (no RuntimeError above); sanity that
+    # the files exist for a future resume.
+    ckpts = os.listdir(tmp_path / "exp_two" / "checkpoints")
+    assert any(c.startswith("last_checkpoint") for c in ckpts), ckpts
