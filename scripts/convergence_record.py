@@ -64,6 +64,16 @@ CALIBRATION = {
 }
 
 
+def gate_record(checks: dict) -> dict:
+    """Honest gate aggregation, shared by every artifact producer (also
+    scripts/refine_convergence.py) so the semantics can't drift: a check
+    that did not apply holds ``"n/a"`` (never a vacuous pass),
+    ``applied_checks`` names the rest, and ``ok`` aggregates only those."""
+    applied = [k for k, v in checks.items() if v != "n/a"]
+    return {"checks": checks, "applied_checks": applied,
+            "ok": all(bool(checks[k]) for k in applied)}
+
+
 def tail_best(traj) -> float:
     """Best EPE over the last quarter of logged steps — the variant's
     converged level, insensitive to a noise spike on the final step."""
@@ -252,7 +262,6 @@ def make_record(platform: str, config: dict, results: list) -> dict:
         "fp32_quarters_nonincreasing": "n/a" if quarters is None else quarters,
         "fast_matches_fp32": tbf <= FAST_VARIANT_RATIO * max(tb32, 1e-3),
     }
-    applied = [k for k, v in checks.items() if v != "n/a"]
     return {
         "platform": platform,
         "config": config,
@@ -263,9 +272,7 @@ def make_record(platform: str, config: dict, results: list) -> dict:
                                "quarter medians non-increasing"},
         "calibration": CALIBRATION,
         "results": results,
-        "checks": checks,
-        "applied_checks": applied,
-        "ok": all(checks[k] for k in applied),
+        **gate_record(checks),
     }
 
 

@@ -98,15 +98,15 @@ def load_reference_datasets(ref_root: str = REF_ROOT):
     pkg_name = "ref_datasets"
     if pkg_name + ".flyingthings3d_hplflownet" in sys.modules:
         return {
-            "generic": sys.modules[pkg_name + ".generic"],
-            "flyingthings3d_hplflownet":
-                sys.modules[pkg_name + ".flyingthings3d_hplflownet"],
+            m: sys.modules[pkg_name + "." + m]
+            for m in ("generic", "flyingthings3d_hplflownet",
+                      "kitti_hplflownet")
         }
     pkg = types.ModuleType(pkg_name)
     pkg.__path__ = [os.path.join(ref_root, "datasets")]
     sys.modules[pkg_name] = pkg
     out = {}
-    for mod in ("generic", "flyingthings3d_hplflownet"):
+    for mod in ("generic", "flyingthings3d_hplflownet", "kitti_hplflownet"):
         spec = importlib.util.spec_from_file_location(
             f"{pkg_name}.{mod}", os.path.join(ref_root, "datasets",
                                               f"{mod}.py"))
@@ -130,17 +130,7 @@ def make_scene_root(root: str, n_scenes: int, n_points: int, seed: int) -> str:
     os.makedirs(val, exist_ok=True)
     for s in range(n_scenes):
         pc1 = rng.uniform(-2.0, 2.0, (n_points, 3)).astype(np.float32)
-        # Flow magnitude bands, each >=0.02 from the 0.05/0.1/0.3 absolute
-        # thresholds: tiny (strict+relax hit), small (relax hit), medium
-        # (no hit, not outlier by l2), large (l2 outlier). Note with a
-        # random-init model the PREDICTED flow also moves each point's
-        # error; margins are re-checked empirically by the caller, which
-        # asserts the reference and our pipeline classify identically.
-        mags = rng.choice([0.02, 0.075, 0.2, 0.5], size=n_points,
-                          p=[0.3, 0.3, 0.2, 0.2])
-        dirs = rng.normal(size=(n_points, 3)).astype(np.float32)
-        dirs /= np.linalg.norm(dirs, axis=-1, keepdims=True) + 1e-12
-        flow = (mags[:, None] * dirs).astype(np.float32)
+        flow = _margin_flows(rng, n_points)
         pc2 = pc1 + flow
         scene = os.path.join(val, f"{s:07d}")
         os.makedirs(scene, exist_ok=True)
@@ -149,38 +139,131 @@ def make_scene_root(root: str, n_scenes: int, n_points: int, seed: int) -> str:
     return root
 
 
+def _margin_flows(rng, n: int) -> "np.ndarray":
+    """Ground-truth flows with magnitudes banded >=0.02 away from every
+    absolute threshold the Acc3DS/Acc3DR/Outliers metrics test (0.05 /
+    0.1 / 0.3): tiny (strict+relax hit), small (relax hit), medium (no
+    hit, not outlier by l2), large (l2 outlier). With the predicted flow
+    also moving each point's error, the margins are re-checked empirically
+    by the caller, which asserts the reference and our pipeline classify
+    identically. Shared by both dataset generators — the bands are
+    load-bearing for the 'threshold metrics agree EXACTLY' gate."""
+    mags = rng.choice([0.02, 0.075, 0.2, 0.5], size=n,
+                      p=[0.3, 0.3, 0.2, 0.2])
+    dirs = rng.normal(size=(n, 3)).astype(np.float32)
+    dirs /= np.linalg.norm(dirs, axis=-1, keepdims=True) + 1e-12
+    return (mags[:, None] * dirs).astype(np.float32)
+
+
+def make_kitti_scene_root(root: str, n_scenes: int, n_points: int,
+                          seed: int) -> str:
+    """Write a KITTI-layout directory tree: scene dirs named with indices
+    from the HPLFlowNet 142-scene mapping (our loader filters by basename,
+    ``pvraft_tpu/data/kitti.py``), each holding ``pc1.npy``/``pc2.npy``
+    where the ground/far filters (``kitti_hplflownet.py:81-87``) pass
+    EXACTLY ``n_points`` rows and provably fire on the rest.
+
+    Filter margins (>=0.1 from the -1.4 ground / 35 m depth thresholds on
+    BOTH frames) make row classification fp-robust; keep-row flows reuse
+    the FT3D generator's threshold-margin magnitude bands."""
+    rng = np.random.default_rng(seed)
+    mapping_indices = [2, 3, 7, 8, 9, 10, 11, 12]  # all in the 142-set
+    if n_scenes > len(mapping_indices):
+        raise ValueError(
+            f"n_scenes={n_scenes} exceeds the {len(mapping_indices)} "
+            "mapping-listed scene names this generator can mint")
+    os.makedirs(root, exist_ok=True)
+    for s in range(n_scenes):
+        n_ground = n_points // 4
+        n_far = n_points // 4
+        # Keep rows: y well above the ground cut, z well below the 35 m
+        # cut in both frames (flow magnitude <= 0.5 < margins).
+        keep = np.stack([
+            rng.uniform(-2.0, 2.0, n_points),   # x
+            rng.uniform(-1.2, 2.0, n_points),   # y: pc1 never ground
+            rng.uniform(5.0, 34.0, n_points),   # z: both frames < 35
+        ], axis=1).astype(np.float32)
+        flow = _margin_flows(rng, n_points)
+        # Ground rows: y < -1.5 in BOTH frames (flow can't lift past -1.4).
+        ground = np.stack([
+            rng.uniform(-2.0, 2.0, n_ground),
+            rng.uniform(-3.0, -2.1, n_ground),
+            rng.uniform(5.0, 30.0, n_ground),
+        ], axis=1).astype(np.float32)
+        # Far rows: z > 36 in both frames.
+        far = np.stack([
+            rng.uniform(-2.0, 2.0, n_far),
+            rng.uniform(0.0, 2.0, n_far),
+            rng.uniform(36.5, 40.0, n_far),
+        ], axis=1).astype(np.float32)
+        drop = np.concatenate([ground, far])
+        drop_flow = (0.1 * rng.normal(size=drop.shape)).astype(np.float32)
+        pc1 = np.concatenate([keep, drop]).astype(np.float32)
+        pc2 = (pc1 + np.concatenate([flow, drop_flow])).astype(np.float32)
+        # Interleave rows so the filter isn't trivially prefix-aligned.
+        perm = rng.permutation(pc1.shape[0])
+        scene = os.path.join(root, f"{mapping_indices[s]:06d}")
+        os.makedirs(scene, exist_ok=True)
+        np.save(os.path.join(scene, "pc1.npy"), pc1[perm])
+        np.save(os.path.join(scene, "pc2.npy"), pc2[perm])
+    return root
+
+
+def build_ref_dataset(dataset: str, root: str, n_points: int):
+    """Instantiate the reference dataset class over a generated root.
+
+    Both classes hard-assert their full production sizes (3,824 FT3D test
+    scenes / 200 KITTI dirs — ``flyingthings3d_hplflownet.py:71``,
+    ``kitti_hplflownet.py:41``); the instances are built around those
+    incidental size checks, keeping every data-path method
+    (``__getitem__`` subsampling, ``load_sequence`` filters/flips) real."""
+    ref_ds = load_reference_datasets()
+    if dataset == "FT3D":
+        cls = ref_ds["flyingthings3d_hplflownet"].FT3D
+        ds = cls.__new__(cls)
+        ds.mode = "test"
+        ds.filenames = sorted(
+            os.path.join(root, "val", d)
+            for d in os.listdir(os.path.join(root, "val")))
+    else:
+        cls = ref_ds["kitti_hplflownet"].Kitti
+        ds = cls.__new__(cls)
+        ds.paths = sorted(
+            os.path.join(root, d) for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+    ds.nb_points = n_points
+    ds.root_dir = root
+    return ds, ref_ds["generic"].Batch
+
+
+def _ref_model(refine: bool, truncate_k: int):
+    from model.RAFTSceneFlow import RSF
+    from model.RAFTSceneFlowRefine import RSF_refine
+
+    args = types.SimpleNamespace(corr_levels=3, base_scales=0.25,
+                                 truncate_k=truncate_k)
+    return (RSF_refine if refine else RSF)(args)
+
+
 def reference_eval(root: str, weights: str, n_points: int, iters: int = 32,
-                   truncate_k: int = 64):
+                   truncate_k: int = 64, dataset: str = "FT3D",
+                   refine: bool = False):
     """The reference standalone eval loop (``test.py:82-156``) on CPU:
-    FT3D(mode='test') -> DataLoader(bs=1, collate_fn=Batch) -> RSF at
-    ``iters`` GRU iterations -> sequence_loss + compute_epe running means."""
+    FT3D(mode='test') or Kitti -> DataLoader(bs=1, collate_fn=Batch) ->
+    RSF / RSF_refine at ``iters`` GRU iterations -> sequence_loss (stage 1,
+    ``test.py:121-123``) or compute_loss on the single refined flow
+    (``test.py:124-126``) + compute_epe running means."""
     import torch
     from torch.utils.data import DataLoader
 
     install_reference()
-    ref_ds = load_reference_datasets()
-    RefFT3D = ref_ds["flyingthings3d_hplflownet"].FT3D
-    Batch = ref_ds["generic"].Batch
-    from model.RAFTSceneFlow import RSF
-    from tools.loss import sequence_loss
+    ds, Batch = build_ref_dataset(dataset, root, n_points)
+    from tools.loss import compute_loss, sequence_loss
     from tools.metric import compute_epe
 
-    # The reference asserts the full 3,824-scene test set
-    # (flyingthings3d_hplflownet.py:71); build the instance around that
-    # incidental size check, keeping every data-path method real.
-    ds = RefFT3D.__new__(RefFT3D)
-    ds.nb_points = n_points
-    ds.mode = "test"
-    ds.root_dir = root
-    ds.filenames = sorted(
-        os.path.join(root, "val", d) for d in os.listdir(os.path.join(root, "val"))
-    )
     loader = DataLoader(ds, 1, shuffle=False, num_workers=0,
                         collate_fn=Batch, drop_last=False)
-
-    args = types.SimpleNamespace(corr_levels=3, base_scales=0.25,
-                                 truncate_k=truncate_k)
-    model = RSF(args)
+    model = _ref_model(refine, truncate_k)
     ckpt = torch.load(weights, map_location="cpu", weights_only=True)
     model.load_state_dict(ckpt["state_dict"])
     model.eval()
@@ -190,9 +273,14 @@ def reference_eval(root: str, weights: str, n_points: int, iters: int = 32,
     for batch_data in loader:
         with torch.no_grad():
             est_flow = model(batch_data["sequence"], iters)
-        loss = sequence_loss(est_flow, batch_data)
-        epe, acc3d_strict, acc3d_relax, outlier = compute_epe(
-            est_flow[-1], batch_data)
+        if not refine:
+            loss = sequence_loss(est_flow, batch_data)
+            epe, acc3d_strict, acc3d_relax, outlier = compute_epe(
+                est_flow[-1], batch_data)
+        else:
+            loss = compute_loss(est_flow, batch_data)
+            epe, acc3d_strict, acc3d_relax, outlier = compute_epe(
+                est_flow, batch_data)
         loss_test.append(loss.cpu())
         epe_test.append(epe)
         outlier_test.append(outlier)
@@ -208,10 +296,11 @@ def reference_eval(root: str, weights: str, n_points: int, iters: int = 32,
 
 
 def our_eval(root: str, torch_weights: str, n_points: int, iters: int = 32,
-             truncate_k: int = 64, eval_batch: int = 1):
-    """Our full standalone pipeline: ``Evaluator`` (FT3D dataset, prefetch
-    loader, jitted 32-iter eval step, on-device running means) with the
-    same torch ``.params`` file imported through the checkpoint
+             truncate_k: int = 64, eval_batch: int = 1,
+             dataset: str = "FT3D", refine: bool = False):
+    """Our full standalone pipeline: ``Evaluator`` (FT3D/KITTI dataset,
+    prefetch loader, jitted 32-iter eval step, on-device running means)
+    with the same torch ``.params`` file imported through the checkpoint
     converter."""
     _pin_cpu()
     from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
@@ -219,10 +308,14 @@ def our_eval(root: str, torch_weights: str, n_points: int, iters: int = 32,
 
     cfg = Config(
         model=ModelConfig(truncate_k=truncate_k),
-        data=DataConfig(dataset="FT3D", root=root, max_points=n_points,
+        data=DataConfig(dataset=dataset, root=root, max_points=n_points,
                         num_workers=0, strict_sizes=False),
-        train=TrainConfig(eval_iters=iters, eval_batch=eval_batch),
-        exp_path=os.path.join(root, "exp"),
+        train=TrainConfig(eval_iters=iters, eval_batch=eval_batch,
+                          refine=refine),
+        # Sibling of the dataset root, never inside it: the KITTI scene
+        # walk treats every leaf directory as a scene and would trip over
+        # the experiment's checkpoints/logs dirs.
+        exp_path=root.rstrip("/") + "_exp",
     )
     ev = Evaluator(cfg)
     ev.load_torch(torch_weights)
@@ -231,7 +324,8 @@ def our_eval(root: str, torch_weights: str, n_points: int, iters: int = 32,
 
 def run_parity(workdir: str, n_scenes: int = 4, n_points: int = 256,
                iters: int = 32, truncate_k: int = 64, seed: int = 2024,
-               pretrain_steps: int = 40):
+               pretrain_steps: int = 40, dataset: str = "FT3D",
+               refine: bool = False):
     """Generate scenes + weights, run both pipelines, return the record.
 
     The torch model is briefly pretrained on the generated scenes first: a
@@ -240,51 +334,51 @@ def run_parity(workdir: str, n_scenes: int = 4, n_points: int = 256,
     degenerate (0%/0%/100% on both sides proves little). A few dozen Adam
     steps pull predictions into the gt-flow range so the per-point errors
     spread across all four metric classes and the threshold metrics carry
-    real information. Training is done by the REFERENCE's own loss/step
-    (``tools/engine.py:135-143``) — the weights both pipelines then load
-    are a genuine reference checkpoint."""
+    real information. Training is done by the REFERENCE's own losses
+    (``tools/engine.py:135-143``; ``tools/engine_refine.py:142`` for the
+    refine head) — the weights both pipelines then load are a genuine
+    reference checkpoint."""
     import torch
 
     install_reference()
-    from model.RAFTSceneFlow import RSF
+    from tools.loss import compute_loss as t_compute_loss
     from tools.loss import sequence_loss as t_sequence_loss
 
-    root = make_scene_root(os.path.join(workdir, "ft3d"), n_scenes,
-                           n_points, seed)
-    args = types.SimpleNamespace(corr_levels=3, base_scales=0.25,
-                                 truncate_k=truncate_k)
+    if dataset == "FT3D":
+        root = make_scene_root(os.path.join(workdir, "ft3d"), n_scenes,
+                               n_points, seed)
+    else:
+        root = make_kitti_scene_root(os.path.join(workdir, "kitti"),
+                                     n_scenes, n_points, seed)
     torch.manual_seed(seed)
-    model = RSF(args)
+    model = _ref_model(refine, truncate_k)
     if pretrain_steps:
-        ref_ds = load_reference_datasets()
-        ds = ref_ds["flyingthings3d_hplflownet"].FT3D.__new__(
-            ref_ds["flyingthings3d_hplflownet"].FT3D)
-        ds.nb_points = n_points
-        ds.mode = "test"
-        ds.root_dir = root
-        ds.filenames = sorted(
-            os.path.join(root, "val", d)
-            for d in os.listdir(os.path.join(root, "val")))
+        ds, Batch = build_ref_dataset(dataset, root, n_points)
         opt = torch.optim.Adam(model.parameters(), lr=1e-3)
         model.train()
         np.random.seed(seed)
         for step in range(pretrain_steps):
-            item = ds[step % len(ds.filenames)]
-            batch = ref_ds["generic"].Batch([item])
+            item = ds[step % len(ds)]
+            batch = Batch([item])
             est = model(batch["sequence"], 4)
-            loss = t_sequence_loss(est, batch)
+            loss = (t_compute_loss(est, batch) if refine
+                    else t_sequence_loss(est, batch))
             opt.zero_grad()
             loss.backward()
             opt.step()
     weights = os.path.join(workdir, "parity.params")
     torch.save({"epoch": 0, "state_dict": model.state_dict()}, weights)
 
-    ref = reference_eval(root, weights, n_points, iters, truncate_k)
-    ours = our_eval(root, weights, n_points, iters, truncate_k)
+    ref = reference_eval(root, weights, n_points, iters, truncate_k,
+                         dataset=dataset, refine=refine)
+    ours = our_eval(root, weights, n_points, iters, truncate_k,
+                    dataset=dataset, refine=refine)
     deltas = {k: abs(ref[k] - ours.get(k, float("nan"))) for k in ref}
     return {
         "config": {"n_scenes": n_scenes, "n_points": n_points,
-                   "iters": iters, "truncate_k": truncate_k, "seed": seed},
+                   "iters": iters, "truncate_k": truncate_k, "seed": seed,
+                   "dataset": dataset, "refine": refine,
+                   "pretrain_steps": pretrain_steps},
         "reference": ref,
         "ours": {k: ours[k] for k in ref if k in ours},
         "abs_delta": deltas,
@@ -303,12 +397,17 @@ def main():
                     help="reference-side Adam steps before the comparison "
                          "(enough to pull some points under the Acc/rel "
                          "thresholds so all four metrics are informative)")
+    ap.add_argument("--dataset", default="FT3D", choices=["FT3D", "KITTI"])
+    ap.add_argument("--refine", action="store_true",
+                    help="compare the stage-2 (RSF_refine) eval path "
+                         "(test.py:124-126) instead of stage 1")
     args = ap.parse_args()
     _pin_cpu()
 
     os.makedirs(args.workdir, exist_ok=True)
     rec = run_parity(args.workdir, args.n_scenes, args.n_points, args.iters,
-                     args.truncate_k, pretrain_steps=args.pretrain_steps)
+                     args.truncate_k, pretrain_steps=args.pretrain_steps,
+                     dataset=args.dataset, refine=args.refine)
     # Gates: continuous metrics within 1e-4; threshold metrics exact by the
     # margin construction (recorded as their own check so a flip is loud).
     checks = {

@@ -130,7 +130,8 @@ def main() -> int:
             "n/a" if args.epochs2 < 2
             else v2_after["epe3d"] <= refine_margin * v1["epe3d"]),
     }
-    applied = [k for k, v in checks.items() if v != "n/a"]
+    from scripts.convergence_record import gate_record
+
     record = {
         "platform": platform,
         "config": {"points": args.points, "objects": args.objects,
@@ -144,9 +145,7 @@ def main() -> int:
         "stage2": {"epochs": s2_epochs,
                    "val_epe3d_before": round(v2_before["epe3d"], 4),
                    "val_epe3d_after": round(v2_after["epe3d"], 4)},
-        "checks": checks,
-        "applied_checks": applied,
-        "ok": all(checks[k] for k in applied),
+        **gate_record(checks),
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:

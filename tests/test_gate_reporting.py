@@ -1,0 +1,102 @@
+"""Unit tests for the convergence-artifact gate logic (round-4 honesty
+fixes): not-applied checks record "n/a" (never a vacuous pass), `ok`
+aggregates only the applied checks, and per-task calibrated thresholds
+are selected correctly. Pure-python — no model, no jax arrays."""
+
+import json
+
+from scripts.convergence_record import (
+    EPE_ABS_THRESHOLD,
+    EPE_ABS_THRESHOLD_MULTIOBJ,
+    make_record,
+    recheck,
+    tail_best,
+    write_and_report,
+)
+
+
+def _traj(epes):
+    return [{"step": i, "loss": e, "epe": e} for i, e in enumerate(epes)]
+
+
+def _results(fp32_epes, fast_epes):
+    return [
+        {"variant": "fp32", "trajectory": _traj(fp32_epes),
+         "initial_epe": fp32_epes[0], "final_epe": fp32_epes[-1]},
+        {"variant": "bf16+approx", "trajectory": _traj(fast_epes),
+         "initial_epe": fast_epes[0], "final_epe": fast_epes[-1]},
+    ]
+
+
+def _good_run(floor, n=32):
+    # n logged points, monotone 2.0 -> floor: passes every shape gate.
+    return [2.0 - (2.0 - floor) * i / (n - 1) for i in range(n)]
+
+
+def test_short_run_abs_gate_is_na_not_true():
+    short = _good_run(0.5, n=8)
+    rec = make_record("cpu", {"steps": 60}, _results(short, short))
+    assert rec["checks"]["fp32_abs"] == "n/a"
+    assert rec["checks"]["fp32_quarters_nonincreasing"] == "n/a"  # <16 pts
+    assert "fp32_abs" not in rec["applied_checks"]
+    assert rec["ok"]  # rel + fast gates still applied and pass
+
+
+def test_full_run_applies_all_gates():
+    rec = make_record(
+        "cpu", {"steps": 200}, _results(_good_run(0.2), _good_run(0.22))
+    )
+    assert rec["checks"]["fp32_abs"] is True
+    assert sorted(rec["applied_checks"]) == sorted(rec["checks"])
+    assert rec["ok"]
+    assert rec["thresholds"]["epe_abs"] == EPE_ABS_THRESHOLD
+
+
+def test_multiobj_uses_its_own_calibrated_threshold():
+    # 0.28 fails the 1-object gate (0.25) but passes multi-object (0.30).
+    rec1 = make_record(
+        "cpu", {"steps": 200, "n_objects": 1},
+        _results(_good_run(0.28), _good_run(0.28)))
+    assert rec1["checks"]["fp32_abs"] is False and not rec1["ok"]
+    rec3 = make_record(
+        "cpu", {"steps": 200, "n_objects": 3},
+        _results(_good_run(0.28), _good_run(0.28)))
+    assert rec3["thresholds"]["epe_abs"] == EPE_ABS_THRESHOLD_MULTIOBJ
+    assert rec3["checks"]["fp32_abs"] is True and rec3["ok"]
+
+
+def test_failed_check_fails_ok_and_divergence_caught():
+    # Diverging tail: quarter medians increase.
+    up = _good_run(0.2)[:24] + [1.5] * 8
+    rec = make_record("cpu", {"steps": 200}, _results(up, up))
+    assert rec["checks"]["fp32_quarters_nonincreasing"] is False
+    assert not rec["ok"]
+
+
+def test_tail_best_ignores_final_spike():
+    epes = _good_run(0.1)
+    epes[-1] = 0.9  # batch-noise spike on the literal last step
+    assert tail_best(_traj(epes)) < 0.25
+
+
+def test_recheck_failure_writes_side_file_and_pass_cleans_it(tmp_path):
+    path = str(tmp_path / "conv.json")
+    bad = {
+        "platform": "cpu", "config": {"steps": 200},
+        "results": _results(_good_run(0.9), _good_run(0.9)),
+    }
+    with open(path, "w") as f:
+        json.dump(bad, f)
+    assert recheck(path) == 1
+    side = path + ".recheck_failed.json"
+    with open(side) as f:
+        assert not json.load(f)["ok"]
+    with open(path) as f:  # committed evidence untouched
+        assert "checks" not in json.load(f)
+
+    good = make_record("cpu", {"steps": 200},
+                       _results(_good_run(0.2), _good_run(0.2)))
+    assert write_and_report(good, path) == 0
+    import os
+
+    assert not os.path.exists(side)  # stale failure evidence removed

@@ -47,3 +47,35 @@ def test_eval_protocol_matches_reference(tmp_path):
     # Sanity: the comparison is non-degenerate (not 0% / 100% everywhere).
     ref = rec["reference"]
     assert 0.0 < ref["acc3d_relax"] < 1.0, ref
+
+
+def test_kitti_eval_protocol_matches_reference(tmp_path):
+    """Zero-shot KITTI leg: the reference's ``Kitti`` dataset applies
+    ground/far filters (``kitti_hplflownet.py:81-87``) before subsampling;
+    the generated scenes make the filters provably fire (a quarter of the
+    rows each) and still leave exactly nb_points survivors on both
+    sides."""
+    from scripts.protocol_parity import run_parity
+
+    rec = run_parity(str(tmp_path), n_scenes=2, n_points=128, iters=8,
+                     truncate_k=64, seed=2024, pretrain_steps=10,
+                     dataset="KITTI")
+    d = rec["abs_delta"]
+    assert d["loss"] <= 1e-4 and d["epe3d"] <= 1e-4, rec
+    assert all(d[k] <= 1e-6
+               for k in ("acc3d_strict", "acc3d_relax", "outlier")), rec
+
+
+def test_refine_eval_protocol_matches_reference(tmp_path):
+    """Stage-2 leg: ``RSF_refine`` at 32 iters with ``compute_loss`` on
+    the single refined flow (``test.py:124-126``) vs our refine
+    Evaluator."""
+    from scripts.protocol_parity import run_parity
+
+    rec = run_parity(str(tmp_path), n_scenes=2, n_points=128, iters=8,
+                     truncate_k=64, seed=2024, pretrain_steps=10,
+                     refine=True)
+    d = rec["abs_delta"]
+    assert d["loss"] <= 1e-4 and d["epe3d"] <= 1e-4, rec
+    assert all(d[k] <= 1e-6
+               for k in ("acc3d_strict", "acc3d_relax", "outlier")), rec
