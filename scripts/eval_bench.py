@@ -76,8 +76,12 @@ out = {"platform": platform, "points": n, "iters": iters,
        "truncate_k": cfg.truncate_k, "protocol": "test.py:92,120 (bs=1)"}
 
 
-def time_scenes(bs):
-    batches = [make_batch(bs) for _ in range(args.steps + 1)]
+def time_scenes(bs, reps=2):
+    """Mean sec/step over ``reps`` repeats of ``args.steps`` fresh-input
+    calls, plus the per-rep means — the spread field lets a reader
+    classify round-over-round drift as noise vs regression (same
+    convention as bench.py's dt_reps)."""
+    batches = [make_batch(bs) for _ in range(reps * args.steps + 1)]
     t0 = time.perf_counter()
     metrics, flow = step(params, batches[0])  # compile
     jax.block_until_ready(flow)
@@ -85,25 +89,41 @@ def time_scenes(bs):
     if not np.isfinite(float(metrics["epe3d"] if "epe3d" in metrics
                              else metrics["loss"])):
         raise FloatingPointError("non-finite eval metric")
-    t0 = time.perf_counter()
-    for b in batches[1:]:
-        metrics, flow = step(params, b)
-    jax.block_until_ready(flow)
-    dt = (time.perf_counter() - t0) / args.steps
-    return bs / dt, dt
+    dts = []
+    rest = batches[1:]
+    for r in range(reps):
+        chunk = rest[r * args.steps:(r + 1) * args.steps]
+        t0 = time.perf_counter()
+        for b in chunk:
+            metrics, flow = step(params, b)
+        jax.block_until_ready(flow)
+        dts.append((time.perf_counter() - t0) / len(chunk))
+    dt = sum(dts) / len(dts)
+    return {
+        "scenes_per_sec": bs / dt,
+        "sec_per_step": round(dt, 4),
+        "sec_per_step_reps": [round(d, 4) for d in dts],
+        "rep_spread": round((max(dts) - min(dts)) / max(dt, 1e-12), 4),
+    }
 
 
-scenes_per_sec, dt = time_scenes(1)
+t1 = time_scenes(1)
+scenes_per_sec = t1["scenes_per_sec"]
 out["eval_scenes_per_sec"] = round(scenes_per_sec, 3)
-out["sec_per_scene"] = round(dt, 4)
+out["sec_per_scene"] = t1["sec_per_step"]
+out["sec_per_scene_reps"] = t1["sec_per_step_reps"]
+out["rep_spread"] = t1["rep_spread"]
 out["ft3d_test_3824_scenes_min"] = round(3824 / scenes_per_sec / 60, 1)
 
 if args.batched:
     try:
-        bsps, bdt = time_scenes(args.batched)
+        tb = time_scenes(args.batched)
         out["batched"] = {"eval_batch": args.batched,
-                          "eval_scenes_per_sec": round(bsps, 3),
-                          "speedup_vs_bs1": round(bsps / scenes_per_sec, 2)}
+                          "eval_scenes_per_sec": round(tb["scenes_per_sec"], 3),
+                          "sec_per_step_reps": tb["sec_per_step_reps"],
+                          "rep_spread": tb["rep_spread"],
+                          "speedup_vs_bs1": round(
+                              tb["scenes_per_sec"] / scenes_per_sec, 2)}
     except Exception as e:  # batched leg is a bonus, not the artifact
         out["batched"] = {"error": repr(e)[:200]}
 
