@@ -57,7 +57,9 @@ CALIBRATION = {
     "epe_abs": "0.25: just above the 200-step fp32 floor 0.2216 of "
                "artifacts/convergence_cpu.json (1-object, 2048 pts)",
     "epe_abs_multiobj": "0.30: just above the 120-step fp32 floor 0.2431 "
-                        "of artifacts/convergence_cpu_multiobj.json",
+                        "of the original multiobj record (git 45ed1a5:"
+                        "artifacts/convergence_cpu_multiobj.json; the live "
+                        "file now holds the 200-step run, floor 0.167)",
     "epe_rel": "0.2: requires a 5x drop; the committed 200-step run drops "
                "8.2x",
     "fast_ratio": "1.6: committed bf16/fp32 tail-best ratios are 0.87-1.04",

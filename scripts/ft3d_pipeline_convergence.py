@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Accuracy trajectory through the REAL FT3D pipeline.
+
+The convergence records (scripts/convergence_record.py) train on the
+in-memory SyntheticDataset; this record instead ties the trajectory to the
+PRODUCTION data path a real FT3D run would use: piecewise-rigid scenes are
+written to disk in the FT3D layout (``train/0*`` + ``val/0*`` dirs of
+``pc1.npy``/``pc2.npy`` with MORE points than ``max_points``, so the
+exact-N subsampling genuinely subsamples), then the full ``Trainer`` runs
+over them through the ``FT3D`` dataset class (x/z flip, linspace train/val
+split — ``datasets/flyingthings3d_hplflownet.py:48-71,100-107`` semantics),
+the prefetch loader (native C++ assembler when available), per-epoch
+sharded val, best-EPE checkpointing, and the final test pass that reloads
+the best checkpoint (``tools/engine.py:191``).
+
+What this certifies beyond the existing records: the loader/subsample/
+flip/split/checkpoint machinery does not distort training — the model
+converges through the same code a real dataset run would execute.
+
+Usage: python scripts/ft3d_pipeline_convergence.py [--out PATH] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def write_corpus(root: str, n_train: int, n_test: int, nb_points: int,
+                 extra: int, n_objects: int, seed: int) -> None:
+    """FT3D-layout corpus from the piecewise-rigid generator. Scenes carry
+    ``nb_points + [0, extra)`` points so the pipeline's permutation
+    subsampling (``generic.py:181-191`` role) actually selects subsets.
+    The on-disk clouds get the x/z sign pre-flip so the FT3D loader's
+    un-flip recovers the generated geometry exactly."""
+    from pvraft_tpu.data import SyntheticDataset
+
+    ds = SyntheticDataset(size=n_train + n_test, nb_points=nb_points,
+                          extra_points=extra, noise=0.01, seed=seed,
+                          n_objects=n_objects)
+    for i in range(n_train + n_test):
+        pc1, pc2, _, _ = ds.load_sequence(i)
+        for pc in (pc1, pc2):
+            pc[:, 0] *= -1.0
+            pc[:, -1] *= -1.0
+        sub = "train" if i < n_train else "val"
+        scene = os.path.join(root, sub, f"{i:07d}")
+        os.makedirs(scene, exist_ok=True)
+        np.save(os.path.join(scene, "pc1.npy"), pc1)
+        np.save(os.path.join(scene, "pc2.npy"), pc2)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/ft3d_pipeline_convergence.json")
+    ap.add_argument("--points", type=int, default=1024)
+    ap.add_argument("--extra", type=int, default=256)
+    ap.add_argument("--train_scenes", type=int, default=64)
+    ap.add_argument("--test_scenes", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--objects", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend (config API — env vars are "
+                         "too late under the TPU plugin's sitecustomize)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+
+    from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from pvraft_tpu.engine.trainer import Trainer
+    from pvraft_tpu.parallel.mesh import make_mesh
+
+    work = tempfile.mkdtemp(prefix="ft3d_pipeline_")
+    root = os.path.join(work, "data")
+    write_corpus(root, args.train_scenes, args.test_scenes, args.points,
+                 args.extra, args.objects, seed=11)
+
+    cfg = Config(
+        model=ModelConfig(truncate_k=128, corr_knn=16, graph_k=16,
+                          use_pallas=False),
+        data=DataConfig(dataset="FT3D", root=root, max_points=args.points,
+                        num_workers=2, strict_sizes=False,
+                        native_loader=True),
+        train=TrainConfig(batch_size=2, num_epochs=args.epochs, iters=4,
+                          eval_iters=8, checkpoint_interval=0, eval_batch=1,
+                          seed=3),
+        exp_path=os.path.join(work, "exp"),
+    )
+    tr = Trainer(cfg, mesh=make_mesh(n_data=1))
+    native = tr.train_loader.native
+
+    # Pre-training val: the convergence gate must measure from the
+    # untrained level — epoch 0's val already reflects a full epoch of
+    # training and understates the drop.
+    v_init = tr.val_test(-1, "val")
+    val_init = round(v_init["epe3d"], 4)
+    print(f"[pipeline] pre-training val_epe {val_init:.4f}", flush=True)
+
+    epochs = []
+    for epoch in range(args.epochs):
+        tm = tr.training(epoch)
+        vm = tr.val_test(epoch, "val")
+        epochs.append({"epoch": epoch,
+                       "train_loss": round(tm["loss"], 4),
+                       "train_epe": round(tm["epe"], 4),
+                       "val_epe3d": round(vm["epe3d"], 4)})
+        print(f"[pipeline] epoch {epoch}: train_epe {tm['epe']:.4f} "
+              f"val_epe {vm['epe3d']:.4f}", flush=True)
+    test = tr.val_test(args.epochs - 1, "test")  # reloads best checkpoint
+
+    from scripts.convergence_record import gate_record
+
+    val_best = min(e["val_epe3d"] for e in epochs)
+    checks = {
+        # The pipeline must not distort training: a 2x val-EPE drop from
+        # the UNTRAINED level (observed headroom is far larger on the
+        # synthetic records; this gate is a pipeline-sanity tripwire, not
+        # an accuracy claim). Short smokes (<4 epochs) haven't had time.
+        "val_epe_halves": (val_best <= 0.5 * val_init
+                           if args.epochs >= 4 else "n/a"),
+        "train_epe_decreases": (epochs[-1]["train_epe"]
+                                < epochs[0]["train_epe"]),
+        # Zero-shot-style final test through the best-checkpoint reload.
+        "test_close_to_best_val": test["epe3d"] <= 2.0 * val_best,
+        "finite": all(np.isfinite([e["val_epe3d"] for e in epochs]).tolist()),
+    }
+    record = {
+        "platform": platform,
+        "config": {"points": args.points, "extra": args.extra,
+                   "train_scenes": args.train_scenes,
+                   "test_scenes": args.test_scenes,
+                   "epochs": args.epochs, "objects": args.objects,
+                   "eval_iters": 8, "native_loader_active": bool(native)},
+        "val_epe3d_untrained": val_init,
+        "epochs": epochs,
+        "test": {k: round(v, 4) for k, v in test.items()},
+        **gate_record(checks),
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps(record))
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
