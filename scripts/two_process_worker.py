@@ -41,9 +41,16 @@ def main():
     ap.add_argument("--process_id", type=int, default=0)
     ap.add_argument("--exp_path", required=True,
                     help="shared experiment dir (checkpoints land here)")
-    ap.add_argument("--out", required=True, help="npz dump path")
+    ap.add_argument("--out", required=True,
+                    help="result path: train mode writes <out> (npz of "
+                         "params+metrics) plus <out>.json; eval mode "
+                         "writes only <out>.json")
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--eval_batch", type=int, default=4)
+    ap.add_argument("--mode", default="train", choices=["train", "eval"],
+                    help="train: full Trainer recipe; eval: the standalone "
+                         "Evaluator with scene-sharding across processes "
+                         "(engine/evaluator.py + eval_scene_shard)")
     args = ap.parse_args()
 
     import jax
@@ -62,6 +69,31 @@ def main():
 
     from pvraft_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
     from pvraft_tpu.engine.trainer import Trainer
+
+    if args.mode == "eval":
+        # Standalone Evaluator: 16 synthetic scenes, eval_batch=4 -> the
+        # scene-shard gate fires for 2 processes (16 % (4*2) == 0,
+        # 4 % local_data(4) == 0) and stays off single-process (4 is not
+        # a multiple of the 8-device data axis -> replicate path, exact).
+        from pvraft_tpu.engine.evaluator import Evaluator
+
+        cfg = Config(
+            model=ModelConfig(truncate_k=16, corr_knn=8, graph_k=8),
+            data=DataConfig(dataset="synthetic", synthetic_size=16,
+                            max_points=64, num_workers=0),
+            train=TrainConfig(eval_iters=2, eval_batch=args.eval_batch),
+            exp_path=args.exp_path,
+        )
+        ev = Evaluator(cfg)
+        means = ev.run(log_every=0)
+        if jax.process_index() == 0:
+            with open(args.out + ".json", "w") as f:
+                json.dump({"means": means,
+                           "shard_world": ev.shard[1],
+                           "process_count": jax.process_count()}, f,
+                          indent=2)
+        print("eval worker done", jax.process_index())
+        return
 
     cfg = Config(
         model=ModelConfig(truncate_k=16, corr_knn=8, graph_k=8),

@@ -45,26 +45,24 @@ def _env(n_devices: int) -> dict:
     return env
 
 
-def test_two_process_matches_single_process(tmp_path):
-    port = _free_port()
-    coord = f"localhost:{port}"
+def _run_worker_pair(tmp_path, tag, extra_args, out_for, timeout=1500):
+    """Launch 2 lock-stepped workers and wait for both.
 
-    # --- 2 processes x 4 devices ------------------------------------------
-    # Workers write stdout to FILES, not PIPEs: both processes run in
-    # collective lockstep, so if one blocked on a full 64 KB pipe buffer
-    # while the other was being drained first, both would deadlock until
-    # the timeout.
-    outs = [str(tmp_path / f"two_{i}.npz") for i in range(2)]
-    log_paths = [tmp_path / f"worker_{i}.log" for i in range(2)]
+    Workers write stdout to FILES, not PIPEs: both processes run in
+    collective lockstep, so if one blocked on a full 64 KB pipe buffer
+    while the other was being drained first, both would deadlock until
+    the timeout. A hung peer is killed so it can't leak past the test.
+    ``out_for(i)`` gives worker i's --out value."""
+    port = _free_port()
+    log_paths = [tmp_path / f"{tag}_{i}.log" for i in range(2)]
     log_files = [open(p, "w") for p in log_paths]
     try:
         procs = [
             subprocess.Popen(
                 [sys.executable, WORKER,
-                 "--coordinator", coord, "--num_processes", "2",
-                 "--process_id", str(i),
-                 "--exp_path", str(tmp_path / "exp_two"),
-                 "--out", outs[i]],
+                 "--coordinator", f"localhost:{port}",
+                 "--num_processes", "2", "--process_id", str(i),
+                 "--out", out_for(i), *extra_args],
                 env=_env(4), stdout=log_files[i],
                 stderr=subprocess.STDOUT,
             )
@@ -72,10 +70,10 @@ def test_two_process_matches_single_process(tmp_path):
         ]
         try:
             for p in procs:
-                p.wait(timeout=1500)
+                p.wait(timeout=timeout)
         finally:
             for p in procs:
-                if p.poll() is None:  # a hung peer would leak otherwise
+                if p.poll() is None:
                     p.kill()
     finally:
         for f in log_files:
@@ -83,6 +81,16 @@ def test_two_process_matches_single_process(tmp_path):
     for i, p in enumerate(procs):
         assert p.returncode == 0, (
             f"worker {i} failed:\n{log_paths[i].read_text()[-4000:]}")
+
+
+def test_two_process_matches_single_process(tmp_path):
+    # --- 2 processes x 4 devices ------------------------------------------
+    outs = [str(tmp_path / f"two_{i}.npz") for i in range(2)]
+    _run_worker_pair(
+        tmp_path, "worker",
+        ["--exp_path", str(tmp_path / "exp_two")],
+        out_for=lambda i: outs[i],
+    )
 
     # --- 1 process x 8 devices (identical recipe) -------------------------
     single_out = str(tmp_path / "single.npz")
@@ -142,3 +150,41 @@ def test_two_process_matches_single_process(tmp_path):
     # the files exist for a future resume.
     ckpts = os.listdir(tmp_path / "exp_two" / "checkpoints")
     assert any(c.startswith("last_checkpoint") for c in ckpts), ckpts
+
+
+def test_two_process_evaluator_scene_sharding(tmp_path):
+    """The STANDALONE Evaluator's multi-host scene-sharding
+    (engine/evaluator.py + eval_scene_shard) under real processes: 2 x 4
+    devices split the 16 scenes (shard gate fires), single-process runs
+    them replicated — the mean*count accumulation must make the metric
+    means identical up to fp reassociation."""
+    import json
+
+    out2 = str(tmp_path / "eval_two")
+    _run_worker_pair(
+        tmp_path, "evalw",
+        ["--mode", "eval", "--exp_path", str(tmp_path / "exp_eval2")],
+        out_for=lambda i: out2,
+        timeout=900,
+    )
+
+    out1 = str(tmp_path / "eval_single")
+    p = subprocess.run(
+        [sys.executable, WORKER, "--mode", "eval",
+         "--exp_path", str(tmp_path / "exp_eval1"), "--out", out1],
+        env=_env(8), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=900,
+    )
+    assert p.returncode == 0, p.stdout.decode(errors="replace")[-4000:]
+
+    with open(out2 + ".json") as f:
+        two = json.load(f)
+    with open(out1 + ".json") as f:
+        single = json.load(f)
+    # The 2-process run really scene-sharded (the gate fired).
+    assert two["process_count"] == 2 and two["shard_world"] == 2, two
+    assert single["shard_world"] == 1
+    assert set(two["means"]) == set(single["means"])
+    for k in single["means"]:
+        assert abs(two["means"][k] - single["means"][k]) <= 1e-5, (
+            k, two["means"], single["means"])
