@@ -109,6 +109,15 @@ def _child_variant(name: str) -> None:
 
     platform = jax.devices()[0].platform
     unroll = int(os.environ.get("PVRAFT_BENCH_UNROLL", 1))
+    if (platform == "tpu" and name == "fp32"
+            and N_POINTS >= 8192 and BATCH >= 2):
+        # Plain fp32 fwd+bwd+adam needs 19.5 GiB HBM at the flagship
+        # shape — over a 16 GiB v5e chip (AOT-certified,
+        # artifacts/aot_readiness.json) — so the fp32 rung checkpoints
+        # each GRU iteration on TPU. Identical floats, extra recompute
+        # FLOPs: acceptable in a last-rung fallback that otherwise OOMs.
+        # CPU fallback keeps remat off for round-over-round continuity.
+        kwargs = dict(kwargs, remat=True)
     cfg = ModelConfig(truncate_k=TRUNCATE_K, scan_unroll=unroll, **kwargs)
     model = PVRaft(cfg)
 
@@ -224,9 +233,14 @@ def _child_variant(name: str) -> None:
                       "dt_reps": [round(d, 4) for d in dt_reps],
                       "dt_spread": round(spread, 4),
                       "timing_reps": len(dt_reps),
-                      "steps_per_rep": n_steps,
+                      # Per-rep so a mixed-step-count rep list can never
+                      # masquerade as run-to-run spread (every path above
+                      # re-times the chosen strategy at n_steps before it
+                      # becomes rep 1; this records that invariant).
+                      "steps_per_rep": [n_steps] * len(dt_reps),
                       "platform": platform, "strategy": strategy,
-                      "points": N_POINTS, "batch": BATCH, "iters": ITERS}))
+                      "points": N_POINTS, "batch": BATCH, "iters": ITERS,
+                      "remat": cfg.remat}))
 
 
 def _child_eval(name: str) -> None:

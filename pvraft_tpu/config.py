@@ -66,6 +66,28 @@ class ModelConfig:
                 f"({self.truncate_k}): the kNN branch selects among the "
                 f"truncated correlation candidates"
             )
+        # The three correlation-build strategies (dense, chunked streaming,
+        # sequence-parallel ring) honor different knobs; reject
+        # contradictory combinations instead of silently ignoring one side
+        # (a benchmark labeled "approx + 2-chip SP" must not silently
+        # measure exact top-k). Full honor/ignore table: PARITY.md
+        # "Correlation-path config matrix".
+        if self.approx_topk and self.seq_shard:
+            raise ValueError(
+                "approx_topk is not supported with seq_shard: the ring "
+                "correlation (parallel/ring.py) assembles the EXACT "
+                "truncated top-k across seq shards and would silently "
+                "ignore approx_topk; benchmark approx_topk on the "
+                "unsharded correlation path only"
+            )
+        if self.corr_chunk is not None and self.seq_shard:
+            raise ValueError(
+                "corr_chunk is not supported with seq_shard: both knobs "
+                "select a correlation-build strategy (chunked streaming "
+                "vs ppermute ring); the ring already bounds per-chip "
+                "memory by the seq-shard width, so drop corr_chunk on "
+                "sharded runs"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

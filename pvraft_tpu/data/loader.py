@@ -79,6 +79,23 @@ class PrefetchLoader:
         rank, world = shard
         if not (0 <= rank < world):
             raise ValueError(f"shard rank {rank} outside world {world}")
+        if world > 1 and not drop_last and len(dataset) % (batch_size * world):
+            # Sharded epochs keep only full GLOBAL batches (see epoch()):
+            # ranks running different step counts would deadlock the
+            # collectives. That silently supersedes drop_last=False — up
+            # to batch_size*world-1 tail samples per epoch would vanish,
+            # which for an eval loader means skipped scenes and biased
+            # means. Refuse instead of biasing; callers that accept the
+            # truncation should pass drop_last=True explicitly.
+            raise ValueError(
+                f"drop_last=False with shard world={world} requires "
+                f"len(dataset) ({len(dataset)}) divisible by "
+                f"batch_size*world ({batch_size * world}): the sharded "
+                f"epoch keeps only full global batches, so the "
+                f"{len(dataset) % (batch_size * world)}-sample tail would "
+                f"be silently dropped; pass drop_last=True to accept "
+                f"truncation or pad/shard the dataset exactly"
+            )
         self.shard = (rank, world)
         self.native_max_rows = native_max_rows
         self.native = False

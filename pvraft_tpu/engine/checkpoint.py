@@ -37,6 +37,9 @@ ORBAX_SUFFIX = ".orbax"
 _orbax_writer = None
 # (tmp_dir, final_dir, extra_final_dirs) owed once the async write commits.
 _orbax_pending: list = []
+# Failed recoveries after which an epoch-unreadable debt is retired loudly
+# instead of warning on every recovery forever (round-4 advisor).
+_MAX_DEBT_KEEPS = 3
 
 
 def _write(path: str, payload: Dict[str, Any]) -> None:
@@ -72,6 +75,41 @@ def _swap_in(tmp: str, dst: str) -> None:
         shutil.rmtree(old)
 
 
+def _promote_ckpt(tmp: str, dst: str) -> None:
+    """``_swap_in`` plus epoch-sidecar maintenance. Ordering matters: the
+    destination's old ``.epoch.json`` is removed BEFORE the swap and the
+    tmp's moved in AFTER, so a crash anywhere between leaves the sidecar
+    MISSING (readers fall back to a full restore) but never STALE — a
+    stale epoch could misdirect debt delivery in ``_recover_leftover_tmp``."""
+    epoch_sidecar = dst + ".epoch.json"
+    if os.path.isfile(epoch_sidecar):
+        os.unlink(epoch_sidecar)
+    _swap_in(tmp, dst)
+    if os.path.isfile(tmp + ".epoch.json"):
+        os.replace(tmp + ".epoch.json", epoch_sidecar)
+
+
+def _read_dst_epoch(dst: str):
+    """Epoch of the promoted checkpoint at ``dst``. Cheap path: the
+    ``.epoch.json`` sidecar written at save time. Fallback (sidecar
+    missing — pre-sidecar checkpoints, or a crash inside ``_promote_ckpt``):
+    one full orbax restore, which drags params+opt_state into host memory
+    just to read an int — exactly what the sidecar exists to avoid."""
+    import json
+
+    try:
+        with open(dst + ".epoch.json") as f:
+            return int(json.load(f)["epoch"])
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    for _ in range(2):  # one retry absorbs transient read failures
+        try:
+            return int(_orbax().restore(os.path.abspath(dst))["epoch"])
+        except Exception:
+            continue
+    return None
+
+
 def _orbax_promote() -> None:
     """Swap committed tmp directories into their final names and copy them
     to the extra name classes (NNN/best). Caller must have settled the
@@ -87,7 +125,7 @@ def _orbax_promote() -> None:
         tmp, dst, extras = _orbax_pending.pop(0)
         if not os.path.exists(tmp):
             continue  # already recovered by find_checkpoint
-        _swap_in(tmp, dst)
+        _promote_ckpt(tmp, dst)
         _copy_extras(dst, extras)
         sidecar = tmp + ".extras.json"
         if os.path.isfile(sidecar):  # owed copies delivered; retire it
@@ -156,7 +194,7 @@ def _recover_leftover_tmp(dst: str) -> None:
     sidecar = tmp + ".extras.json"
     if jax.process_index() == 0:
         if os.path.isdir(tmp):
-            _swap_in(tmp, dst)
+            _promote_ckpt(tmp, dst)
         # Re-create the NNN/best copies the dying run still owed (the
         # sidecar records them at save time; without it only
         # last_checkpoint would survive a crash between the async commit
@@ -174,16 +212,9 @@ def _recover_leftover_tmp(dst: str) -> None:
             except (OSError, ValueError):
                 meta = {}
             debts = _sidecar_debts(meta)
-            unresolved = []
+            unresolved, retired = [], 0
             if debts and os.path.isdir(dst):
-                dst_epoch = None
-                for _ in range(2):  # one retry absorbs transient failures
-                    try:
-                        dst_epoch = int(_orbax().restore(
-                            os.path.abspath(dst))["epoch"])
-                        break
-                    except Exception:
-                        continue
+                dst_epoch = _read_dst_epoch(dst)
                 for debt in debts:
                     owed_epoch = debt.get("epoch")
                     extras = debt.get("extras", [])
@@ -197,13 +228,28 @@ def _recover_leftover_tmp(dst: str) -> None:
                         # LATER recovery can still deliver the owed copies
                         # — unlinking here would drop them silently. The
                         # next _orbax_write appends its own debt to this
-                        # sidecar rather than clobbering it; the debt dies
-                        # only when dst is readable with a different epoch
-                        # (the owed payload is genuinely gone).
-                        unresolved.append(debt)
+                        # sidecar rather than clobbering it. The debt dies
+                        # when dst is readable with a different epoch (the
+                        # owed payload is genuinely gone) or after
+                        # _MAX_DEBT_KEEPS failed recoveries (a permanently
+                        # unreadable dst must not warn forever).
+                        kept = int(debt.get("kept", 0)) + 1
+                        if kept >= _MAX_DEBT_KEEPS:
+                            retired += 1
+                        else:
+                            unresolved.append({**debt, "kept": kept})
                     # else: dst readable but a different epoch — the owed
                     # payload never committed (or was since replaced);
                     # the debt is undeliverable, retire it.
+            if retired:
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint recovery: retiring {retired} debt(s) "
+                    f"after {_MAX_DEBT_KEEPS} recoveries with an "
+                    f"unreadable epoch at {dst} — the owed NNN/best "
+                    f"copies will NOT be re-created; inspect {dst} "
+                    f"manually if they matter")
             if unresolved:
                 import warnings
 
@@ -252,6 +298,15 @@ def _orbax_write(path: str, payload: Dict[str, Any], extras=()) -> None:
         # crashed runs don't accumulate multi-MB orphans.
         for orphan in glob.glob(tmp + ".orbax-checkpoint-tmp-*"):
             shutil.rmtree(orphan, ignore_errors=True)
+    if jax.process_index() == 0:
+        # Tiny epoch sidecar so recovery / resume can learn the epoch of a
+        # promoted checkpoint without a full orbax restore of
+        # params+opt_state into host memory (_read_dst_epoch). Travels
+        # with the directory through _promote_ckpt.
+        import json as _json
+
+        with open(tmp + ".epoch.json", "w") as f:
+            _json.dump({"epoch": int(payload["epoch"])}, f)
     if extras and jax.process_index() == 0:
         # Sidecar so a crash after the async commit but before promote can
         # still re-create the NNN/best copies from the adopted tmp

@@ -1,0 +1,29 @@
+"""Pallas TPU kernels (Mosaic) with CPU interpreter fallback.
+
+``interpret_mode()`` decides whether ``pl.pallas_call`` runs the
+interpreter (CPU tests) or compiles through Mosaic (TPU). The
+``PVRAFT_PALLAS_INTERPRET`` env var overrides the backend-based default:
+``0`` forces compiled mode — used by ``scripts/aot_readiness.py`` to
+deviceless-compile the kernels against a TPU topology from a CPU host
+(the backend there is cpu, but the target is tpu) — and ``1`` forces the
+interpreter.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def interpret_mode() -> bool:
+    force = os.environ.get("PVRAFT_PALLAS_INTERPRET")
+    if force is not None:
+        if force not in ("0", "1"):
+            # A typo like "true" silently forcing compiled mode would
+            # surface as an opaque Mosaic lowering error on CPU hosts.
+            raise ValueError(
+                f"PVRAFT_PALLAS_INTERPRET must be '0' or '1', got {force!r}"
+            )
+        return force == "1"
+    import jax
+
+    return jax.default_backend() == "cpu"

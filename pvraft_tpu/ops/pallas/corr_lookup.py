@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from pvraft_tpu.ops.pallas import interpret_mode
+
 from pvraft_tpu.ops.pallas.voxel_corr import (
     _pick_tile,
     _voxel_bwd,
@@ -116,7 +118,7 @@ def _fused_forward(
         in_specs=[cand_spec] * 4 + [coord_spec] * 3,
         out_specs=(out_spec, knn_spec, knn_spec, knn_spec, knn_spec),
         out_shape=out_shapes,
-        interpret=jax.default_backend() == "cpu",
+        interpret=interpret_mode(),
     )(
         corr,
         xyz[..., 0], xyz[..., 1], xyz[..., 2],

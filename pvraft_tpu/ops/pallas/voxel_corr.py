@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from pvraft_tpu.ops.pallas import interpret_mode
+
 
 def _pick_tile(n: int, target: int = 64) -> int:
     """Largest divisor of n that is <= target (prefer multiples of 8)."""
@@ -122,7 +124,7 @@ def _voxel_forward_pallas(
             (1, tile, num_levels * r3), lambda bi, ni: (bi, ni, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((b, n, num_levels * r3), corr.dtype),
-        interpret=jax.default_backend() == "cpu",
+        interpret=interpret_mode(),
     )(corr, relx, rely, relz)
 
 

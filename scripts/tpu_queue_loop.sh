@@ -22,7 +22,7 @@ for cycle in $(seq 1 12); do
         exit 0
     fi
     echo "[tpu_queue_loop] cycle $cycle: launching tpu_batch.sh at $(date -u +%FT%TZ)"
-    bash scripts/tpu_batch.sh >> artifacts/logs/tpu_batch_r4.log 2>&1
+    bash scripts/tpu_batch.sh >> artifacts/logs/tpu_batch_r5.log 2>&1
     rc=$?
     echo "[tpu_queue_loop] cycle $cycle: tpu_batch rc=$rc at $(date -u +%FT%TZ)"
     if [ "$rc" -eq 0 ]; then

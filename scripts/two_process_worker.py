@@ -51,6 +51,24 @@ def main():
                     help="train: full Trainer recipe; eval: the standalone "
                          "Evaluator with scene-sharding across processes "
                          "(engine/evaluator.py + eval_scene_shard)")
+    ap.add_argument("--ckpt_backend", default="msgpack",
+                    choices=["msgpack", "orbax"])
+    ap.add_argument("--die_before_promote", action="store_true",
+                    help="orbax crash shape: exit after the async commit "
+                         "settles but WITHOUT promoting the final "
+                         "checkpoint (no wait_for_saves; hard exit) — the "
+                         "last epoch's .tmp + sidecars stay on disk for a "
+                         "resuming pair to recover")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the shared ckpt_dir's last_checkpoint "
+                         "(exercises wait_for_saves + _recover_leftover_tmp "
+                         "+ _sync_hosts across the real process pair)")
+    ap.add_argument("--skip_val", action="store_true",
+                    help="train-only epochs (no val pass, so no best-"
+                         "checkpoint saves: each orbax save's promote is "
+                         "deferred to the NEXT save, leaving the final "
+                         "last_checkpoint as the unpromoted .tmp for the "
+                         "die_before_promote crash shape)")
     args = ap.parse_args()
 
     import jax
@@ -101,29 +119,69 @@ def main():
                         num_workers=0),
         train=TrainConfig(batch_size=1, num_epochs=args.epochs, iters=2,
                           eval_iters=2, eval_batch=args.eval_batch,
-                          checkpoint_interval=1, seed=7),
+                          checkpoint_interval=1, seed=7,
+                          ckpt_backend=args.ckpt_backend),
         exp_path=args.exp_path,
     )
     tr = Trainer(cfg)
+    resumed_from = None
+    if args.resume:
+        from pvraft_tpu.engine.checkpoint import latest_checkpoint
+
+        # latest_checkpoint -> wait_for_saves + _recover_leftover_tmp:
+        # with a dead run's committed-but-unpromoted .tmp on disk, this is
+        # the real multi-process recovery path (process-0 adoption +
+        # _sync_hosts barriers + sidecar debt delivery).
+        path = latest_checkpoint(os.path.join(args.exp_path, "checkpoints"))
+        assert path is not None, "resume requested but no checkpoint found"
+        tr.load_weights(path, resume=True)
+        resumed_from = tr.begin_epoch
     history = []
-    for epoch in range(cfg.train.num_epochs):
+    for epoch in range(tr.begin_epoch, cfg.train.num_epochs):
         tm = tr.training(epoch)
-        vm = tr.val_test(epoch, "val")
+        vm = None if args.skip_val else tr.val_test(epoch, "val")
         history.append({"train": tm, "val": vm})
 
+    if args.die_before_promote:
+        # Crash shape "death between the async commit and the deferred
+        # promote": settle the background write so the .tmp directory is
+        # durable and complete, barrier so BOTH processes committed, then
+        # hard-exit without _orbax_promote/wait_for_saves. The final
+        # epoch's checkpoint exists only as .tmp (+ .epoch.json/.extras
+        # sidecars) until a later run recovers it.
+        from pvraft_tpu.engine.checkpoint import _orbax, _sync_hosts
+
+        _orbax().wait_until_finished()
+        _sync_hosts("test-die-before-promote")
+        if jax.process_index() == 0:
+            ckpts = sorted(os.listdir(
+                os.path.join(args.exp_path, "checkpoints")))
+            with open(args.out + ".json", "w") as f:
+                json.dump({"died_before_promote": True,
+                           "epochs_run": len(history),
+                           "ckpt_dir": ckpts}, f, indent=2)
+        print("worker dying before promote", jax.process_index(), flush=True)
+        os._exit(0)
+
+    from pvraft_tpu.engine.checkpoint import wait_for_saves
+
+    wait_for_saves()
     if jax.process_index() == 0:
         leaves = jax.tree_util.tree_leaves_with_path(
             jax.tree_util.tree_map(np.asarray, tr.params))
         dump = {jax.tree_util.keystr(k): v for k, v in leaves}
-        dump["__val_epe3d"] = np.asarray(
-            [h["val"]["epe3d"] for h in history])
-        dump["__val_loss"] = np.asarray([h["val"]["loss"] for h in history])
+        if not args.skip_val:
+            dump["__val_epe3d"] = np.asarray(
+                [h["val"]["epe3d"] for h in history])
+            dump["__val_loss"] = np.asarray(
+                [h["val"]["loss"] for h in history])
         dump["__train_loss"] = np.asarray(
             [h["train"]["loss"] for h in history])
         np.savez(args.out, **dump)
         with open(args.out + ".json", "w") as f:
             json.dump({"history": history,
                        "val_shard_world": tr._val_shard[1],
+                       "resumed_from_epoch": resumed_from,
                        "process_count": jax.process_count()}, f, indent=2)
     print("worker done", jax.process_index())
 

@@ -11,6 +11,23 @@ def test_corr_knn_validation():
     ModelConfig(truncate_k=32, corr_knn=32)  # boundary OK
 
 
+def test_seq_shard_rejects_contradictory_corr_knobs():
+    # The ring path would silently ignore approx_topk / corr_chunk
+    # (models/raft.py routes past them); the config must refuse instead
+    # (PARITY.md "Correlation-path config matrix").
+    with pytest.raises(ValueError, match="approx_topk.*seq_shard"):
+        ModelConfig(approx_topk=True, seq_shard=True)
+    with pytest.raises(ValueError, match="corr_chunk.*seq_shard"):
+        ModelConfig(corr_chunk=1024, seq_shard=True)
+    ModelConfig(seq_shard=True)  # alone: fine
+    ModelConfig(approx_topk=True)  # alone: fine
+    import dataclasses
+
+    # replace() re-runs validation on frozen dataclasses.
+    with pytest.raises(ValueError, match="seq_shard"):
+        dataclasses.replace(ModelConfig(approx_topk=True), seq_shard=True)
+
+
 def test_compute_dtype_mapping():
     import jax.numpy as jnp
 

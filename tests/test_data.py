@@ -172,6 +172,22 @@ def test_loader_shard_disjoint_and_covering():
     assert counts == [(2, 2)] * world
 
 
+def test_loader_shard_rejects_indivisible_drop_last_false():
+    """Sharded epochs keep only full global batches; with drop_last=False
+    and an indivisible dataset that would silently skip tail samples
+    (biased eval means) — the loader must refuse up front."""
+    from pvraft_tpu.data import PrefetchLoader, SyntheticDataset
+
+    ds13 = SyntheticDataset(size=13, nb_points=32, seed=0)
+    with pytest.raises(ValueError, match="drop_last"):
+        PrefetchLoader(ds13, 2, drop_last=False, num_workers=0, shard=(0, 3))
+    # Exactly divisible: allowed (the eval_scene_shard pattern).
+    ds12 = SyntheticDataset(size=12, nb_points=32, seed=0)
+    PrefetchLoader(ds12, 2, drop_last=False, num_workers=0, shard=(0, 3))
+    # Unsharded: drop_last=False keeps its normal meaning.
+    PrefetchLoader(ds13, 2, drop_last=False, num_workers=0)
+
+
 def test_device_prefetch_order_and_pipelining():
     """device_prefetch yields every item in order and issues the put for
     the NEXT item before the current one is consumed (the H2D overlap)."""

@@ -47,9 +47,36 @@ def test_full_run_applies_all_gates():
         "cpu", {"steps": 200}, _results(_good_run(0.2), _good_run(0.22))
     )
     assert rec["checks"]["fp32_abs"] is True
-    assert sorted(rec["applied_checks"]) == sorted(rec["checks"])
+    # Threshold-metric gates only apply on the calibrated profile
+    # (config["threshold_gates"]); off it they are honest "n/a".
+    heldout = [k for k in rec["checks"] if k.startswith("fp32_heldout_")]
+    assert len(heldout) == 3
+    assert all(rec["checks"][k] == "n/a" for k in heldout)
+    assert sorted(rec["applied_checks"]) == sorted(
+        k for k in rec["checks"] if k not in heldout)
     assert rec["ok"]
     assert rec["thresholds"]["epe_abs"] == EPE_ABS_THRESHOLD
+
+
+def test_thresholds_profile_gates_heldout_metrics():
+    res = _results(_good_run(0.05), _good_run(0.05))
+    res[0]["heldout_metrics"] = {"epe3d": 0.03, "acc3d_strict": 0.4,
+                                 "acc3d_relax": 0.9, "outlier": 0.2}
+    cfg = {"steps": 400, "threshold_gates": True}
+    rec = make_record("cpu", cfg, res)
+    assert rec["checks"]["fp32_heldout_acc3d_relax"] is True
+    assert rec["checks"]["fp32_heldout_outlier"] is True
+    assert "fp32_heldout_acc3d_strict" in rec["applied_checks"]
+    assert rec["ok"]
+    # A saturated outlier (the round-4 failure mode) must FAIL the gate.
+    res[0]["heldout_metrics"]["outlier"] = 0.99
+    rec = make_record("cpu", cfg, res)
+    assert rec["checks"]["fp32_heldout_outlier"] is False
+    assert not rec["ok"]
+    # Without held-out metrics the gates stay n/a even on the profile.
+    del res[0]["heldout_metrics"]
+    rec = make_record("cpu", cfg, res)
+    assert rec["checks"]["fp32_heldout_outlier"] == "n/a"
 
 
 def test_multiobj_uses_its_own_calibrated_threshold():

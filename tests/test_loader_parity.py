@@ -1,0 +1,32 @@
+"""Train-epoch data-path parity vs the reference loader (slow tier).
+
+One lock-stepped epoch over the same on-disk FT3D tree: reference
+``datasets/generic.py`` subsample/reject-advance + ``Batch`` + torch
+``DataLoader`` vs our ``FT3D`` + ``PrefetchLoader``. See
+scripts/loader_parity.py for the claim decomposition."""
+
+import os
+
+import pytest
+
+REF_ROOT = "/root/reference"
+
+pytestmark = [
+    pytest.mark.skipif(
+        not os.path.isdir(os.path.join(REF_ROOT, "datasets")),
+        reason="reference checkout not available",
+    ),
+    pytest.mark.slow,
+]
+
+
+def test_train_epoch_data_path_matches_reference():
+    from scripts.loader_parity import run
+
+    rec = run(n_scenes=13, n_points=128)
+    assert rec["ok"], rec["checks"]
+    # 12 train scenes (1 val carve-out), one rejected + replaced: still a
+    # full-length epoch with one duplicated successor on BOTH sides.
+    assert rec["ref_scenes"] == rec["our_scenes"] == 12
+    assert rec["max_scene_multiplicity"] == 2
+    assert rec["tensor_mismatches"] == []
