@@ -216,6 +216,50 @@ def _child_variant(name: str) -> None:
             dt_rt = time_packed(n_steps, roundtrip=True)
             if dt_rt < dt:
                 strategy, dt = "packed_host_roundtrip", dt_rt
+        if dt > 0.5:
+            # The decisive lever: fuse K optimizer steps into ONE dispatch
+            # (lax.scan over the packed step — engine/steps.py:
+            # make_multistep_train_step, Trainer --steps_per_dispatch).
+            # Per-dispatch overhead is amortized K-fold; every step is
+            # still a genuine fwd+bwd+adam with state carried step-to-step
+            # and K DISTINCT pre-staged batches per dispatch.
+            from pvraft_tpu.engine.steps import make_multistep_train_step
+
+            fuse_k = max(2, int(os.environ.get("PVRAFT_BENCH_FUSE", 32)))
+            mstep, _, _ = make_multistep_train_step(
+                model, tx, 0.8, ITERS, params, opt_state, fuse_k,
+                donate=True,
+            )
+            stacked = [
+                {"pc1": jnp.asarray(rng.uniform(-1, 1, pc1.shape)
+                                    .astype(np.float32)),
+                 "pc2": jnp.asarray(rng.uniform(-1, 1, pc2.shape)
+                                    .astype(np.float32)),
+                 "mask": mask, "flow": gt}
+                for _ in range(fuse_k)
+            ]
+            mbatches = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *stacked
+            )
+            from jax.flatten_util import ravel_pytree
+
+            mflat, _ = ravel_pytree((params, opt_state))
+            mflat, mm = mstep(mflat, mbatches)  # warmup/compile
+            jax.block_until_ready(mm["loss"])
+            if not np.all(np.isfinite(np.asarray(mm["loss"]))):
+                raise FloatingPointError("non-finite loss in fused steps")
+
+            def time_multi(n_dispatch):
+                nonlocal mflat
+                t0 = time.perf_counter()
+                for _ in range(n_dispatch):
+                    mflat, mm = mstep(mflat, mbatches)
+                jax.block_until_ready(mm["loss"])
+                return (time.perf_counter() - t0) / (n_dispatch * fuse_k)
+
+            dt_multi = time_multi(3)
+            if dt_multi < dt:
+                strategy, dt = f"multistep{fuse_k}", dt_multi
     elif platform != "cpu":
         dt = time_pytree(n_steps)
     if platform != "cpu":
@@ -223,21 +267,27 @@ def _child_variant(name: str) -> None:
         # run-to-run spread (same rationale as the CPU branch above).
         if strategy == "pytree":
             dt2 = time_pytree(n_steps)
+        elif strategy.startswith("multistep"):
+            dt2 = time_multi(3)
         else:
             dt2 = time_packed(n_steps,
                               roundtrip=strategy == "packed_host_roundtrip")
         dt_reps = [dt, dt2]
     dt_mean = sum(dt_reps) / len(dt_reps)
     spread = (max(dt_reps) - min(dt_reps)) / max(dt_mean, 1e-12)
+    # Optimizer steps behind each rep (multistep reps run 3 dispatches of
+    # fuse_k fused steps each; every other path times n_steps).
+    rep_steps = (3 * int(strategy[len("multistep"):])
+                 if strategy.startswith("multistep") else n_steps)
     print(json.dumps({"ok": True, "dt": dt_mean,
-                      "dt_reps": [round(d, 4) for d in dt_reps],
+                      "dt_reps": [round(d, 6) for d in dt_reps],
                       "dt_spread": round(spread, 4),
                       "timing_reps": len(dt_reps),
                       # Per-rep so a mixed-step-count rep list can never
                       # masquerade as run-to-run spread (every path above
                       # re-times the chosen strategy at n_steps before it
                       # becomes rep 1; this records that invariant).
-                      "steps_per_rep": [n_steps] * len(dt_reps),
+                      "steps_per_rep": [rep_steps] * len(dt_reps),
                       "platform": platform, "strategy": strategy,
                       "points": N_POINTS, "batch": BATCH, "iters": ITERS,
                       "remat": cfg.remat}))
@@ -290,13 +340,51 @@ def _child_eval(name: str) -> None:
     jax.block_until_ready(flow)
     if platform == "cpu":  # minutes/step at full config — keep it short
         batches = batches[:3]
+    # Host fetch per scene, not just block_until_ready: the remote tunnel
+    # has been observed to satisfy block_until_ready before the work ran
+    # (a 115 us/step "eval" at a config whose train step is seconds). A
+    # host scalar fetch cannot be faked, and the eval protocol needs the
+    # metrics on host for its running means anyway (test.py:128-142).
     t0 = time.perf_counter()
     for b in batches[1:]:
-        metrics, flow = step(params, b)
-    jax.block_until_ready(flow)
+        m, _ = step(params, b)
+        float(np.asarray(m["loss"]))
     dt = (time.perf_counter() - t0) / (len(batches) - 1)
+    strategy = "per_scene_host_sync"
+    if platform != "cpu" and dt > 0.2:
+        # Per-dispatch tunnel overhead dominates: scan S scenes per
+        # dispatch (bs=1 each — protocol-exact) and fetch all S metric
+        # sets at once. Every timed dispatch gets DISTINCT pre-staged
+        # scenes so the remote executor's result memoization cannot
+        # satisfy it from cache.
+        n_scan, n_disp = len(batches) - 1, 3
+        stacks = []
+        for _ in range(n_disp + 1):
+            group = [make_batch() for _ in range(n_scan)]
+            stacks.append(
+                jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *group)
+            )
+
+        @jax.jit
+        def fused(params, sb):
+            def body(c, b):
+                m, _ = step(params, b)
+                return c, m
+
+            return jax.lax.scan(body, 0, sb)[1]
+
+        ms = fused(params, stacks[0])  # warmup/compile
+        np.asarray(ms["loss"])
+        t0 = time.perf_counter()
+        for i in range(n_disp):
+            ms = fused(params, stacks[1 + i])
+            np.asarray(ms["loss"])
+        dt_f = (time.perf_counter() - t0) / (n_disp * n_scan)
+        if dt_f < dt:
+            dt, strategy = dt_f, f"scanned{n_scan}"
     print(json.dumps({"ok": True, "dt": dt, "platform": platform,
-                      "points": N_POINTS, "iters": eval_iters}))
+                      "points": N_POINTS, "iters": eval_iters,
+                      "eval_strategy": strategy, "host_synced": True}))
 
 
 # --------------------------------------------------------------- parent ----
@@ -475,6 +563,8 @@ def main() -> None:
         )
         if ev is not None:
             extra["eval_scenes_per_sec"] = round(1.0 / ev["dt"], 3)
+            if ev.get("eval_strategy"):
+                extra["eval_strategy"] = ev["eval_strategy"]
             ev_pts, ev_it = ev.get("points"), ev.get("iters")
             if (ev_pts, ev_it) != (N_POINTS, 32):
                 extra["eval_detail"] = (

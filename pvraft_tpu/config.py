@@ -173,6 +173,13 @@ class ParallelConfig:
     # every step. bench.py auto-tries it; this flag makes the same loop
     # available to real training runs.
     host_roundtrip: bool = False
+    # Fuse this many optimizer steps into ONE compiled dispatch via
+    # lax.scan over the packed step (engine/steps.py:
+    # make_multistep_train_step). Per-step numerics and logging are
+    # unchanged (the packed state is the scan carry); dispatch overhead is
+    # amortized K-fold — decisive on remote-dispatch tunnels where one
+    # full-step dispatch costs seconds (BENCHMARKS.md). 1 disables fusion.
+    steps_per_dispatch: int = 1
     # Batches kept in flight to the device (data/loader.py:device_prefetch):
     # H2D transfers overlap compute. 1 disables the pipeline.
     device_prefetch: int = 2
@@ -182,6 +189,19 @@ class ParallelConfig:
             raise ValueError(
                 "host_roundtrip requires packed_state (the round-trip "
                 "moves the single flat state buffer)"
+            )
+        if self.steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
+        if self.steps_per_dispatch > 1 and not self.packed_state:
+            raise ValueError(
+                "steps_per_dispatch > 1 requires packed_state (the scan "
+                "carries the single flat state buffer across fused steps)"
+            )
+        if self.steps_per_dispatch > 1 and self.host_roundtrip:
+            raise ValueError(
+                "steps_per_dispatch > 1 already amortizes dispatch "
+                "overhead; combining it with host_roundtrip (a per-step "
+                "host sync) would reintroduce what it removes"
             )
 
 

@@ -98,6 +98,19 @@ def make_packed_train_step(
     (new_flat, metrics)``, ``flat0`` the packed initial state, and
     ``unravel(flat) -> (params, opt_state)`` for checkpointing.
     """
+    step, flat0, unravel = _packed_step_fn(
+        model, tx, gamma, num_iters, params, opt_state, refine
+    )
+    return (
+        jax.jit(step, donate_argnums=(0,) if donate else ()),
+        flat0,
+        unravel,
+    )
+
+
+def _packed_step_fn(model, tx, gamma, num_iters, params, opt_state, refine):
+    """Unjitted packed-state step body shared by the single-step and the
+    scan-fused multi-step factories. Returns ``(step, flat0, unravel)``."""
     from jax.flatten_util import ravel_pytree
 
     flat0, unravel = ravel_pytree((params, opt_state))
@@ -119,6 +132,53 @@ def make_packed_train_step(
         epe = epe_train(last, batch["mask"], batch["flow"])
         new_flat, _ = ravel_pytree((params, opt_state))
         return new_flat, {"loss": loss, "epe": epe}
+
+    return step, flat0, unravel
+
+
+def make_multistep_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    gamma: float,
+    num_iters: int,
+    params,
+    opt_state,
+    steps_per_dispatch: int,
+    donate: bool = True,
+    refine: bool = False,
+):
+    """K packed train steps fused into ONE compiled program via
+    ``lax.scan`` — one dispatch runs K genuine fwd+bwd+adam steps.
+
+    Motivation: on remote-dispatch tunnels the per-dispatch overhead of the
+    full train-step executable is seconds (BENCHMARKS.md "chained full train
+    step"), ~700x the measured device step time. Fusing K steps amortizes
+    that overhead K-fold while remaining a true training loop: the packed
+    state is the scan carry, so step i+1 consumes step i's updated params
+    and optimizer state, exactly as K separate dispatches would. On a
+    directly attached TPU the same fusion removes K-1 host dispatches per
+    group (smaller but still real).
+
+    The reference has no counterpart (its ``tools/engine.py:135-143`` loop
+    is one optimizer step per Python iteration by construction); this is a
+    TPU/XLA-native capability: deterministic control flow inside one XLA
+    program.
+
+    ``step(flat, batches) -> (new_flat, metrics)`` where every leaf of
+    ``batches`` carries a leading ``steps_per_dispatch`` axis (K stacked
+    loader batches) and each metrics leaf comes back with shape ``(K,)`` —
+    per-step losses/EPEs, so logging stays per-step exact.
+
+    Returns ``(step, flat0, unravel)`` like ``make_packed_train_step``.
+    """
+    if steps_per_dispatch < 1:
+        raise ValueError("steps_per_dispatch must be >= 1")
+    inner, flat0, unravel = _packed_step_fn(
+        model, tx, gamma, num_iters, params, opt_state, refine
+    )
+
+    def step(flat, batches):
+        return jax.lax.scan(inner, flat, batches)
 
     return (
         jax.jit(step, donate_argnums=(0,) if donate else ()),

@@ -45,6 +45,7 @@ from pvraft_tpu.engine.checkpoint import (
 from pvraft_tpu.engine.schedule import make_lr_schedule
 from pvraft_tpu.engine.steps import (
     make_eval_step,
+    make_multistep_train_step,
     make_packed_train_step,
     make_refine_train_step,
     make_train_step,
@@ -238,6 +239,16 @@ class Trainer:
                 self.params, self.opt_state, donate=cfg.parallel.donate,
                 refine=refine,
             )
+            # K>1: fuse K optimizer steps into one dispatch (lax.scan over
+            # the packed step; engine/steps.py). The single packed_step
+            # stays built for the epoch tail (n_steps % K != 0).
+            if cfg.parallel.steps_per_dispatch > 1:
+                self.multi_step, _, _ = make_multistep_train_step(
+                    self.model, tx, cfg.train.gamma, cfg.train.iters,
+                    self.params, self.opt_state,
+                    cfg.parallel.steps_per_dispatch,
+                    donate=cfg.parallel.donate, refine=refine,
+                )
 
         self.ckpt_dir = os.path.join(cfg.exp_path, "checkpoints")
 
@@ -292,35 +303,68 @@ class Trainer:
         # logging never forces a dispatch sync inside the hot loop.
         dev_metrics = []
         profile = cfg.train.profile_dir if epoch == self.begin_epoch else None
+        steps_k = cfg.parallel.steps_per_dispatch if self.packed else 1
         with trace_context(profile or None):
             timer.start()
             last = None
-            for b in device_prefetch(
+            stream = device_prefetch(
                 self.train_loader.epoch(epoch), self._device_batch,
                 depth=cfg.parallel.device_prefetch,
-            ):
-                if self.packed:
-                    if self.cfg.parallel.host_roundtrip:
-                        # Break the chained-executable dependency through
-                        # the host: D2H+H2D of one flat buffer per step
-                        # (identical floats; see ParallelConfig).
-                        self.flat = jnp.asarray(np.asarray(self.flat))
+            )
+            if steps_k > 1:
+                # Fused mode: stack K sharded batches (leading axis K; the
+                # batch-axis sharding propagates through the stack) and run
+                # them in one dispatch. The tail reuses the single step.
+                pending = []
+                for b in stream:
+                    pending.append(b)
+                    if len(pending) == steps_k:
+                        batches = jax.tree_util.tree_map(
+                            lambda *xs: jnp.stack(xs), *pending
+                        )
+                        pending = []
+                        self.flat, m = self.multi_step(self.flat, batches)
+                        dev_metrics.append(m)
+                        last = m
+                for b in pending:
                     self.flat, m = self.packed_step(self.flat, b)
-                else:
-                    self.params, self.opt_state, m = self.train_step(
-                        self.params, self.opt_state, b
-                    )
-                dev_metrics.append(m)
-                last = m
+                    dev_metrics.append(m)
+                    last = m
+            else:
+                for b in stream:
+                    if self.packed:
+                        if self.cfg.parallel.host_roundtrip:
+                            # Break the chained-executable dependency
+                            # through the host: D2H+H2D of one flat buffer
+                            # per step (identical floats; see
+                            # ParallelConfig).
+                            self.flat = jnp.asarray(np.asarray(self.flat))
+                        self.flat, m = self.packed_step(self.flat, b)
+                    else:
+                        self.params, self.opt_state, m = self.train_step(
+                            self.params, self.opt_state, b
+                        )
+                    dev_metrics.append(m)
+                    last = m
             if last is not None:
                 timer.stop(last["loss"])
         if self.packed:
             # Unpack once per epoch so eval and checkpointing see the
             # trained state without per-step tree traffic.
             self.params, self.opt_state = self.unravel(self.flat)
-        n_steps = len(dev_metrics)
-        losses = [float(m["loss"]) for m in dev_metrics]
-        epes = [float(m["epe"]) for m in dev_metrics]
+        # Fused-dispatch metric leaves arrive as (K,) arrays; flattening
+        # keeps per-optimizer-step logging identical in every mode.
+        losses = [
+            float(v)
+            for m in dev_metrics
+            for v in np.atleast_1d(np.asarray(m["loss"]))
+        ]
+        epes = [
+            float(v)
+            for m in dev_metrics
+            for v in np.atleast_1d(np.asarray(m["epe"]))
+        ]
+        n_steps = len(losses)
         for i, (l, e) in enumerate(zip(losses, epes)):
             self.tb.add_scalar("Train/Loss", l, self.step_count + i + 1)
             self.tb.add_scalar("Train/EPE", e, self.step_count + i + 1)

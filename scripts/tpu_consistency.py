@@ -103,13 +103,25 @@ def main() -> int:
     pc1 = jnp.asarray(rng.uniform(-1, 1, (1, n_model, 3)).astype(np.float32))
     pc2 = jnp.asarray(rng.uniform(-1, 1, (1, n_model, 3)).astype(np.float32))
     params = model.init(jax.random.key(0), pc1, pc2, 2)
-    flows_dev, _ = jax.jit(lambda p: model.apply(p, pc1, pc2, 4))(params)
+    # TPU fp32 matmuls default to bf16-multiply passes; through 4 GRU
+    # iterations (plus top-k selections that flip on near-tied scores) the
+    # drift vs an fp32 host oracle reaches O(0.1) on the flow — an
+    # expected property of the TPU perf mode, not a bug. The GATED check
+    # therefore pins matmul precision to fp32 on both sides ("does the
+    # compiled model compute the same function"); the default-precision
+    # drift is recorded ungated for visibility.
+    flows_def, _ = jax.jit(lambda p: model.apply(p, pc1, pc2, 4))(params)
+    with jax.default_matmul_precision("highest"):
+        flows_dev, _ = jax.jit(lambda p: model.apply(p, pc1, pc2, 4))(params)
     cpu = jax.devices("cpu")[0]
     with jax.default_device(cpu):
         params_h = jax.device_put(params, cpu)
         flows_host, _ = jax.jit(lambda p: model.apply(p, pc1, pc2, 4))(params_h)
     d = _max_diff(flows_dev, flows_host)
     record["max_diffs"]["model_forward"] = d
+    record["max_diffs"]["model_forward_default_precision"] = _max_diff(
+        flows_def, flows_host
+    )
     # 4 GRU iterations compound fp reorderings; 5e-3 on the flow is well
     # inside training noise while still catching a broken kernel.
     record["checks"]["model_forward"] = d < 5e-3
@@ -126,12 +138,13 @@ def main() -> int:
         return loss_fn
 
     grad_model = PVRaft(dataclasses.replace(cfg, use_pallas=platform != "cpu"))
-    g_dev = jax.jit(jax.grad(make_loss(grad_model)))(params, pc1, pc2)
-    with jax.default_device(cpu):
-        # `model` (XLA fallback) is the host oracle.
-        g_host = jax.jit(jax.grad(make_loss(model)))(
-            params_h, jax.device_put(pc1, cpu), jax.device_put(pc2, cpu)
-        )
+    with jax.default_matmul_precision("highest"):
+        g_dev = jax.jit(jax.grad(make_loss(grad_model)))(params, pc1, pc2)
+        with jax.default_device(cpu):
+            # `model` (XLA fallback) is the host oracle.
+            g_host = jax.jit(jax.grad(make_loss(model)))(
+                params_h, jax.device_put(pc1, cpu), jax.device_put(pc2, cpu)
+            )
     diff_tree = jax.tree_util.tree_map(_max_diff, g_dev, g_host)  # raises on
     d = max(jax.tree_util.tree_leaves(diff_tree))  # structure mismatch
     record["max_diffs"]["model_grad"] = d

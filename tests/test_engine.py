@@ -260,6 +260,29 @@ def test_visual_render(tmp_path):
     assert os.path.exists(out) and os.path.getsize(out) > 1000
 
 
+def test_visual_render_html(tmp_path):
+    import visual
+
+    rng = np.random.default_rng(0)
+    scene = tmp_path / "result" / "FT3D" / "0"
+    scene.mkdir(parents=True)
+    np.save(scene / "pc1.npy", rng.normal(size=(60, 3)).astype(np.float32))
+    np.save(scene / "pc2.npy", rng.normal(size=(60, 3)).astype(np.float32))
+    np.save(scene / "flow.npy", rng.normal(size=(60, 3)).astype(np.float32))
+    out = visual.render_html(str(scene), str(scene / "render.html"),
+                             max_points=32)
+    html = open(out).read()
+    # Self-contained: inline data + renderer, no external resources.
+    assert "CLOUDS" in html and "<script>" in html
+    assert "http://" not in html and "https://" not in html
+    # Subsampling honored: 3 clouds of exactly max_points entries.
+    import json as _json
+
+    payload = html.split("const CLOUDS = ", 1)[1].split(";\n", 1)[0]
+    clouds = _json.loads(payload)
+    assert len(clouds) == 3 and all(len(c) == 32 for c in clouds)
+
+
 @pytest.mark.slow
 def test_trainer_packed_state_matches_unpacked(tmp_path):
     import dataclasses

@@ -60,6 +60,63 @@ def test_packed_matches_pytree_step(setup):
     assert counts and all(int(c) == 3 for c in counts)
 
 
+def test_multistep_matches_sequential_packed(setup):
+    """K scan-fused steps == K sequential packed steps, bit-for-bit: same
+    per-step losses, same final flat state (the fused program runs the
+    SAME packed step body under lax.scan)."""
+    from pvraft_tpu.engine.steps import make_multistep_train_step
+
+    model, tx, params, batch = setup
+    opt_state = tx.init(params)
+    k = 4
+
+    step, flat, _ = make_packed_train_step(
+        model, tx, 0.8, 2, params, opt_state, donate=False
+    )
+    seq_losses = []
+    for i in range(k):
+        # Distinct per-step batches so the test would catch a wrong scan
+        # xs-ordering, not just a wrong carry.
+        b = {**batch, "flow": batch["flow"] * (1.0 + 0.1 * i)}
+        flat, m = step(flat, b)
+        seq_losses.append(float(m["loss"]))
+
+    mstep, mflat, unravel = make_multistep_train_step(
+        model, tx, 0.8, 2, params, opt_state, k, donate=False
+    )
+    batches = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[{**batch, "flow": batch["flow"] * (1.0 + 0.1 * i)}
+          for i in range(k)],
+    )
+    mflat, ms = mstep(mflat, batches)
+
+    assert np.asarray(ms["loss"]).shape == (k,)
+    # Same step body, but XLA may fuse a scan-wrapped program differently
+    # from the standalone executable — tight tolerance, not bitwise.
+    np.testing.assert_allclose(np.asarray(ms["loss"]),
+                               np.asarray(seq_losses, np.float32),
+                               rtol=1e-6, atol=0)
+    np.testing.assert_allclose(np.asarray(mflat), np.asarray(flat),
+                               rtol=1e-5, atol=1e-7)
+    counts = [x for x in jax.tree.leaves(unravel(mflat)[1])
+              if np.asarray(x).dtype == np.int32]
+    assert counts and all(int(c) == k for c in counts)
+
+
+def test_steps_per_dispatch_config_validation():
+    from pvraft_tpu.config import ParallelConfig
+
+    with pytest.raises(ValueError):
+        ParallelConfig(steps_per_dispatch=2)  # requires packed_state
+    with pytest.raises(ValueError):
+        ParallelConfig(steps_per_dispatch=0, packed_state=True)
+    with pytest.raises(ValueError):
+        ParallelConfig(steps_per_dispatch=2, packed_state=True,
+                       host_roundtrip=True)
+    ParallelConfig(steps_per_dispatch=4, packed_state=True)  # ok
+
+
 def test_packed_refine_matches_pytree_step():
     """Stage-2: packed step through optax.masked state + compute_loss."""
     from pvraft_tpu.engine.steps import make_refine_train_step

@@ -79,6 +79,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--packed_state", action="store_true",
                    help="carry params+opt_state between steps as one flat "
                         "buffer (fewer chained leaves; see BENCHMARKS.md)")
+    p.add_argument("--steps_per_dispatch", type=int, default=1,
+                   help="with --packed_state: fuse K optimizer steps into "
+                        "one compiled dispatch (lax.scan over the packed "
+                        "step; amortizes per-dispatch overhead K-fold, "
+                        "identical per-step numerics)")
     p.add_argument("--device_prefetch", type=int, default=2,
                    help="batches kept in flight to the device "
                         "(H2D overlaps compute; 1 disables)")
@@ -125,6 +130,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
         parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel,
                                 packed_state=a.packed_state,
                                 host_roundtrip=a.host_roundtrip,
+                                steps_per_dispatch=a.steps_per_dispatch,
                                 device_prefetch=a.device_prefetch),
         exp_path=a.exp_path,
     )

@@ -2,9 +2,16 @@
 """Visualize a dumped scene-flow result.
 
 Equivalent of the reference ``visual.py`` (mayavi 3-cloud render of
-``result/<dataset>/<idx>/{pc1,pc2,flow}.npy``, ``visual.py:11-30``) using
-matplotlib (headless-friendly): pc1 red, pc2 green, pc1+flow blue, written
-to a PNG. Produce the inputs with ``test.py --dump_dir result``.
+``result/<dataset>/<idx>/{pc1,pc2,flow}.npy``, ``visual.py:11-30``) in two
+forms, both headless-friendly (no mayavi/X server):
+
+- default: a static matplotlib PNG (pc1 red, pc2 green, pc1+flow blue);
+- ``--html``: a self-contained interactive HTML viewer (drag to orbit,
+  wheel to zoom, per-cloud toggles) with the clouds embedded inline —
+  the interactive parity for the reference's mayavi window, viewable in
+  any browser with zero dependencies.
+
+Produce the inputs with ``test.py --dump_dir result``.
 """
 
 from __future__ import annotations
@@ -39,16 +46,121 @@ def render(scene_dir: str, out_path: str, point_size: float = 0.5) -> str:
     return out_path
 
 
+_HTML_TEMPLATE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>PV-RAFT scene flow</title>
+<style>
+ body {{ margin:0; background:#111; color:#ddd; font:13px sans-serif; }}
+ #hud {{ position:fixed; top:8px; left:8px; background:rgba(0,0,0,.6);
+        padding:8px 10px; border-radius:6px; }}
+ label {{ margin-right:10px; cursor:pointer; }}
+ canvas {{ display:block; }}
+</style></head><body>
+<div id="hud">
+ <b>{title}</b> &nbsp; drag: orbit &middot; wheel: zoom<br>
+ <label><input type="checkbox" id="c0" checked>
+   <span style="color:#ff5a4d">pc1 (t)</span></label>
+ <label><input type="checkbox" id="c1" checked>
+   <span style="color:#4dd15a">pc2 (t+1)</span></label>
+ <label><input type="checkbox" id="c2" checked>
+   <span style="color:#5a9bff">pc1 + flow</span></label>
+</div>
+<canvas id="cv"></canvas>
+<script>
+const CLOUDS = {clouds_json};
+const COLORS = ["#ff5a4d", "#4dd15a", "#5a9bff"];
+const cv = document.getElementById("cv"), ctx = cv.getContext("2d");
+let yaw = 0.6, pitch = 0.3, zoom = 1.0, drag = null;
+// Center and scale once so every scene fits the view.
+let lo = [1e9,1e9,1e9], hi = [-1e9,-1e9,-1e9];
+for (const c of CLOUDS) for (const p of c)
+  for (let i = 0; i < 3; i++) {{
+    lo[i] = Math.min(lo[i], p[i]); hi[i] = Math.max(hi[i], p[i]);
+  }}
+const mid = lo.map((v, i) => (v + hi[i]) / 2);
+const span = Math.max(hi[0]-lo[0], hi[1]-lo[1], hi[2]-lo[2], 1e-6);
+function draw() {{
+  cv.width = innerWidth; cv.height = innerHeight;
+  ctx.fillStyle = "#111"; ctx.fillRect(0, 0, cv.width, cv.height);
+  const s = 0.8 * Math.min(cv.width, cv.height) / span * zoom;
+  const cy = Math.cos(yaw), sy = Math.sin(yaw);
+  const cp = Math.cos(pitch), sp = Math.sin(pitch);
+  for (let ci = 0; ci < CLOUDS.length; ci++) {{
+    if (!document.getElementById("c" + ci).checked) continue;
+    ctx.fillStyle = COLORS[ci];
+    for (const p of CLOUDS[ci]) {{
+      const x = p[0]-mid[0], y = p[1]-mid[1], z = p[2]-mid[2];
+      const rx = cy*x + sy*z, rz = -sy*x + cy*z;
+      const ry = cp*y - sp*rz;
+      ctx.fillRect(cv.width/2 + rx*s, cv.height/2 - ry*s, 2, 2);
+    }}
+  }}
+}}
+cv.onmousedown = e => drag = [e.clientX, e.clientY];
+onmouseup = () => drag = null;
+onmousemove = e => {{
+  if (!drag) return;
+  yaw += (e.clientX - drag[0]) * 0.01;
+  pitch += (e.clientY - drag[1]) * 0.01;
+  drag = [e.clientX, e.clientY]; draw();
+}};
+cv.onwheel = e => {{
+  e.preventDefault();
+  zoom *= Math.exp(-e.deltaY * 0.001); draw();
+}};
+onresize = draw;
+for (const id of ["c0", "c1", "c2"])
+  document.getElementById(id).onchange = draw;
+draw();
+</script></body></html>
+"""
+
+
+def render_html(scene_dir: str, out_path: str, max_points: int = 8192) -> str:
+    """Write a dependency-free interactive HTML viewer for one scene.
+
+    Embeds pc1 / pc2 / pc1+flow (subsampled to ``max_points`` each to keep
+    the file small) as inline JSON with a canvas orbit/zoom renderer —
+    the interactive counterpart of the reference's mayavi window
+    (``visual.py:14-21``).
+    """
+    import json
+
+    pc1 = np.load(os.path.join(scene_dir, "pc1.npy"))
+    pc2 = np.load(os.path.join(scene_dir, "pc2.npy"))
+    flow = np.load(os.path.join(scene_dir, "flow.npy"))
+
+    def sub(a: np.ndarray) -> list:
+        if len(a) > max_points:
+            idx = np.linspace(0, len(a) - 1, max_points).astype(np.int64)
+            a = a[idx]
+        return np.round(a.astype(np.float64), 4).tolist()
+
+    clouds = [sub(pc1), sub(pc2), sub(pc1 + flow)]
+    html = _HTML_TEMPLATE.format(
+        title=os.path.basename(os.path.abspath(scene_dir)),
+        clouds_json=json.dumps(clouds, separators=(",", ":")),
+    )
+    with open(out_path, "w") as f:
+        f.write(html)
+    return out_path
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser("pvraft_tpu visual")
     p.add_argument("--result_root", default="result")
     p.add_argument("--dataset", default="FT3D")
     p.add_argument("--index", type=int, default=0)
     p.add_argument("--out", default=None)
+    p.add_argument("--html", action="store_true",
+                   help="write an interactive HTML viewer instead of a PNG")
     a = p.parse_args(argv)
     scene = os.path.join(a.result_root, a.dataset, str(a.index))
-    out = a.out or os.path.join(scene, "render.png")
-    print(render(scene, out))
+    if a.html:
+        out = a.out or os.path.join(scene, "render.html")
+        print(render_html(scene, out))
+    else:
+        out = a.out or os.path.join(scene, "render.png")
+        print(render(scene, out))
 
 
 if __name__ == "__main__":
