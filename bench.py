@@ -153,17 +153,23 @@ def _child_variant(name: str) -> None:
         raise FloatingPointError("non-finite loss")
 
     def time_pytree(n):
+        # Host fetch of the final loss, not just block_until_ready: the
+        # tunnel has satisfied block_until_ready before execution (the
+        # 115 us/scene eval artifact). The chain's dataflow makes one
+        # scalar D2H force every step; its cost is per-measurement, not
+        # per-step.
         nonlocal params, opt_state, loss
         t0 = time.perf_counter()
         for _ in range(n):
             params, opt_state, loss = step(params, opt_state, pc1, pc2,
                                            mask, gt)
-        jax.block_until_ready(loss)
+        float(np.asarray(loss))
         return (time.perf_counter() - t0) / n
 
     # CPU fallback steps are minutes each at 8,192 points — keep it short.
     n_steps = 10 if platform != "cpu" else 2
     strategy = "pytree"
+    fuse_k = int(os.environ.get("PVRAFT_BENCH_FUSE", 32))
     dt = time_pytree(2 if platform != "cpu" else n_steps)
     if platform == "cpu":
         # Repeat the measurement so the artifact records run-to-run spread
@@ -196,7 +202,8 @@ def _child_variant(name: str) -> None:
                     # executable dependency through the host.
                     flat = jnp.asarray(np.asarray(flat))
                 flat, m = pstep(flat, batch)
-            jax.block_until_ready(m["loss"])
+            # Host fetch: forces the full chain (see time_pytree).
+            float(np.asarray(m["loss"]))
             return (time.perf_counter() - t0) / n
 
         dt_packed = time_packed(n_steps)
@@ -216,77 +223,89 @@ def _child_variant(name: str) -> None:
             dt_rt = time_packed(n_steps, roundtrip=True)
             if dt_rt < dt:
                 strategy, dt = "packed_host_roundtrip", dt_rt
-        if dt > 0.5:
+        if dt > 0.5 and fuse_k > 1:
             # The decisive lever: fuse K optimizer steps into ONE dispatch
             # (lax.scan over the packed step — engine/steps.py:
             # make_multistep_train_step, Trainer --steps_per_dispatch).
             # Per-dispatch overhead is amortized K-fold; every step is
             # still a genuine fwd+bwd+adam with state carried step-to-step
             # and K DISTINCT pre-staged batches per dispatch.
-            from pvraft_tpu.engine.steps import make_multistep_train_step
+            # Guarded: a failure of this OPTIONAL probe (the scan program
+            # is far larger than the single step, and the tunnel's
+            # remote-compile has been observed to 500 — eval_tpu.json)
+            # must not destroy the packed measurement already in hand.
+            # PVRAFT_BENCH_FUSE=1 disables the probe.
+            try:
+                from pvraft_tpu.engine.steps import make_multistep_train_step
 
-            fuse_k = max(2, int(os.environ.get("PVRAFT_BENCH_FUSE", 32)))
-            mstep, _, _ = make_multistep_train_step(
-                model, tx, 0.8, ITERS, params, opt_state, fuse_k,
-                donate=True,
-            )
-            stacked = [
-                {"pc1": jnp.asarray(rng.uniform(-1, 1, pc1.shape)
-                                    .astype(np.float32)),
-                 "pc2": jnp.asarray(rng.uniform(-1, 1, pc2.shape)
-                                    .astype(np.float32)),
-                 "mask": mask, "flow": gt}
-                for _ in range(fuse_k)
-            ]
-            mbatches = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs), *stacked
-            )
-            from jax.flatten_util import ravel_pytree
+                mstep, _, _ = make_multistep_train_step(
+                    model, tx, 0.8, ITERS, params, opt_state, fuse_k,
+                    donate=True,
+                )
+                stacked = [
+                    {"pc1": jnp.asarray(rng.uniform(-1, 1, pc1.shape)
+                                        .astype(np.float32)),
+                     "pc2": jnp.asarray(rng.uniform(-1, 1, pc2.shape)
+                                        .astype(np.float32)),
+                     "mask": mask, "flow": gt}
+                    for _ in range(fuse_k)
+                ]
+                mbatches = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *stacked
+                )
+                from jax.flatten_util import ravel_pytree
 
-            mflat, _ = ravel_pytree((params, opt_state))
-            mflat, mm = mstep(mflat, mbatches)  # warmup/compile
-            jax.block_until_ready(mm["loss"])
-            if not np.all(np.isfinite(np.asarray(mm["loss"]))):
-                raise FloatingPointError("non-finite loss in fused steps")
-
-            def time_multi(n_dispatch):
-                nonlocal mflat
-                t0 = time.perf_counter()
-                for _ in range(n_dispatch):
-                    mflat, mm = mstep(mflat, mbatches)
+                mflat, _ = ravel_pytree((params, opt_state))
+                mflat, mm = mstep(mflat, mbatches)  # warmup/compile
                 jax.block_until_ready(mm["loss"])
-                return (time.perf_counter() - t0) / (n_dispatch * fuse_k)
+                if not np.all(np.isfinite(np.asarray(mm["loss"]))):
+                    raise FloatingPointError("non-finite loss in fused steps")
 
-            dt_multi = time_multi(3)
-            if dt_multi < dt:
-                strategy, dt = f"multistep{fuse_k}", dt_multi
+                def time_multi(n_dispatch):
+                    nonlocal mflat
+                    t0 = time.perf_counter()
+                    for _ in range(n_dispatch):
+                        mflat, mm = mstep(mflat, mbatches)
+                    # Host fetch: forces the full chain (see time_pytree).
+                    float(np.asarray(mm["loss"][-1]))
+                    return (time.perf_counter() - t0) / (n_dispatch * fuse_k)
+
+                dt_multi = time_multi(3)
+                if dt_multi < dt:
+                    strategy, dt = f"multistep{fuse_k}", dt_multi
+            except Exception as e:  # noqa: BLE001 — report, keep packed dt
+                sys.stderr.write(f"multistep probe failed: {e!r}\n")
     elif platform != "cpu":
         dt = time_pytree(n_steps)
     if platform != "cpu":
         # Second rep of the CHOSEN strategy so the artifact records
         # run-to-run spread (same rationale as the CPU branch above).
-        if strategy == "pytree":
-            dt2 = time_pytree(n_steps)
-        elif strategy.startswith("multistep"):
-            dt2 = time_multi(3)
-        else:
-            dt2 = time_packed(n_steps,
-                              roundtrip=strategy == "packed_host_roundtrip")
-        dt_reps = [dt, dt2]
+        try:
+            if strategy == "pytree":
+                dt2 = time_pytree(n_steps)
+            elif strategy.startswith("multistep"):
+                dt2 = time_multi(3)
+            else:
+                dt2 = time_packed(n_steps,
+                                  roundtrip=strategy == "packed_host_roundtrip")
+            dt_reps = [dt, dt2]
+        except Exception as e:  # noqa: BLE001 — rep 1 is already valid
+            sys.stderr.write(f"rep-2 timing failed: {e!r}\n")
+            dt_reps = [dt]
     dt_mean = sum(dt_reps) / len(dt_reps)
     spread = (max(dt_reps) - min(dt_reps)) / max(dt_mean, 1e-12)
     # Optimizer steps behind each rep (multistep reps run 3 dispatches of
     # fuse_k fused steps each; every other path times n_steps).
-    rep_steps = (3 * int(strategy[len("multistep"):])
-                 if strategy.startswith("multistep") else n_steps)
+    rep_steps = 3 * fuse_k if strategy.startswith("multistep") else n_steps
     print(json.dumps({"ok": True, "dt": dt_mean,
                       "dt_reps": [round(d, 6) for d in dt_reps],
                       "dt_spread": round(spread, 4),
                       "timing_reps": len(dt_reps),
-                      # Per-rep so a mixed-step-count rep list can never
-                      # masquerade as run-to-run spread (every path above
-                      # re-times the chosen strategy at n_steps before it
-                      # becomes rep 1; this records that invariant).
+                      # Per-rep optimizer-step counts, so a mixed-step-count
+                      # rep list can never masquerade as run-to-run spread.
+                      # Both reps of the chosen strategy run the same count:
+                      # n_steps for the loop strategies, 3 dispatches x
+                      # fuse_k for multistep.
                       "steps_per_rep": [rep_steps] * len(dt_reps),
                       "platform": platform, "strategy": strategy,
                       "points": N_POINTS, "batch": BATCH, "iters": ITERS,
@@ -351,40 +370,54 @@ def _child_eval(name: str) -> None:
         float(np.asarray(m["loss"]))
     dt = (time.perf_counter() - t0) / (len(batches) - 1)
     strategy = "per_scene_host_sync"
+    dt_scanned = None
     if platform != "cpu" and dt > 0.2:
         # Per-dispatch tunnel overhead dominates: scan S scenes per
         # dispatch (bs=1 each — protocol-exact) and fetch all S metric
         # sets at once. Every timed dispatch gets DISTINCT pre-staged
         # scenes so the remote executor's result memoization cannot
-        # satisfy it from cache.
-        n_scan, n_disp = len(batches) - 1, 3
-        stacks = []
-        for _ in range(n_disp + 1):
-            group = [make_batch() for _ in range(n_scan)]
-            stacks.append(
-                jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *group)
-            )
+        # satisfy it from cache. Guarded: this optional leg compiles a
+        # much larger program on a remote-compile path that has been
+        # observed to 500 (eval_tpu.json's batched leg) — a failure must
+        # not discard the per-scene measurement already in hand.
+        try:
+            n_scan, n_disp = len(batches) - 1, 3
+            stacks = []
+            for _ in range(n_disp + 1):
+                group = [make_batch() for _ in range(n_scan)]
+                stacks.append(
+                    jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *group)
+                )
 
-        @jax.jit
-        def fused(params, sb):
-            def body(c, b):
-                m, _ = step(params, b)
-                return c, m
+            @jax.jit
+            def fused(params, sb):
+                def body(c, b):
+                    m, _ = step(params, b)
+                    return c, m
 
-            return jax.lax.scan(body, 0, sb)[1]
+                return jax.lax.scan(body, 0, sb)[1]
 
-        ms = fused(params, stacks[0])  # warmup/compile
-        np.asarray(ms["loss"])
-        t0 = time.perf_counter()
-        for i in range(n_disp):
-            ms = fused(params, stacks[1 + i])
+            ms = fused(params, stacks[0])  # warmup/compile
             np.asarray(ms["loss"])
-        dt_f = (time.perf_counter() - t0) / (n_disp * n_scan)
-        if dt_f < dt:
-            dt, strategy = dt_f, f"scanned{n_scan}"
-    print(json.dumps({"ok": True, "dt": dt, "platform": platform,
-                      "points": N_POINTS, "iters": eval_iters,
-                      "eval_strategy": strategy, "host_synced": True}))
+            t0 = time.perf_counter()
+            for i in range(n_disp):
+                ms = fused(params, stacks[1 + i])
+                np.asarray(ms["loss"])
+            dt_f = (time.perf_counter() - t0) / (n_disp * n_scan)
+            # Reported SEPARATELY, never as the headline: the reference
+            # protocol's running means need per-scene host fetches
+            # (test.py:128-142), so the headline scenes/s stays the
+            # per-scene-synced rate; the scanned rate shows what our
+            # Evaluator's pre-staged scan mode reaches on this tunnel.
+            dt_scanned = dt_f
+        except Exception as e:  # noqa: BLE001 — keep the per-scene dt
+            sys.stderr.write(f"scanned-eval probe failed: {e!r}\n")
+    out = {"ok": True, "dt": dt, "platform": platform,
+           "points": N_POINTS, "iters": eval_iters,
+           "eval_strategy": strategy, "host_synced": True}
+    if dt_scanned is not None:
+        out["dt_scanned"] = dt_scanned
+    print(json.dumps(out))
 
 
 # --------------------------------------------------------------- parent ----
@@ -565,6 +598,10 @@ def main() -> None:
             extra["eval_scenes_per_sec"] = round(1.0 / ev["dt"], 3)
             if ev.get("eval_strategy"):
                 extra["eval_strategy"] = ev["eval_strategy"]
+            if ev.get("dt_scanned"):
+                extra["eval_scenes_per_sec_scanned"] = round(
+                    1.0 / ev["dt_scanned"], 3
+                )
             ev_pts, ev_it = ev.get("points"), ev.get("iters")
             if (ev_pts, ev_it) != (N_POINTS, 32):
                 extra["eval_detail"] = (

@@ -314,6 +314,40 @@ def test_trainer_packed_state_matches_unpacked(tmp_path):
 
 
 @pytest.mark.slow
+def test_trainer_steps_per_dispatch_matches_single(tmp_path):
+    """A fused-dispatch epoch (K=2, including a tail batch when the epoch
+    length is odd) must reproduce the K=1 packed epoch: same per-step
+    losses, same val metrics."""
+    import dataclasses
+
+    from pvraft_tpu.config import ParallelConfig
+
+    def mk(path, **par):
+        c = _tiny_cfg(path, epochs=1)
+        # 6 samples / bs=2 -> 3 steps: K=2 exercises one fused dispatch
+        # AND the odd tail batch through the single packed step.
+        return dataclasses.replace(
+            c,
+            data=dataclasses.replace(c.data, synthetic_size=6),
+            parallel=ParallelConfig(packed_state=True, **par),
+        )
+
+    cfg = mk(tmp_path / "a")
+    tr = _tiny_trainer(cfg)
+    m = tr.training(0)
+    v = tr.val_test(0, "val")
+
+    cfg_f = mk(tmp_path / "b", steps_per_dispatch=2)
+    tr_f = _tiny_trainer(cfg_f)
+    assert hasattr(tr_f, "multi_step")
+    m_f = tr_f.training(0)
+    v_f = tr_f.val_test(0, "val")
+
+    assert m_f["loss"] == pytest.approx(m["loss"], rel=1e-5)
+    assert v_f["epe3d"] == pytest.approx(v["epe3d"], rel=1e-4)
+
+
+@pytest.mark.slow
 def test_trainer_val_sharded_matches_bs1_protocol(tmp_path):
     """The trainer's per-epoch val loop shards eval_batch scenes over the
     mesh data axis (per-scene metrics); its means must equal the bs=1
