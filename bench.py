@@ -480,6 +480,17 @@ def _emit(value: float, extra: dict, comparable: bool = True) -> None:
 def main() -> None:
     notes = []
 
+    # Persistent executable cache shared with the TPU queue's jobs
+    # (scripts/tpu_batch.sh): a driver-launched bench reuses executables
+    # compiled earlier in the round instead of paying multi-minute remote
+    # compiles inside its own budget. setdefault so an operator override
+    # wins.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "artifacts", "xla_cache"),
+    )
+
     # 1. Backend probe, retried in fresh processes: a hung claim dies with
     #    its child and the next attempt gets a clean client.
     probe = None

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Probe WHICH multistep (scan-fused train step) configuration executes on
+the real chip, one combo per process.
+
+Context: the single bf16+pallas+approx train step measures 15.1 GiB live
+on a 16 GiB v5e (BENCHMARKS.md AOT table, 0.6 GiB headroom); the first
+K=32 scan attempt died with `UNAVAILABLE: TPU device error ... kernel
+fault` at warmup — consistent with the fused program tipping over the
+memory edge, but a Mosaic-under-scan fault is not excluded. This probe
+separates the axes: remat on/off, Pallas on/off, K. Each run prints one
+JSON line; run one combo per process so a device fault cannot poison the
+next combo's claim state.
+
+Usage: python scripts/multistep_probe.py --variant bf16+pallas+approx \
+          --remat --fuse 8 [--out artifacts/foo.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VARIANTS = {
+    "bf16+pallas+approx": dict(compute_dtype="bfloat16", use_pallas=True,
+                               approx_topk=True),
+    "bf16+approx": dict(compute_dtype="bfloat16", use_pallas=False,
+                        approx_topk=True),
+    "bf16": dict(compute_dtype="bfloat16", use_pallas=False),
+    "fp32": dict(use_pallas=False),
+}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--variant", default="bf16+pallas+approx")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--fuse", type=int, default=8)
+    p.add_argument("--points", type=int, default=8192)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--k", type=int, default=512)
+    p.add_argument("--out", default=None)
+    a = p.parse_args()
+
+    record = {"variant": a.variant, "remat": a.remat, "fuse_k": a.fuse,
+              "points": a.points, "iters": a.iters, "batch": a.batch}
+    try:
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pvraft_tpu.config import ModelConfig
+        from pvraft_tpu.engine.steps import make_multistep_train_step
+        from pvraft_tpu.models import PVRaft
+
+        record["platform"] = jax.devices()[0].platform
+        kwargs = dict(VARIANTS[a.variant])
+        if a.remat:
+            kwargs["remat"] = True
+        cfg = ModelConfig(truncate_k=a.k, **kwargs)
+        model = PVRaft(cfg)
+
+        rng = np.random.default_rng(0)
+
+        def mk():
+            pc1 = rng.uniform(-1, 1, (a.batch, a.points, 3)).astype(np.float32)
+            pc2 = rng.uniform(-1, 1, (a.batch, a.points, 3)).astype(np.float32)
+            return {"pc1": jnp.asarray(pc1), "pc2": jnp.asarray(pc2),
+                    "mask": jnp.ones((a.batch, a.points), jnp.float32),
+                    "flow": jnp.asarray(pc2 - pc1)}
+
+        b0 = mk()
+        n_init = min(a.points, max(256, a.k))
+        params = model.init(jax.random.key(0), b0["pc1"][:, :n_init],
+                            b0["pc2"][:, :n_init], 2)
+        tx = optax.adam(1e-3)
+        opt_state = tx.init(params)
+
+        mstep, mflat, _ = make_multistep_train_step(
+            model, tx, 0.8, a.iters, params, opt_state, a.fuse, donate=True
+        )
+        batches = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[mk() for _ in range(a.fuse)]
+        )
+        t0 = time.perf_counter()
+        mflat, mm = mstep(mflat, batches)  # compile + first execute
+        first_loss = float(np.asarray(mm["loss"][-1]))  # host fetch
+        record["first_call_s"] = round(time.perf_counter() - t0, 1)
+        if not np.isfinite(first_loss):
+            raise FloatingPointError("non-finite loss")
+
+        dts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            mflat, mm = mstep(mflat, batches)
+            float(np.asarray(mm["loss"][-1]))
+            dts.append((time.perf_counter() - t0) / a.fuse)
+        record["sec_per_step_reps"] = [round(d, 4) for d in dts]
+        record["pairs_per_sec_per_chip"] = round(
+            a.batch * a.points / min(dts), 1
+        )
+        record["ok"] = True
+    except Exception as e:  # noqa: BLE001 — the record IS the result
+        record["ok"] = False
+        record["error"] = repr(e)[:500]
+    line = json.dumps(record)
+    print(line)
+    if a.out:
+        with open(a.out, "a") as f:
+            f.write(line + "\n")
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

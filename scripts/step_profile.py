@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Honest on-chip component breakdown of the flagship train step.
+
+Every earlier sub-second "device time" figure measured through the axon
+tunnel without a host fetch is suspect (block_until_ready has returned
+before execution; BENCHMARKS.md round-5 caveats). This script times each
+stage of the flagship program with the only sync the tunnel cannot fake —
+a host scalar fetch of a value data-dependent on the stage's output — and
+fresh (perturbed) inputs per call so result memoization cannot serve
+cache hits.
+
+Stages (flagship: 8,192 pts, bs=2, K=512, knn=32, bf16+pallas+approx):
+  encoder      PointEncoder fwd on one cloud (kNN graph + 3 SetConvs)
+  corr_init    feature matmul + truncated top-k (approx) + xyz gather
+  fwd1/fwd8    full forward at 1 / 8 GRU iterations (slope = per-iter)
+  fwdbwd8      value_and_grad of the sequence loss (no optimizer)
+  step8        the full train step (fwd+bwd+adam)
+
+Writes artifacts/step_profile.json (one JSON line to stdout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--points", type=int, default=8192)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--k", type=int, default=512)
+    p.add_argument("--reps", type=int, default=2)
+    p.add_argument("--variant", default="bf16+pallas+approx")
+    p.add_argument("--out", default="artifacts/step_profile.json")
+    a = p.parse_args()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.models import PVRaft
+    from pvraft_tpu.models.encoder import PointEncoder
+    from pvraft_tpu.ops.corr import corr_init
+
+    VARIANTS = {
+        "bf16+pallas+approx": dict(compute_dtype="bfloat16", use_pallas=True,
+                                   approx_topk=True),
+        "bf16+approx": dict(compute_dtype="bfloat16", use_pallas=False,
+                            approx_topk=True),
+        "bf16": dict(compute_dtype="bfloat16", use_pallas=False),
+        "fp32": dict(use_pallas=False),
+    }
+    cfg = ModelConfig(truncate_k=a.k, **VARIANTS[a.variant])
+    model = PVRaft(cfg)
+    platform = jax.devices()[0].platform
+
+    rng = np.random.default_rng(0)
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (a.batch, a.points, 3))
+                      .astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (a.batch, a.points, 3))
+                      .astype(np.float32))
+    mask = jnp.ones((a.batch, a.points), jnp.float32)
+    gt = pc2 - pc1
+    n_init = min(a.points, max(256, a.k))
+    params = model.init(jax.random.key(0), pc1[:, :n_init], pc2[:, :n_init], 2)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    from pvraft_tpu.config import compute_dtype as _cd
+
+    enc = PointEncoder(cfg.encoder_width, cfg.graph_k, dtype=_cd(cfg),
+                       graph_chunk=cfg.graph_chunk)
+    enc_params = enc.init(jax.random.key(1), pc1[:, :n_init])
+
+    @jax.jit
+    def f_encoder(eps):
+        fmap, _ = enc.apply(enc_params, pc1 + eps)
+        return jnp.sum(fmap.astype(jnp.float32))
+
+    @jax.jit
+    def f_corr_init(eps):
+        fmap1, _ = enc.apply(enc_params, pc1 + eps)
+        fmap2, _ = enc.apply(enc_params, pc2 + eps)
+        st = corr_init(fmap1, fmap2, pc2 + eps, cfg.truncate_k,
+                       cfg.corr_chunk, approx=cfg.approx_topk)
+        return jnp.sum(st.corr.astype(jnp.float32))
+
+    def fwd(n_iters):
+        @jax.jit
+        def f(eps):
+            flows, _ = model.apply(params, pc1 + eps, pc2 + eps, n_iters)
+            return jnp.sum(flows[-1].astype(jnp.float32))
+
+        return f
+
+    @jax.jit
+    def f_fwdbwd(eps):
+        def loss_fn(p):
+            flows, _ = model.apply(p, pc1 + eps, pc2 + eps, 8)
+            return sequence_loss(flows, mask, gt, 0.8)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        gsum = sum(jnp.sum(jnp.abs(g).astype(jnp.float32))
+                   for g in jax.tree_util.tree_leaves(grads))
+        return loss + 0.0 * gsum
+
+    @jax.jit
+    def f_step(eps):
+        def loss_fn(p):
+            flows, _ = model.apply(p, pc1 + eps, pc2 + eps, 8)
+            return sequence_loss(flows, mask, gt, 0.8)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, _ = tx.update(grads, opt_state)
+        new_params = optax.apply_updates(params, updates)
+        psum = sum(jnp.sum(jnp.abs(q).astype(jnp.float32))
+                   for q in jax.tree_util.tree_leaves(new_params))
+        return loss + 0.0 * psum
+
+    stages = [
+        ("encoder", f_encoder),
+        ("corr_init", f_corr_init),
+        ("fwd1", fwd(1)),
+        ("fwd8", fwd(8)),
+        ("fwdbwd8", f_fwdbwd),
+        ("step8", f_step),
+    ]
+    record = {"platform": platform, "variant": a.variant,
+              "points": a.points, "batch": a.batch, "truncate_k": a.k,
+              "host_synced": True, "stages": {}}
+    eps_counter = [0.0]
+
+    def fresh_eps():
+        eps_counter[0] += 1e-6
+        return jnp.float32(eps_counter[0])
+
+    for name, fn in stages:
+        entry = {}
+        try:
+            t0 = time.perf_counter()
+            float(np.asarray(fn(fresh_eps())))  # compile + first run
+            entry["first_call_s"] = round(time.perf_counter() - t0, 2)
+            dts = []
+            for _ in range(a.reps):
+                t0 = time.perf_counter()
+                float(np.asarray(fn(fresh_eps())))
+                dts.append(time.perf_counter() - t0)
+            entry["sec_reps"] = [round(d, 4) for d in dts]
+            entry["sec"] = round(min(dts), 4)
+        except Exception as e:  # noqa: BLE001 — keep profiling other stages
+            entry["error"] = repr(e)[:300]
+        record["stages"][name] = entry
+        print(f"[step_profile] {name}: {entry}", file=sys.stderr)
+
+    s = record["stages"]
+    if "sec" in s.get("fwd8", {}) and "sec" in s.get("fwd1", {}):
+        record["per_iter_s"] = round((s["fwd8"]["sec"] - s["fwd1"]["sec"]) / 7,
+                                     4)
+    print(json.dumps(record))
+    with open(a.out, "w") as f:
+        json.dump(record, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
