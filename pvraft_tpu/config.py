@@ -51,6 +51,12 @@ class ModelConfig:
     # Stream the kNN graph construction over point chunks (avoids the
     # (N, N) distance matrix; needed for 16k+ point clouds).
     graph_chunk: Optional[int] = None
+    # lax.approx_max_k for the encoder kNN graph neighbor selection
+    # (recall ~0.95): the graph top-k over the (N, N) distance matrix is
+    # a TPU sort bottleneck the MXU cannot help with. Approximate
+    # neighbors change which edges the SetConvs aggregate — opt-in,
+    # perf-path only, like approx_topk.
+    approx_knn: bool = False
     # Sequence-parallel correlation: shard both point axes of the
     # correlation volume over the mesh "seq" axis and build the truncated
     # cache with a ppermute ring (parallel/ring.py) instead of the dense
@@ -87,6 +93,22 @@ class ModelConfig:
                 "vs ppermute ring); the ring already bounds per-chip "
                 "memory by the seq-shard width, so drop corr_chunk on "
                 "sharded runs"
+            )
+        # Same honor/ignore discipline for the GRAPH build strategies
+        # (dense, chunked streaming, seq-parallel ring): approx_knn only
+        # exists on the dense path.
+        if self.approx_knn and self.graph_chunk is not None:
+            raise ValueError(
+                "approx_knn is not supported with graph_chunk: the "
+                "chunked graph build keeps an exact running top-k per "
+                "chunk and would silently ignore approx_knn"
+            )
+        if self.approx_knn and self.seq_shard:
+            raise ValueError(
+                "approx_knn is not supported with seq_shard: the ring "
+                "graph build (parallel/ring.py) assembles EXACT "
+                "neighbors across seq shards and would silently ignore "
+                "approx_knn"
             )
 
 

@@ -112,8 +112,8 @@ class PVRaft(nn.Module):
         enc_mesh = self.mesh if cfg.seq_shard else None
         feat = PointEncoder(
             cfg.encoder_width, cfg.graph_k, dtype=dtype,
-            graph_chunk=cfg.graph_chunk, mesh=enc_mesh,
-            name="feature_extractor"
+            graph_chunk=cfg.graph_chunk, graph_approx=cfg.approx_knn,
+            mesh=enc_mesh, name="feature_extractor"
         )
         fmap1, graph1 = feat(xyz1)
         fmap2, _ = feat(xyz2)
@@ -125,8 +125,8 @@ class PVRaft(nn.Module):
         # function of the cloud, so share the feature extractor's.
         fct, graph_ctx = PointEncoder(
             cfg.encoder_width, cfg.graph_k, dtype=dtype,
-            graph_chunk=cfg.graph_chunk, mesh=enc_mesh,
-            name="context_extractor"
+            graph_chunk=cfg.graph_chunk, graph_approx=cfg.approx_knn,
+            mesh=enc_mesh, name="context_extractor"
         )(xyz1, graph=graph1)
         net, inp = jnp.split(fct, [cfg.hidden_dim], axis=-1)
         net = jnp.tanh(net)

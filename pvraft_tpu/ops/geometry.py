@@ -45,6 +45,7 @@ def knn_indices(
     points: jnp.ndarray,
     k: int,
     chunk: Optional[int] = None,
+    approx: bool = False,
 ) -> jnp.ndarray:
     """Indices of the k nearest ``points`` for each ``query`` point.
 
@@ -56,12 +57,29 @@ def knn_indices(
     full (N, M) distance matrix is never materialized — the memory lever
     for 16k+ point graphs (1 GB fp32 at 16,384^2), mirroring the chunked
     correlation truncation (SURVEY.md §5 long-context note).
+
+    ``approx`` selects ``lax.approx_max_k`` (TPU-native partial
+    reduction, recall ~0.95, same lever as ``corr_init``'s
+    ``approx_topk``) on the dense path; rejected with ``chunk`` (the
+    streaming running top-k is exact by construction).
     """
+    if approx and chunk is not None:
+        # Rejected BEFORE the chunk>=M dense-path normalization below so
+        # the contract is deterministic (not dependent on the cloud size),
+        # matching ModelConfig's unconditional approx_knn x graph_chunk
+        # rejection.
+        raise ValueError(
+            "approx kNN is not supported with chunked streaming "
+            "(the running top-k is exact by construction)"
+        )
     if chunk is not None and chunk >= points.shape[1]:
         chunk = None   # one chunk would cover everything: use the dense path
     if chunk is None:
         d = pairwise_sqdist(query, points)
-        _, idx = lax.top_k(-d, k)
+        if approx:
+            _, idx = lax.approx_max_k(-d, k, aggregate_to_topk=True)
+        else:
+            _, idx = lax.top_k(-d, k)
         return idx.astype(jnp.int32)
 
     b, m, _ = points.shape
@@ -119,12 +137,13 @@ class Graph(NamedTuple):
         return self.neighbors.shape[-1]
 
 
-def build_graph(pc: jnp.ndarray, k: int, chunk: Optional[int] = None) -> Graph:
+def build_graph(pc: jnp.ndarray, k: int, chunk: Optional[int] = None,
+                approx: bool = False) -> Graph:
     """Construct the kNN graph of a cloud with itself.
 
     pc: (B, N, 3). Mirrors ``Graph.construct_graph`` (``graph.py:27-89``)
     with batched tensors instead of flat edge lists.
     """
-    idx = knn_indices(pc, pc, k, chunk=chunk)
+    idx = knn_indices(pc, pc, k, chunk=chunk, approx=approx)
     nb = gather_neighbors(pc, idx)
     return Graph(neighbors=idx, rel_pos=nb - pc[:, :, None, :])

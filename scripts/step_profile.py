@@ -55,6 +55,9 @@ def main() -> int:
     VARIANTS = {
         "bf16+pallas+approx": dict(compute_dtype="bfloat16", use_pallas=True,
                                    approx_topk=True),
+        "bf16+pallas+approx+aknn": dict(compute_dtype="bfloat16",
+                                        use_pallas=True, approx_topk=True,
+                                        approx_knn=True),
         "bf16+approx": dict(compute_dtype="bfloat16", use_pallas=False,
                             approx_topk=True),
         "bf16": dict(compute_dtype="bfloat16", use_pallas=False),
@@ -79,7 +82,8 @@ def main() -> int:
     from pvraft_tpu.config import compute_dtype as _cd
 
     enc = PointEncoder(cfg.encoder_width, cfg.graph_k, dtype=_cd(cfg),
-                       graph_chunk=cfg.graph_chunk)
+                       graph_chunk=cfg.graph_chunk,
+                       graph_approx=cfg.approx_knn)
     enc_params = enc.init(jax.random.key(1), pc1[:, :n_init])
 
     @jax.jit

@@ -39,6 +39,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--corr_chunk", type=int, default=None)
     p.add_argument("--graph_chunk", type=int, default=None)
     p.add_argument("--approx_topk", action="store_true")
+    p.add_argument("--approx_knn", action="store_true")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--num_workers", type=int, default=8)
     p.add_argument("--no_strict_sizes", action="store_true",
@@ -62,7 +63,7 @@ def main(argv=None) -> None:
             corr_levels=a.corr_levels,
             base_scale=a.base_scales, use_pallas=a.use_pallas,
             corr_chunk=a.corr_chunk, graph_chunk=a.graph_chunk,
-            approx_topk=a.approx_topk,
+            approx_topk=a.approx_topk, approx_knn=a.approx_knn,
             compute_dtype="bfloat16" if a.bf16 else "float32",
             seq_shard=a.seq_parallel > 1,
         ),

@@ -120,3 +120,57 @@ def test_chunked_knn_randomized_shapes():
             full, chunked,
             err_msg=f"trial {trial}: nq={nq} np={npts} k={k} chunk={chunk}",
         )
+
+
+def test_approx_knn_recall_and_config():
+    """approx=True returns valid indices with high recall vs exact; the
+    config layer rejects the combinations the op cannot honor."""
+    import pytest
+
+    rng = np.random.default_rng(7)
+    pc = rng.uniform(-1, 1, (2, 256, 3)).astype(np.float32)
+    k = 16
+    exact = np.asarray(knn_indices(jnp.asarray(pc), jnp.asarray(pc), k))
+    approx = np.asarray(
+        knn_indices(jnp.asarray(pc), jnp.asarray(pc), k, approx=True)
+    )
+    assert approx.shape == exact.shape and approx.dtype == np.int32
+    assert approx.min() >= 0 and approx.max() < 256
+    recall = np.mean([
+        len(set(approx[b, i]) & set(exact[b, i])) / k
+        for b in range(2) for i in range(256)
+    ])
+    assert recall >= 0.9, recall
+
+    g = build_graph(jnp.asarray(pc), k, approx=True)
+    assert g.neighbors.shape == (2, 256, k)
+
+    with pytest.raises(ValueError):
+        knn_indices(jnp.asarray(pc), jnp.asarray(pc), k, chunk=64,
+                    approx=True)
+
+    from pvraft_tpu.config import ModelConfig
+
+    with pytest.raises(ValueError):
+        ModelConfig(approx_knn=True, graph_chunk=64)
+    with pytest.raises(ValueError):
+        ModelConfig(approx_knn=True, seq_shard=True)
+    ModelConfig(approx_knn=True)  # ok
+
+
+def test_approx_knn_through_model():
+    """cfg.approx_knn must reach the encoder graph build and produce a
+    finite forward."""
+    import jax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models import PVRaft
+
+    cfg = ModelConfig(truncate_k=16, corr_knn=8, graph_k=4, approx_knn=True)
+    model = PVRaft(cfg)
+    rng = np.random.default_rng(3)
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (1, 64, 3)).astype(np.float32))
+    params = model.init(jax.random.key(0), pc1, pc2, 2)
+    flows, _ = model.apply(params, pc1, pc2, 2)
+    assert np.all(np.isfinite(np.asarray(flows)))

@@ -70,6 +70,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--approx_topk", action="store_true",
                    help="approximate correlation truncation (faster on TPU)")
+    p.add_argument("--approx_knn", action="store_true",
+                   help="approximate encoder kNN graph selection (faster on TPU)")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--host_roundtrip", action="store_true",
                    help="with --packed_state: round-trip the flat train "
@@ -108,7 +110,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
             use_pallas=a.use_pallas,
             corr_chunk=a.corr_chunk,
             remat=a.remat,
-            approx_topk=a.approx_topk,
+            approx_topk=a.approx_topk, approx_knn=a.approx_knn,
             graph_chunk=a.graph_chunk,
             scan_unroll=a.scan_unroll,
             # A requested seq mesh axis routes the correlation init through
