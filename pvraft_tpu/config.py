@@ -152,6 +152,13 @@ class TrainConfig:
     # (reference behavior tools/engine.py:197-198 being serial is a
     # torch-era artifact, not part of the protocol).
     eval_batch: int = 0
+    # Scan-fuse this many eval batches into ONE compiled dispatch
+    # (lax.scan over the eval step — the eval twin of
+    # ParallelConfig.steps_per_dispatch). Per-scene metrics and running
+    # means are unchanged. The fused program returns metrics only, so a
+    # --dump_dir run (which needs per-batch flows) falls back to the
+    # per-batch path for that run. 1 disables fusion.
+    eval_scan: int = 1
     checkpoint_interval: int = 5
     # "msgpack" (single atomic file) or "orbax" (async multi-host-aware
     # directory checkpoints); loads auto-detect (engine/checkpoint.py).

@@ -80,6 +80,23 @@ class Evaluator:
             self.model, cfg.train.eval_iters, cfg.train.gamma, refine=refine,
             per_scene=True,
         )
+        # Scan-fused eval: one dispatch evaluates eval_scan stacked
+        # batches (metrics only — flows are never materialized across the
+        # group, which also caps memory). The per-batch step stays built
+        # for the tail group and for --dump_dir runs.
+        self.eval_scan = max(1, cfg.train.eval_scan)
+        if self.eval_scan > 1:
+            step = self.eval_step
+
+            @jax.jit
+            def scan_step(params, stacked):
+                def body(c, b):
+                    m, _ = step(params, b)
+                    return c, m
+
+                return jax.lax.scan(body, 0, stacked)[1]
+
+            self.eval_scan_step = scan_step
 
     def load(self, path: str) -> None:
         tmpl = jax.tree_util.tree_map(np.asarray, self.params)
@@ -115,6 +132,67 @@ class Evaluator:
         dev_sums = None
         count = 0
         n_scenes = len(self.dataset)
+        # Scan fusion groups full-size device batches; --dump_dir needs
+        # per-batch flows, so it disables fusion for that run.
+        scan_n = self.eval_scan if dump_dir is None else 1
+        pending = []
+
+        def accumulate(per_scene_metrics, bsize, scene_axis=0):
+            """mean-over-scenes * (distinct scenes): exact for both the
+            scene-sharded case (local_bsize * world distinct rows) and the
+            unsharded multi-host case, where the global batch axis holds
+            each scene process_count times (the mean over it is
+            duplication-invariant, a raw sum is not)."""
+            nonlocal dev_sums
+            summed = jax.tree_util.tree_map(
+                lambda v: jnp.mean(v, axis=scene_axis) * bsize,
+                per_scene_metrics,
+            )
+            if scene_axis:  # scanned leaves are (S, B): sum the S groups
+                summed = jax.tree_util.tree_map(
+                    lambda v: jnp.sum(v, axis=0), summed
+                )
+            dev_sums = summed if dev_sums is None else jax.tree_util.tree_map(
+                jnp.add, dev_sums, summed
+            )
+
+        def log_progress(added):
+            nonlocal count
+            crossed = (
+                log_every and count // log_every != (count + added) // log_every
+            )
+            count += added
+            if crossed:
+                self.log.info(
+                    f"[{count}/{n_scenes}] "
+                    + " ".join(
+                        f"{k}={float(v) / count:.4f}"
+                        for k, v in sorted(dev_sums.items())
+                    )
+                )
+
+        def flush_scanned():
+            if not pending:
+                return 0
+            bsize = self.eval_batch * self.shard[1]
+            group = list(pending)
+            pending.clear()
+            if len(group) < scan_n:
+                # Partial group: the scan program is compiled for exactly
+                # scan_n batches; re-lowering it for a one-off length
+                # would cost a fresh compile. The per-batch step is
+                # already built — run the stragglers through it.
+                for gb in group:
+                    m, _ = self.eval_step(self.params, gb)
+                    accumulate(m, bsize)
+            else:
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *group
+                )
+                ms = self.eval_scan_step(self.params, stacked)
+                accumulate(ms, bsize, scene_axis=1)  # leaves (S, B)
+            return len(group) * bsize
+
         for batch, b in device_prefetch(
             self.loader.epoch(0),
             # A tail batch smaller than the data axis replicates — per-
@@ -125,19 +203,18 @@ class Evaluator:
                 batch, self.mesh, on_indivisible="replicate")),
             depth=self.cfg.parallel.device_prefetch,
         ):
+            if scan_n > 1 and batch["pc1"].shape[0] == self.eval_batch:
+                pending.append(b)
+                if len(pending) == scan_n:
+                    log_progress(flush_scanned())
+                continue
+            # A smaller (tail) batch: flush any scanned group first so the
+            # running means stay in scene order, then fall through to the
+            # per-batch step.
+            count += flush_scanned()
             metrics, flow = self.eval_step(self.params, b)
             bsize = batch["pc1"].shape[0] * self.shard[1]
-            # mean * (distinct scenes in the global batch): exact for both
-            # the scene-sharded case (local_bsize * world distinct rows)
-            # and the unsharded multi-host case, where the global batch
-            # axis holds each scene process_count times (the mean over it
-            # is duplication-invariant, a raw sum is not).
-            summed = jax.tree_util.tree_map(
-                lambda v: jnp.mean(v, axis=0) * bsize, metrics
-            )
-            dev_sums = summed if dev_sums is None else jax.tree_util.tree_map(
-                jnp.add, dev_sums, summed
-            )
+            accumulate(metrics, bsize)
             if dump_dir is not None:
                 flow_host = np.asarray(flow)
                 for row in range(bsize):
@@ -148,18 +225,8 @@ class Evaluator:
                     np.save(os.path.join(scene, "pc1.npy"), batch["pc1"][row])
                     np.save(os.path.join(scene, "pc2.npy"), batch["pc2"][row])
                     np.save(os.path.join(scene, "flow.npy"), flow_host[row])
-            crossed = (
-                log_every and count // log_every != (count + bsize) // log_every
-            )
-            count += bsize
-            if crossed:
-                self.log.info(
-                    f"[{count}/{n_scenes}] "
-                    + " ".join(
-                        f"{k}={float(v) / count:.4f}"
-                        for k, v in sorted(dev_sums.items())
-                    )
-                )
+            log_progress(bsize)
+        count += flush_scanned()  # partial final group
         means = {
             k: float(v) / max(1, count) for k, v in (dev_sums or {}).items()
         }

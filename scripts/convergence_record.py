@@ -258,6 +258,10 @@ def main() -> int:
     ap.add_argument("--max_angle", type=float, default=None)
     ap.add_argument("--noise", type=float, default=None)
     ap.add_argument("--val_batches", type=int, default=4)
+    ap.add_argument("--approx_knn", action="store_true",
+                    help="add approx_knn to the fast variant (the "
+                         "fast_matches_fp32 gate then certifies its "
+                         "training convergence)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU backend (config API — env vars are "
                          "overridden by the TPU plugin's sitecustomize)")
@@ -298,11 +302,17 @@ def main() -> int:
     variants = [("fp32", {"use_pallas": False})]
     fast = {"compute_dtype": "bfloat16", "approx_topk": True,
             "use_pallas": False}
+    name_fast = "bf16+approx"
     if platform == "tpu":
         fast["use_pallas"] = True
-    variants.append(
-        ("bf16+approx" + ("+pallas" if platform == "tpu" else ""), fast)
-    )
+        name_fast += "+pallas"
+    if args.approx_knn:
+        # Fold the approximate encoder-graph selection into the fast
+        # variant so the fast_matches_fp32 gate certifies that training
+        # with approx_knn converges like the exact-graph fp32 baseline.
+        fast["approx_knn"] = True
+        name_fast += "+aknn"
+    variants.append((name_fast, fast))
 
     results = [
         run_variant(name, kw, steps, args.points, args.batch,

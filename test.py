@@ -23,6 +23,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--truncate_k", type=int, default=512)
     p.add_argument("--corr_knn", type=int, default=32)
     p.add_argument("--eval_iters", type=int, default=32)
+    p.add_argument("--eval_scan", type=int, default=1,
+                   help="scan-fuse this many eval batches per compiled "
+                        "dispatch (metrics only; a --dump_dir run falls "
+                        "back to the per-batch path)")
     p.add_argument("--eval_batch", type=int, default=0,
                    help="scenes evaluated concurrently, sharded over the "
                         "mesh data axis with per-scene metrics (identical "
@@ -72,7 +76,8 @@ def main(argv=None) -> None:
                         synthetic_size=a.synthetic_size,
                         strict_sizes=not a.no_strict_sizes),
         train=TrainConfig(refine=a.refine, eval_iters=a.eval_iters,
-                          eval_batch=a.eval_batch),
+                          eval_batch=a.eval_batch,
+                          eval_scan=a.eval_scan),
         exp_path=a.exp_path,
     )
 
