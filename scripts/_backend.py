@@ -1,0 +1,27 @@
+"""Shared backend pinning for the measurement scripts.
+
+The TPU plugin's sitecustomize pre-imports jax and captures the platform
+before a script's own environment variables could, so pinning the CPU
+backend must go through the config API after ``import jax`` and before
+the first backend-initializing call. Older scripts carry this pattern
+inline (it predates this helper); new scripts should use these two
+functions instead of copying it again.
+"""
+
+from __future__ import annotations
+
+
+def add_cpu_flag(parser) -> None:
+    parser.add_argument(
+        "--cpu", action="store_true",
+        help="pin the CPU backend (config API — env vars are too late "
+             "under the TPU plugin's sitecustomize)",
+    )
+
+
+def maybe_pin_cpu(cpu: bool) -> None:
+    """Call after ``import jax`` and before any backend use."""
+    if cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

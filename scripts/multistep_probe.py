@@ -45,6 +45,9 @@ def main() -> int:
     p.add_argument("--batch", type=int, default=2)
     p.add_argument("--k", type=int, default=512)
     p.add_argument("--out", default=None)
+    from _backend import add_cpu_flag, maybe_pin_cpu
+
+    add_cpu_flag(p)
     a = p.parse_args()
 
     record = {"variant": a.variant, "remat": a.remat, "fuse_k": a.fuse,
@@ -53,6 +56,8 @@ def main() -> int:
         import numpy as np
 
         import jax
+
+        maybe_pin_cpu(a.cpu)
         import jax.numpy as jnp
         import optax
 

@@ -38,11 +38,16 @@ def main() -> int:
     p.add_argument("--reps", type=int, default=2)
     p.add_argument("--variant", default="bf16+pallas+approx")
     p.add_argument("--out", default="artifacts/step_profile.json")
+    from _backend import add_cpu_flag, maybe_pin_cpu
+
+    add_cpu_flag(p)
     a = p.parse_args()
 
     import numpy as np
 
     import jax
+
+    maybe_pin_cpu(a.cpu)
     import jax.numpy as jnp
     import optax
 
