@@ -1,0 +1,29 @@
+"""graftlint — JAX/TPU-aware static analysis for pvraft_tpu.
+
+Two halves:
+
+  * an AST lint engine (``pvraft_tpu.analysis.engine`` +
+    ``pvraft_tpu.analysis.rules``) with TPU-specific rules: host-sync
+    calls reachable from jitted code, Python control flow on tracers,
+    version-fragile jax imports, module-level jnp constants baked into
+    traces, and friends. Run it with
+
+        python -m pvraft_tpu.analysis lint pvraft_tpu/ tests/
+
+  * a shape/dtype contract layer (``pvraft_tpu.analysis.contracts``):
+    the ``@shapecheck`` decorator on the package's public ops — a no-op
+    unless ``PVRAFT_CHECKS=1`` — plus a ``jax.eval_shape`` trace-compat
+    audit (``python -m pvraft_tpu.analysis trace``) that abstractly
+    traces every registered op without running a FLOP.
+
+This package deliberately does NOT import jax at lint time: ``engine``
+and ``rules`` are pure stdlib-``ast`` code so the linter runs in
+milliseconds anywhere; only ``contracts``/``audit`` (imported lazily by
+the ``trace`` subcommand and by decorated modules) touch jax.
+"""
+
+from pvraft_tpu.analysis.engine import (  # noqa: F401
+    Diagnostic,
+    lint_paths,
+    lint_source,
+)

@@ -1,0 +1,74 @@
+"""CLI: ``python -m pvraft_tpu.analysis {lint,trace} ...``.
+
+``lint`` is pure stdlib-AST and never initializes a jax backend.
+``trace`` imports jax and abstractly traces every registered op with
+``jax.eval_shape`` (zero FLOPs — shape propagation only), reporting any
+concretization / shape errors a TPU run would hit at compile time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _cmd_lint(args) -> int:
+    from pvraft_tpu.analysis.engine import all_rules, lint_paths
+
+    if args.list_rules:
+        for rule in all_rules():
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id}  {rule.title:<26} {doc}")
+        return 0
+    if not args.paths:
+        print("usage: python -m pvraft_tpu.analysis lint PATH [PATH ...]",
+              file=sys.stderr)
+        return 2
+    select = tuple(args.select.split(",")) if args.select else ()
+    diags, nfiles = lint_paths(args.paths, rule_ids=select)
+    for d in diags:
+        print(d.format())
+    summary = f"graftlint: {len(diags)} finding(s) in {nfiles} file(s)"
+    print(summary, file=sys.stderr)
+    return 1 if diags else 0
+
+
+def _cmd_trace(args) -> int:
+    from pvraft_tpu.analysis.audit import run_audit
+
+    results = run_audit(verbose=True)
+    bad = [r for r in results if not r.ok]
+    print(
+        f"trace-compat audit: {len(results) - len(bad)}/{len(results)} "
+        "op(s) trace clean", file=sys.stderr,
+    )
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m pvraft_tpu.analysis",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_lint = sub.add_parser("lint", help="run the AST lint rules")
+    p_lint.add_argument("paths", nargs="*", help="files/directories to lint")
+    p_lint.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    p_lint.add_argument("--select", default="",
+                        help="comma-separated rule ids to run (default all)")
+    p_lint.set_defaults(fn=_cmd_lint)
+
+    p_trace = sub.add_parser(
+        "trace", help="eval_shape trace-compat audit of registered ops"
+    )
+    p_trace.set_defaults(fn=_cmd_trace)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

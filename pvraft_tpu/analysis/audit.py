@@ -1,0 +1,283 @@
+"""Trace-compat audit: abstractly trace every registered op, zero FLOPs.
+
+``jax.eval_shape`` runs the full trace machinery — shape propagation,
+Python control flow, ``lax.scan``/``top_k`` shape rules, the
+``@shapecheck`` contracts when enabled — without executing anything. So
+every failure mode the linter hunts *dynamically manifests here*:
+tracer concretization, shape drift between the point and voxel
+branches, version-fragile lowering, all caught on a CPU host in
+milliseconds per op.
+
+Each entry is a thunk returning ``(fn, args)`` where array args are
+``jax.ShapeDtypeStruct``s; the audit calls ``jax.eval_shape(fn, *args)``
+and reports per-op pass/fail. Run it:
+
+    python -m pvraft_tpu.analysis trace
+
+Dims are deliberately small and pairwise-distinct (B=2, N=24, M=40,
+D=16, K=8) so a transposed axis can never accidentally type-check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import traceback
+from typing import Callable, Dict, List, Tuple
+
+# Symbolic dims: distinct so axis mixups fail loudly.
+B, N, M, D, K = 2, 24, 40, 16, 8
+
+
+@dataclasses.dataclass
+class AuditResult:
+    name: str
+    ok: bool
+    detail: str  # out shapes on success, error summary on failure
+
+
+_ENTRIES: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {}
+
+
+def audit_entry(name: str):
+    def deco(thunk):
+        if name in _ENTRIES:
+            raise ValueError(f"duplicate audit entry {name}")
+        _ENTRIES[name] = thunk
+        return thunk
+
+    return deco
+
+
+def _f32(*shape):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, "float32")
+
+
+def _i32(*shape):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, "int32")
+
+
+# --- ops/geometry ---------------------------------------------------------
+
+@audit_entry("geometry.pairwise_sqdist")
+def _e_pairwise():
+    from pvraft_tpu.ops.geometry import pairwise_sqdist
+
+    return pairwise_sqdist, (_f32(B, N, 3), _f32(B, M, 3))
+
+
+@audit_entry("geometry.knn_indices")
+def _e_knn():
+    from pvraft_tpu.ops.geometry import knn_indices
+
+    return lambda q, p: knn_indices(q, p, K), (_f32(B, N, 3), _f32(B, M, 3))
+
+
+@audit_entry("geometry.knn_indices[chunked]")
+def _e_knn_chunked():
+    from pvraft_tpu.ops.geometry import knn_indices
+
+    return (
+        lambda q, p: knn_indices(q, p, K, chunk=M // 2),
+        (_f32(B, N, 3), _f32(B, M, 3)),
+    )
+
+
+@audit_entry("geometry.gather_neighbors")
+def _e_gather():
+    from pvraft_tpu.ops.geometry import gather_neighbors
+
+    return gather_neighbors, (_f32(B, M, D), _i32(B, N, K))
+
+
+@audit_entry("geometry.build_graph")
+def _e_graph():
+    from pvraft_tpu.ops.geometry import build_graph
+
+    return lambda pc: build_graph(pc, K), (_f32(B, N, 3),)
+
+
+# --- ops/corr -------------------------------------------------------------
+
+@audit_entry("corr.corr_volume")
+def _e_corr_volume():
+    from pvraft_tpu.ops.corr import corr_volume
+
+    return corr_volume, (_f32(B, N, D), _f32(B, M, D))
+
+
+@audit_entry("corr.corr_init")
+def _e_corr_init():
+    from pvraft_tpu.ops.corr import corr_init
+
+    return (
+        lambda f1, f2, x2: corr_init(f1, f2, x2, K),
+        (_f32(B, N, D), _f32(B, M, D), _f32(B, M, 3)),
+    )
+
+
+@audit_entry("corr.corr_init[chunked]")
+def _e_corr_init_chunked():
+    from pvraft_tpu.ops.corr import corr_init
+
+    return (
+        lambda f1, f2, x2: corr_init(f1, f2, x2, K, chunk=M // 2),
+        (_f32(B, N, D), _f32(B, M, D), _f32(B, M, 3)),
+    )
+
+
+@audit_entry("corr.knn_lookup")
+def _e_knn_lookup():
+    from pvraft_tpu.ops.corr import CorrState, knn_lookup
+
+    state = CorrState(corr=_f32(B, N, K), xyz=_f32(B, N, K, 3))
+    return (
+        lambda s, rel: knn_lookup(s, rel, K // 2),
+        (state, _f32(B, N, K, 3)),
+    )
+
+
+# --- ops/voxel + Pallas kernels ------------------------------------------
+
+@audit_entry("voxel.voxel_bin_means")
+def _e_voxel():
+    from pvraft_tpu.ops.voxel import voxel_bin_means
+
+    return (
+        lambda c, rel: voxel_bin_means(c, rel, 3, 0.25),
+        (_f32(B, N, K), _f32(B, N, K, 3)),
+    )
+
+
+@audit_entry("pallas.voxel_bin_means_pallas")
+def _e_voxel_pallas():
+    from pvraft_tpu.ops.pallas.voxel_corr import voxel_bin_means_pallas
+
+    return (
+        lambda c, rel: voxel_bin_means_pallas(c, rel, 3, 0.25),
+        (_f32(B, N, K), _f32(B, N, K, 3)),
+    )
+
+
+@audit_entry("pallas.fused_corr_lookup")
+def _e_fused():
+    from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
+
+    return (
+        lambda c, xyz, co: fused_corr_lookup(c, xyz, co, 3, 0.25, 3, K // 2),
+        (_f32(B, N, K), _f32(B, N, K, 3), _f32(B, N, 3)),
+    )
+
+
+# --- parallel/ring (under shard_map on a 1-device mesh) -------------------
+
+@audit_entry("ring.ring_corr_init")
+def _e_ring():
+    from jax.sharding import PartitionSpec as P
+
+    from pvraft_tpu.compat import shard_map
+    from pvraft_tpu.ops.corr import CorrState
+    from pvraft_tpu.parallel.mesh import make_mesh
+    from pvraft_tpu.parallel.ring import ring_corr_init
+
+    mesh = make_mesh(n_data=1, n_seq=1)
+
+    def fn(f1, f2, x2):
+        return shard_map(
+            lambda a, b, c: ring_corr_init(a, b, c, K, "seq"),
+            mesh=mesh,
+            in_specs=(P(None, "seq", None),) * 2 + (P(None, "seq", None),),
+            out_specs=CorrState(
+                corr=P(None, "seq", None), xyz=P(None, "seq", None, None)
+            ),
+            check_vma=False,
+        )(f1, f2, x2)
+
+    return fn, (_f32(B, N, D), _f32(B, M, D), _f32(B, M, 3))
+
+
+# --- models (full forward passes, abstract params included) ---------------
+
+def _model_entry(refine: bool):
+    import jax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models.raft import PVRaft, PVRaftRefine
+
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2)
+    model = (PVRaftRefine if refine else PVRaft)(cfg)
+
+    # pc2 gets M points and num_iters (T) differs from B: an axis mixup
+    # inside the model cannot accidentally type-check (same discipline as
+    # the op-level entries).
+    def fn(pc1, pc2):
+        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        return model.apply(params, pc1, pc2, 3)
+
+    return fn, (_f32(B, N, 3), _f32(B, M, 3))
+
+
+@audit_entry("models.PVRaft")
+def _e_pvraft():
+    return _model_entry(refine=False)
+
+
+@audit_entry("models.PVRaftRefine")
+def _e_refine():
+    return _model_entry(refine=True)
+
+
+# --- engine (the jitted train step, end to end) ---------------------------
+
+@audit_entry("engine.train_step")
+def _e_train_step():
+    import jax
+    import optax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.steps import make_train_step
+    from pvraft_tpu.models.raft import PVRaft
+
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2)
+    model = PVRaft(cfg)
+    tx = optax.sgd(1e-2)
+
+    def fn(pc1, pc2, mask, gt):
+        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        opt_state = tx.init(params)
+        step = make_train_step(model, tx, 0.8, 3)
+        batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
+        return step(params, opt_state, batch)
+
+    return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
+
+
+def run_audit(verbose: bool = False) -> List[AuditResult]:
+    """eval_shape every registered entry. Never raises; failures become
+    ``AuditResult(ok=False)`` so one broken op can't hide the rest."""
+    import jax
+
+    results: List[AuditResult] = []
+    for name in sorted(_ENTRIES):
+        try:
+            fn, args = _ENTRIES[name]()
+            out = jax.eval_shape(fn, *args)
+            shapes = jax.tree_util.tree_map(
+                lambda s: tuple(s.shape), out
+            )
+            detail = f"{shapes}"
+            if len(detail) > 160:  # param pytrees dump pages otherwise
+                leaves = jax.tree_util.tree_leaves(shapes)
+                detail = f"<pytree of {len(leaves)} arrays>"
+            results.append(AuditResult(name, True, detail))
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            last = traceback.format_exception_only(type(e), e)[-1].strip()
+            results.append(AuditResult(name, False, last[:500]))
+    if verbose:
+        for r in results:
+            mark = "PASS" if r.ok else "FAIL"
+            print(f"[{mark}] {r.name}: {r.detail}")
+    return results

@@ -1,0 +1,246 @@
+"""Shape/dtype contracts: the ``@shapecheck`` decorator.
+
+The PV-RAFT pipeline is a chain of shape-contracted ops — ``pc1 (B,N,3)``
+-> truncated correlation ``(B,N,K)`` -> flow ``(B,N,3)`` — and the
+point/voxel branches drift apart silently until a TPU run explodes.
+``@shapecheck`` states the contract at the def site and (when enabled)
+verifies it at trace time on CPU, with readable errors.
+
+Zero-cost guarantee: unless ``PVRAFT_CHECKS=1`` is set **at import
+time**, the decorator returns the original function object — not a
+wrapper — so jaxprs, ids, and call overhead are byte-identical to the
+undecorated code (tested in ``tests/test_contracts.py``). Even when
+enabled, checks read only static metadata (``x.shape``/``x.dtype``), so
+the traced computation — the jaxpr — is unchanged; enabling contracts
+can never change numerics.
+
+Spec grammar (one space-separated token per axis)::
+
+    @shapecheck("B N D", "B M D", "B M 3", out=("B N K", "B N K 3"))
+    def corr_init(fmap1, fmap2, xyz2, truncate_k, ...): ...
+
+  * ``3``      — literal: the axis must be exactly 3;
+  * ``N``      — named: bound on first sight, must match everywhere else
+                 in the same call (inputs AND outputs);
+  * ``_``      — wildcard: any size;
+  * spec ``None`` — skip that argument (non-array / unconstrained);
+  * ``out=``   — a spec for the return value, or a tuple of specs zipped
+                 against a tuple return (``None`` entries skipped).
+
+``dtype=`` optionally constrains checked args: a jnp dtype-like
+(``"float32"``) for an exact match, or the strings ``"floating"`` /
+``"integer"`` for a kind check.
+
+No jax import happens at decoration time when checks are off — this
+module stays importable (and free) everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+ENV_VAR = "PVRAFT_CHECKS"
+
+
+def checks_enabled() -> bool:
+    """Contracts are on iff ``PVRAFT_CHECKS=1`` (evaluated at import /
+    decoration time — the zero-cost path returns undecorated functions)."""
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+class ShapeError(ValueError):
+    """A violated shape/dtype contract, with enough context to act on."""
+
+
+_Spec = Optional[str]
+
+
+def _parse(spec: str) -> Tuple[Union[int, str], ...]:
+    dims = []
+    for tok in spec.split():
+        dims.append(int(tok) if tok.lstrip("-").isdigit() else tok)
+    if not dims:
+        raise ValueError(f"empty shape spec {spec!r}")
+    return tuple(dims)
+
+
+def _shape_of(x: Any):
+    return getattr(x, "shape", None)
+
+
+def _check_one(
+    x: Any,
+    spec: str,
+    bindings: Dict[str, int],
+    where: str,
+    fn_name: str,
+) -> None:
+    shape = _shape_of(x)
+    if shape is None:
+        raise ShapeError(
+            f"{fn_name}: {where} expected an array of shape [{spec}], got "
+            f"{type(x).__name__} (no .shape)"
+        )
+    dims = _parse(spec)
+    if len(shape) != len(dims):
+        raise ShapeError(
+            f"{fn_name}: {where} expected rank {len(dims)} [{spec}], got "
+            f"rank {len(shape)} shape {tuple(shape)}"
+        )
+    for axis, (want, got) in enumerate(zip(dims, shape)):
+        if want == "_":
+            continue
+        if isinstance(want, int):
+            if got != want:
+                raise ShapeError(
+                    f"{fn_name}: {where} axis {axis} must be {want} "
+                    f"(spec [{spec}]), got shape {tuple(shape)}"
+                )
+        else:
+            bound = bindings.setdefault(want, got)
+            if bound != got:
+                raise ShapeError(
+                    f"{fn_name}: {where} axis {axis} ({want}={got}) "
+                    f"conflicts with {want}={bound} bound earlier in this "
+                    f"call (spec [{spec}], shape {tuple(shape)}; "
+                    f"bindings {bindings})"
+                )
+
+
+def _check_dtype(x: Any, dtype: str, where: str, fn_name: str) -> None:
+    got = getattr(x, "dtype", None)
+    if got is None:
+        return
+    import jax.numpy as jnp
+
+    if dtype == "floating":
+        ok = jnp.issubdtype(got, jnp.floating)
+    elif dtype == "integer":
+        ok = jnp.issubdtype(got, jnp.integer)
+    else:
+        ok = got == jnp.dtype(dtype)
+    if not ok:
+        raise ShapeError(
+            f"{fn_name}: {where} expected dtype {dtype}, got {got}"
+        )
+
+
+class ContractSpec:
+    """Parsed decorator arguments, attached to the function as
+    ``__shapecheck__`` whether or not checks are enabled (the trace-compat
+    audit and tests read it)."""
+
+    def __init__(self, arg_specs, out, dtype):
+        self.arg_specs: Tuple[_Spec, ...] = arg_specs
+        self.out = out
+        self.dtype = dtype
+
+    def __repr__(self):
+        return (f"ContractSpec(args={self.arg_specs!r}, out={self.out!r}, "
+                f"dtype={self.dtype!r})")
+
+
+def _check_call(
+    spec: ContractSpec, fn_name: str, values
+) -> Dict[str, int]:
+    """``values``: per-spec ``(present, value)`` pairs (absent = defaulted)."""
+    bindings: Dict[str, int] = {}
+    for i, (s, (present, value)) in enumerate(zip(spec.arg_specs, values)):
+        if s is None or not present:
+            continue
+        where = f"argument {i}"
+        _check_one(value, s, bindings, where, fn_name)
+        if spec.dtype is not None:
+            _check_dtype(value, spec.dtype, where, fn_name)
+    return bindings
+
+
+def _check_out(spec: ContractSpec, fn_name: str, bindings, result) -> None:
+    out = spec.out
+    if out is None:
+        return
+    if isinstance(out, str):
+        _check_one(result, out, bindings, "return value", fn_name)
+        return
+    if not isinstance(result, tuple) or len(result) < len(out):
+        raise ShapeError(
+            f"{fn_name}: return value expected a tuple of >= {len(out)} "
+            f"elements for out specs {out!r}, got {type(result).__name__}"
+        )
+    for i, s in enumerate(out):
+        if s is None:
+            continue
+        _check_one(result[i], s, bindings, f"return value [{i}]", fn_name)
+
+
+def wrap_with_spec(fn, spec: ContractSpec):
+    """The checking wrapper for ``fn`` (used directly by tests; normal
+    code gets it via ``@shapecheck`` when ``PVRAFT_CHECKS=1``)."""
+    import inspect
+
+    # Specs align with the function's parameters after `self`; a
+    # contracted argument is checked however it is passed — positionally
+    # OR by keyword (an unchecked kwarg would be false confidence).
+    sig = None
+    param_names: Tuple[str, ...] = ()
+    try:
+        sig = inspect.signature(fn)
+        param_names = tuple(sig.parameters)
+        if param_names and param_names[0] == "self":
+            param_names = param_names[1:]
+    except (TypeError, ValueError):
+        pass
+
+    def _values(args, kwargs):
+        if sig is not None:
+            try:
+                bound = sig.bind(*args, **kwargs)
+            except TypeError:
+                bound = None  # fn will raise its own, better error
+            if bound is not None:
+                return [
+                    (name in bound.arguments, bound.arguments.get(name))
+                    for name in param_names[: len(spec.arg_specs)]
+                ]
+        # No usable signature: positional-only fallback.
+        return [
+            (i < len(args), args[i] if i < len(args) else None)
+            for i in range(len(spec.arg_specs))
+        ]
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        bindings = _check_call(
+            spec, fn.__qualname__, _values(args, kwargs)
+        )
+        result = fn(*args, **kwargs)
+        _check_out(spec, fn.__qualname__, bindings, result)
+        return result
+
+    wrapper.__shapecheck__ = spec
+    wrapper.__shapecheck_inner__ = fn
+    return wrapper
+
+
+def shapecheck(
+    *arg_specs: _Spec,
+    out: Union[None, str, Tuple[_Spec, ...]] = None,
+    dtype: Optional[str] = None,
+):
+    """Declare (and, under ``PVRAFT_CHECKS=1``, enforce) a shape contract.
+
+    See the module docstring for the grammar. Positional specs align with
+    the function's positional parameters (``self`` auto-skipped); trailing
+    parameters without specs are unconstrained.
+    """
+    spec = ContractSpec(arg_specs, out, dtype)
+
+    def deco(fn):
+        if not checks_enabled():
+            fn.__shapecheck__ = spec  # visible to the audit + tests
+            return fn
+        return wrap_with_spec(fn, spec)
+
+    return deco

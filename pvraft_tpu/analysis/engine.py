@@ -1,0 +1,215 @@
+"""Lint engine: rule registry, suppression handling, file walking.
+
+A rule is a subclass of :class:`Rule` registered with :func:`register`.
+Each rule sees the parsed module once (``check(ctx)``) and yields
+:class:`Diagnostic` findings. The engine owns everything rule-agnostic:
+parsing, per-line/per-file suppression comments, path walking, and
+stable ordering of the output.
+
+Suppression syntax (checked literally, like the tools it imitates):
+
+    x = something()          # graftlint: disable=GL001
+    x = something_else()     # graftlint: disable=GL001,GL004 -- reason
+    # graftlint: disable-next=GL004 -- reason
+    from jax.experimental import topologies
+    # graftlint: disable-file=GL004 -- pinned-version escape hatch
+
+``disable=...`` silences the named rules on that source line only;
+``disable-next=...`` (a comment on its own line) on the line directly
+below it; ``disable-file=...`` (anywhere in the file) for the whole
+file. ``disable=all`` exists for fixtures and emergencies. Text after
+``--`` is a free-form reason and is encouraged.
+
+Only stdlib ``ast``/``re`` here — no jax import — so linting stays fast
+and runnable on hosts with no accelerator stack at all.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Iterable, Iterator, List, Tuple, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: ``path:line:col: rule_id message``."""
+
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule_id} {self.message}"
+
+
+class LintContext:
+    """Per-file state handed to every rule."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        # Absolute, forward-slash path so rules scoping by package area
+        # ("pvraft_tpu/data/", the compat.py exemption) behave the same
+        # whether the lint was invoked on a directory, a relative path,
+        # or a bare filename from inside the package.
+        if path == "<string>":
+            self.norm_path = path
+        else:
+            self.norm_path = os.path.abspath(path).replace(os.sep, "/")
+
+    def diag(self, node: ast.AST, rule_id: str, message: str) -> Diagnostic:
+        return Diagnostic(
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            rule_id=rule_id,
+            message=message,
+        )
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``title`` and implement ``check``.
+
+    ``id`` is the stable suppression key (``GLxxx``); ``title`` a short
+    slug; the class docstring is the human explanation printed by
+    ``lint --list-rules``.
+    """
+
+    id: str = ""
+    title: str = ""
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        raise NotImplementedError
+
+
+_REGISTRY: List[Type[Rule]] = []
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id or not cls.title:
+        raise ValueError(f"rule {cls.__name__} must set id and title")
+    if any(r.id == cls.id for r in _REGISTRY):
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY.append(cls)
+    return cls
+
+
+def all_rules() -> Tuple[Type[Rule], ...]:
+    # Rules live in pvraft_tpu.analysis.rules; import lazily to avoid a
+    # circular import at package-init time.
+    import pvraft_tpu.analysis.rules  # noqa: F401
+
+    return tuple(sorted(_REGISTRY, key=lambda r: r.id))
+
+
+# --- suppression comments -------------------------------------------------
+
+_LINE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,]+)")
+_NEXT_RE = re.compile(r"#\s*graftlint:\s*disable-next=([A-Za-z0-9_,]+)")
+_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,]+)")
+
+
+def _comment_tokens(source: str) -> List[Tuple[int, str]]:
+    """(lineno, text) of REAL comment tokens — a suppression example shown
+    inside a docstring or string literal must never disable anything."""
+    out: List[Tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # partial tokenization still yielded the comments before it
+    return out
+
+
+def _suppressions(source: str):
+    """(per-line {lineno: ids}, file-level ids) from suppression comments."""
+    per_line: dict = {}
+    file_ids: set = set()
+    for i, text in _comment_tokens(source):
+        m = _FILE_RE.search(text)
+        if m:
+            file_ids.update(m.group(1).split(","))
+            continue
+        m = _NEXT_RE.search(text)
+        if m:
+            per_line.setdefault(i + 1, set()).update(m.group(1).split(","))
+            continue
+        m = _LINE_RE.search(text)
+        if m:
+            per_line.setdefault(i, set()).update(m.group(1).split(","))
+    return per_line, file_ids
+
+
+def _suppressed(d: Diagnostic, per_line, file_ids) -> bool:
+    if "all" in file_ids or d.rule_id in file_ids:
+        return True
+    ids = per_line.get(d.line, ())
+    return "all" in ids or d.rule_id in ids
+
+
+# --- entry points ---------------------------------------------------------
+
+def lint_source(
+    source: str, path: str = "<string>", rule_ids: Sequence[str] = ()
+) -> List[Diagnostic]:
+    """Lint one source string. ``rule_ids`` restricts to those rules."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Diagnostic(path, e.lineno or 1, e.offset or 0, "GL000",
+                       f"syntax error: {e.msg}")
+        ]
+    ctx = LintContext(path, source, tree)
+    per_line, file_ids = _suppressions(source)
+    out: List[Diagnostic] = []
+    for rule_cls in all_rules():
+        if rule_ids and rule_cls.id not in rule_ids:
+            continue
+        for d in rule_cls().check(ctx):
+            if not _suppressed(d, per_line, file_ids):
+                out.append(d)
+    out.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return out
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".pytest_cache")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            raise FileNotFoundError(p)
+
+
+def lint_paths(
+    paths: Sequence[str], rule_ids: Sequence[str] = ()
+) -> Tuple[List[Diagnostic], int]:
+    """Lint files/directories. Returns (diagnostics, files_checked)."""
+    out: List[Diagnostic] = []
+    n = 0
+    for f in iter_py_files(paths):
+        n += 1
+        with open(f, "r", encoding="utf-8") as fh:
+            out.extend(lint_source(fh.read(), path=f, rule_ids=rule_ids))
+    out.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return out, n

@@ -1,0 +1,512 @@
+"""graftlint rules GL001-GL008 — the TPU failure modes worth automating.
+
+Each rule's class docstring is its user-facing documentation (printed by
+``python -m pvraft_tpu.analysis lint --list-rules``). Suppress any rule
+on a line with ``# graftlint: disable=GLxxx -- reason``.
+
+Scope discipline: the expensive rules (host sync, tracer control flow,
+tracer asserts) only fire inside functions this module can PROVE are
+jit-traced — functions decorated with ``jax.jit``/``partial(jax.jit)``,
+functions passed to a ``jax.jit(...)`` call in the same module, and
+everything lexically nested inside those. That is deliberately
+under-approximate (no cross-module call graph): a lint gate that cries
+wolf gets disabled; one that only flags certainties gets kept.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from pvraft_tpu.analysis.engine import Diagnostic, LintContext, Rule, register
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Attribute reads that concretize nothing: static metadata available on
+# tracers at trace time.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+
+
+def _attach_parents(tree: ast.Module) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._gl_parent = node  # type: ignore[attr-defined]
+
+
+def _ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "_gl_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_gl_parent", None)
+
+
+def _mentions_jit(expr: ast.AST) -> bool:
+    """Does this decorator/callee expression reference a ``jit`` symbol
+    (``jax.jit``, bare ``jit``, ``partial(jax.jit, ...)``)?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id == "jit":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return True
+    return False
+
+
+def jit_context_functions(tree: ast.Module) -> Set[ast.AST]:
+    """Function nodes that are provably traced under ``jax.jit``.
+
+    Roots: a) decorated with something mentioning ``jit``; b) named as the
+    first argument of a call whose callee mentions ``jit`` anywhere in the
+    module. Every function lexically nested inside a root is included.
+    """
+    _attach_parents(tree)
+    jitted_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _mentions_jit(node.func):
+            if node.args and isinstance(node.args[0], ast.Name):
+                jitted_names.add(node.args[0].id)
+
+    roots: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES):
+            if any(_mentions_jit(d) for d in node.decorator_list):
+                roots.add(node)
+            elif node.name in jitted_names:
+                roots.add(node)
+
+    out: Set[ast.AST] = set(roots)
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES) and any(
+            a in roots for a in _ancestors(node)
+        ):
+            out.add(node)
+    return out
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    return names
+
+
+def _tainted_names(fn: ast.AST) -> Set[str]:
+    """Names (probably) holding tracers inside a jitted function: its
+    parameters, plus anything assigned from an expression that reads a
+    tainted name (one forward pass — no fixpoint, matching the "only flag
+    certainties" stance)."""
+    tainted = _param_names(fn)
+
+    def expr_tainted(expr: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Name) and n.id in tainted
+            for n in ast.walk(expr)
+        )
+
+    class Prop(ast.NodeVisitor):
+        def visit_Assign(self, node: ast.Assign):
+            if expr_tainted(node.value):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+            self.generic_visit(node)
+
+        # Nested functions get their own analysis pass.
+        def visit_FunctionDef(self, node):
+            if node is not fn:
+                return
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    Prop().visit(fn)
+    return tainted
+
+
+def _dynamic_taint_uses(expr: ast.AST, tainted: Set[str]) -> List[ast.Name]:
+    """Tainted Name reads in ``expr`` that are NOT static-metadata uses
+    (``x.shape``, ``x is None``, ``isinstance(x, ...)``, ``len(...)`` of
+    those)."""
+    out: List[ast.Name] = []
+    for node in ast.walk(expr):
+        if not (isinstance(node, ast.Name) and node.id in tainted):
+            continue
+        parent = getattr(node, "_gl_parent", None)
+        if isinstance(parent, ast.Attribute) and parent.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(parent, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops
+        ):
+            continue
+        if (
+            isinstance(parent, ast.Call)
+            and isinstance(parent.func, ast.Name)
+            and parent.func.id in ("isinstance", "len", "type")
+        ):
+            continue
+        out.append(node)
+    return out
+
+
+def _own_statements(fn: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of ``fn``'s body excluding nested function bodies."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES + (ast.Lambda,)):
+                continue
+            stack.append(child)
+
+
+def _dotted(expr: ast.AST) -> str:
+    """'jax.debug.print'-style dotted name of an expression, or ''."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# --- GL001 ----------------------------------------------------------------
+
+@register
+class HostSyncInJit(Rule):
+    """Host-synchronizing call inside a jit-traced function.
+
+    ``x.item()``, ``float(x)``/``int(x)``/``bool(x)`` on a tracer, and
+    ``np.asarray``/``np.array`` all force a device->host transfer (or
+    fail outright) at trace time, silently serializing the TPU pipeline
+    when they do work. Return arrays from the jitted function and
+    convert on the host instead.
+    """
+
+    id = "GL001"
+    title = "host-sync-in-jit"
+
+    _NP_FUNCS = {"asarray", "array", "float32", "float64", "int32", "int64"}
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        jitted = jit_context_functions(ctx.tree)
+        for fn in jitted:
+            tainted = _tainted_names(fn)
+            for call in _own_statements(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                f = call.func
+                if isinstance(f, ast.Attribute) and f.attr == "item" \
+                        and not call.args:
+                    yield ctx.diag(
+                        call, self.id,
+                        "`.item()` inside a jit-traced function forces "
+                        "a device sync; return the array and convert "
+                        "on the host",
+                    )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy", "onp")
+                    and f.attr in self._NP_FUNCS
+                ):
+                    yield ctx.diag(
+                        call, self.id,
+                        f"`{f.value.id}.{f.attr}(...)` inside a "
+                        "jit-traced function concretizes the tracer "
+                        "(host sync); use jnp or move it outside jit",
+                    )
+                elif (
+                    isinstance(f, ast.Name)
+                    and f.id in ("float", "int", "bool")
+                    and len(call.args) == 1
+                    and _dynamic_taint_uses(call.args[0], tainted)
+                ):
+                    yield ctx.diag(
+                        call, self.id,
+                        f"`{f.id}(...)` on a traced value inside jit "
+                        "concretizes the tracer (host sync)",
+                    )
+
+
+# --- GL002 ----------------------------------------------------------------
+
+@register
+class TracerControlFlow(Rule):
+    """Python ``if``/``while`` on a traced value inside a jit function.
+
+    Python control flow runs at TRACE time: branching on a tracer raises
+    ``TracerBoolConversionError`` (or worse, silently bakes one branch
+    into the compiled program). Use ``lax.cond``/``lax.while_loop`` or
+    ``jnp.where``; branching on static metadata (``x.shape``, ``x is
+    None``, config flags) is fine and not flagged.
+    """
+
+    id = "GL002"
+    title = "tracer-control-flow"
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        jitted = jit_context_functions(ctx.tree)
+        for fn in jitted:
+            tainted = _tainted_names(fn)
+            for node in _own_statements(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    uses = _dynamic_taint_uses(node.test, tainted)
+                    if uses:
+                        kw = "if" if isinstance(node, ast.If) else "while"
+                        yield ctx.diag(
+                            node, self.id,
+                            f"Python `{kw}` on traced value "
+                            f"`{uses[0].id}` inside jit; use lax.cond / "
+                            "lax.while_loop / jnp.where",
+                        )
+
+
+# --- GL003 ----------------------------------------------------------------
+
+@register
+class ModuleLevelJnpConstant(Rule):
+    """Module-level ``jnp`` array constant.
+
+    A ``jnp.array/zeros/ones/arange/...`` at module scope allocates on
+    the default device at import time and is CAPTURED as a constant by
+    every jit trace that touches it — it is re-uploaded per executable
+    and pins the import to a backend. Build it inside the function (XLA
+    constant-folds it) or keep it a ``np`` array.
+    """
+
+    id = "GL003"
+    title = "module-level-jnp-constant"
+
+    _BUILDERS = {
+        "array", "asarray", "zeros", "ones", "full", "arange", "linspace",
+        "eye", "zeros_like", "ones_like", "full_like",
+    }
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        for stmt in ctx.tree.body:
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = stmt.value
+            if value is None:
+                continue
+            for call in ast.walk(value):
+                if (
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id == "jnp"
+                    and call.func.attr in self._BUILDERS
+                ):
+                    yield ctx.diag(
+                        stmt, self.id,
+                        f"module-level `jnp.{call.func.attr}(...)` is "
+                        "baked into every jit trace as a captured "
+                        "constant; build it inside the function or use np",
+                    )
+                    break
+
+
+# --- GL004 ----------------------------------------------------------------
+
+@register
+class FragileJaxImport(Rule):
+    """Version-fragile jax import outside the compat shim.
+
+    ``jax.experimental.*`` has no stability promise, and symbols like
+    ``shard_map`` have already moved homes between pinned versions (the
+    exact import that used to kill this repo's test collection). Route
+    these through ``pvraft_tpu/compat.py`` — one file to touch on a jax
+    upgrade — or suppress with a reason where no stable spelling exists.
+    """
+
+    id = "GL004"
+    title = "fragile-jax-import"
+
+    # Symbols that moved between jax versions: importing them from a
+    # specific home is fragile in BOTH directions.
+    _MOVED = {"shard_map"}
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        if ctx.norm_path.endswith("pvraft_tpu/compat.py"):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module == "jax":
+                    for alias in node.names:
+                        if alias.name in self._MOVED:
+                            yield ctx.diag(
+                                node, self.id,
+                                f"`from jax import {alias.name}` is "
+                                "version-fragile (moved between jax "
+                                "releases); use pvraft_tpu.compat",
+                            )
+                elif node.module.split(".")[:2] == ["jax", "experimental"]:
+                    yield ctx.diag(
+                        node, self.id,
+                        f"import from `{node.module}` (no stability "
+                        "promise); route through pvraft_tpu.compat or "
+                        "suppress with a reason",
+                    )
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[:2] == ["jax", "experimental"]:
+                        yield ctx.diag(
+                            node, self.id,
+                            f"`import {alias.name}` (no stability "
+                            "promise); route through pvraft_tpu.compat "
+                            "or suppress with a reason",
+                        )
+
+
+# --- GL005 ----------------------------------------------------------------
+
+@register
+class JnpInHostData(Rule):
+    """``jax.numpy`` imported in host-side data-loader code.
+
+    Everything under ``pvraft_tpu/data/`` runs on the host (sampling,
+    augmentation, batch assembly in worker threads): ``jnp`` there
+    allocates on-device buffers per worker, serializes on the device
+    lock, and silently moves preprocessing onto the accelerator. Use
+    ``np``; the device boundary is ``loader.py``'s explicit
+    ``jax.device_put`` prefetch.
+    """
+
+    id = "GL005"
+    title = "jnp-in-host-data"
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        if "pvraft_tpu/data/" not in ctx.norm_path:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax.numpy":
+                        yield ctx.diag(
+                            node, self.id,
+                            "host-side data code must stay on np arrays; "
+                            "jnp here puts loader workers on the device "
+                            "(device transfer belongs in loader.py's "
+                            "prefetch)",
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax" and any(
+                    a.name == "numpy" for a in node.names
+                ) or node.module == "jax.numpy":
+                    yield ctx.diag(
+                        node, self.id,
+                        "host-side data code must stay on np arrays; "
+                        "jnp here puts loader workers on the device",
+                    )
+
+
+# --- GL006 ----------------------------------------------------------------
+
+@register
+class MutableDefaultArg(Rule):
+    """Mutable default argument.
+
+    A ``[]``/``{}``/``set()`` default is created once at def time and
+    shared across calls — in a codebase full of cached/jitted function
+    factories this turns into cross-call state that survives retraces.
+    Default to ``None`` and create inside.
+    """
+
+    id = "GL006"
+    title = "mutable-default-arg"
+
+    def _is_mutable(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+            return True
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("list", "dict", "set")
+            and not node.args
+            and not node.keywords
+        )
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, _FUNC_NODES + (ast.Lambda,)):
+                continue
+            for default in list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None
+            ]:
+                if self._is_mutable(default):
+                    name = getattr(fn, "name", "<lambda>")
+                    yield ctx.diag(
+                        default, self.id,
+                        f"mutable default argument in `{name}` is shared "
+                        "across calls; use None and create inside",
+                    )
+
+
+# --- GL007 ----------------------------------------------------------------
+
+@register
+class FStringDebugPrint(Rule):
+    """f-string passed to ``jax.debug.print``.
+
+    An f-string formats at TRACE time: the printed text shows
+    ``Traced<ShapedArray...>`` instead of runtime values (and bakes one
+    formatting into the program). ``jax.debug.print`` takes a format
+    string with ``{}`` placeholders filled at run time:
+    ``jax.debug.print("loss={l}", l=loss)``.
+    """
+
+    id = "GL007"
+    title = "fstring-debug-print"
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if not dotted.endswith("debug.print"):
+                continue
+            if node.args and isinstance(node.args[0], ast.JoinedStr):
+                yield ctx.diag(
+                    node, self.id,
+                    "f-string formats tracers at trace time; pass a "
+                    'format string: jax.debug.print("x={x}", x=x)',
+                )
+
+
+# --- GL008 ----------------------------------------------------------------
+
+@register
+class AssertOnTracer(Rule):
+    """``assert`` on a traced value inside a jit function.
+
+    The assert runs at trace time: on a tracer it either raises
+    ``TracerBoolConversionError`` or — under ``python -O`` — vanishes
+    entirely, so it can never check runtime values. Use
+    ``checkify.check`` or the ``@shapecheck`` contract layer for shape
+    invariants (``pvraft_tpu.analysis.contracts``).
+    """
+
+    id = "GL008"
+    title = "assert-on-tracer"
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        jitted = jit_context_functions(ctx.tree)
+        for fn in jitted:
+            tainted = _tainted_names(fn)
+            for node in _own_statements(fn):
+                if isinstance(node, ast.Assert):
+                    uses = _dynamic_taint_uses(node.test, tainted)
+                    if uses:
+                        yield ctx.diag(
+                            node, self.id,
+                            f"`assert` on traced value `{uses[0].id}` "
+                            "inside jit runs at trace time; use "
+                            "checkify.check or @shapecheck",
+                        )

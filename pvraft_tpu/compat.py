@@ -1,0 +1,109 @@
+"""Version-fragile jax imports, resolved in ONE place.
+
+jax moves things: ``shard_map`` lived in ``jax.experimental.shard_map``
+(<= 0.4.x), became ``jax.shard_map`` later and renamed its replication
+check from ``check_rep`` to ``check_vma`` along the way;
+``multihost_utils`` and ``pallas`` still live under ``jax.experimental``
+with no stability promise. Every such import in this package goes through
+this module so a jax upgrade is a one-file change — and so the package
+imports (and fails) identically on every pinned version instead of
+exploding lazily at first use on some code path.
+
+The lint rule ``GL004 fragile-jax-import`` (``pvraft_tpu.analysis``)
+enforces this: it flags ``jax.experimental`` imports and known moved
+symbols anywhere outside this file.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import jax
+
+
+def _resolve_shard_map():
+    """The shard_map callable of the running jax, wherever it lives."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map as fn  # jax <= 0.4.x
+
+    return fn
+
+
+_shard_map_impl = _resolve_shard_map()
+# The replication-check kwarg was renamed check_rep -> check_vma.
+_SHARD_MAP_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool | None = None,
+              **kwargs) -> Any:
+    """``jax.shard_map`` on any supported jax version.
+
+    Call with the MODERN spelling (``check_vma``); on older jax the flag is
+    translated to its ``check_rep`` predecessor. Extra kwargs pass through
+    untouched.
+    """
+    if check_vma is not None:
+        if "check_vma" in _SHARD_MAP_PARAMS:
+            kwargs["check_vma"] = check_vma
+        elif "check_rep" in _SHARD_MAP_PARAMS:
+            kwargs["check_rep"] = check_vma
+        # Neither spelling known: drop the flag rather than TypeError —
+        # it only relaxes an internal consistency check.
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis, inside shard_map/pmap bodies.
+
+    ``lax.axis_size`` only exists on newer jax; older versions spell it
+    with the constant-folding ``psum(1, axis)`` idiom.
+    """
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+# Resolved EAGERLY so a jax upgrade that moves the module fails here, at
+# import time, with one obvious file to fix — not hours into a multi-host
+# run at the first checkpoint barrier (where a one-process ImportError
+# strands every other process in the collective).
+try:
+    from jax.experimental import multihost_utils as _multihost
+except ImportError:  # pragma: no cover - exercised only on future jax
+    _multihost = None
+
+
+def _require_multihost():
+    if _multihost is None:
+        raise ImportError(
+            "jax.experimental.multihost_utils is gone on this jax version; "
+            "update pvraft_tpu/compat.py with its new home"
+        )
+    return _multihost
+
+
+def sync_global_devices(tag: str) -> None:
+    """``multihost_utils.sync_global_devices`` (cross-process barrier)."""
+    _require_multihost().sync_global_devices(tag)
+
+
+def process_allgather(x, *, tiled: bool = False):
+    """``multihost_utils.process_allgather`` (host-level allgather)."""
+    return _require_multihost().process_allgather(x, tiled=tiled)
+
+
+def import_pallas():
+    """The pallas module (``jax.experimental.pallas`` on current jax)."""
+    from jax.experimental import pallas  # no stable home yet
+
+    return pallas

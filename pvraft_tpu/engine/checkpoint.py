@@ -155,9 +155,9 @@ def _sync_hosts(tag: str) -> None:
     """Barrier so non-0 processes never observe mid-rename filesystem
     states (promotion/recovery is process-0-only)."""
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        from pvraft_tpu import compat
 
-        multihost_utils.sync_global_devices(tag)
+        compat.sync_global_devices(tag)
 
 
 def wait_for_saves() -> None:
@@ -381,9 +381,9 @@ def save_checkpoint(
             # next collective (a distributed hang, not a clean error).
             import time
 
-            from jax.experimental import multihost_utils
+            from pvraft_tpu import compat
 
-            multihost_utils.sync_global_devices(
+            compat.sync_global_devices(
                 f"pvraft-msgpack-written-{epoch}")
             seen = os.path.exists(paths[0])
             for _ in range(10):
@@ -391,7 +391,7 @@ def save_checkpoint(
                     break
                 time.sleep(0.5)
                 seen = os.path.exists(paths[0])
-            visible = multihost_utils.process_allgather(np.asarray([seen]))
+            visible = compat.process_allgather(np.asarray([seen]))
             if not bool(np.asarray(visible).all()):
                 raise RuntimeError(
                     f"msgpack checkpoint {paths[0]} written by process 0 "

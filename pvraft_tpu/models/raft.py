@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pvraft_tpu.analysis.contracts import shapecheck
 from pvraft_tpu.config import ModelConfig, compute_dtype
 from pvraft_tpu.models.corr_block import CorrLookup
 from pvraft_tpu.models.encoder import PointEncoder
@@ -79,6 +80,7 @@ class PVRaft(nn.Module):
             )
         from jax.sharding import PartitionSpec as P
 
+        from pvraft_tpu.compat import shard_map
         from pvraft_tpu.parallel.ring import ring_corr_init
 
         n1, n2 = fmap1.shape[1], fmap2.shape[1]
@@ -92,7 +94,7 @@ class PVRaft(nn.Module):
         # test.py:92 protocol — and must not be force-split).
         n_data = mesh.shape.get("data", 1)
         bspec = "data" if n_data > 1 and fmap1.shape[0] % n_data == 0 else None
-        ring = jax.shard_map(
+        ring = shard_map(
             lambda a, b, c: ring_corr_init(a, b, c, cfg.truncate_k, "seq"),
             mesh=mesh,
             in_specs=(P(bspec, "seq", None),) * 2 + (P(bspec, "seq", None),),
@@ -103,6 +105,7 @@ class PVRaft(nn.Module):
         )
         return ring(fmap1, fmap2, xyz2)
 
+    @shapecheck("B N 3", "B M 3", out=("T B N 3", None))
     @nn.compact
     def __call__(
         self, xyz1: jnp.ndarray, xyz2: jnp.ndarray, num_iters: int = 8
@@ -158,6 +161,7 @@ class PVRaftRefine(nn.Module):
     cfg: ModelConfig
     mesh: Optional[jax.sharding.Mesh] = None
 
+    @shapecheck("B N 3", "B M 3", out="B N 3")
     @nn.compact
     def __call__(
         self, xyz1: jnp.ndarray, xyz2: jnp.ndarray, num_iters: int = 32

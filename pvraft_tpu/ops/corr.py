@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pvraft_tpu.analysis.contracts import shapecheck
 from pvraft_tpu.ops.geometry import gather_neighbors
 
 
@@ -31,6 +32,7 @@ class CorrState(NamedTuple):
     xyz: jnp.ndarray    # (B, N1, K, 3) positions of the top-k pc2 points
 
 
+@shapecheck("B N D", "B M D", out="B N M", dtype="floating")
 def corr_volume(fmap1: jnp.ndarray, fmap2: jnp.ndarray) -> jnp.ndarray:
     """Scaled all-pairs feature correlation.
 
@@ -56,6 +58,7 @@ def merge_topk_xyz(best_v, best_x, part_v, part_x, truncate_k: int):
     return new_v, new_x
 
 
+@shapecheck("B N D", "B M D", "B M 3", out=("B N K", "B N K 3"))
 def corr_init(
     fmap1: jnp.ndarray,
     fmap2: jnp.ndarray,
@@ -126,6 +129,7 @@ def corr_init(
     return CorrState(corr=vals, xyz=xyz)
 
 
+@shapecheck(None, "B N K 3", out=("B N J", "B N J 3"))
 def knn_lookup(state: CorrState, rel: jnp.ndarray, k: int):
     """Point-branch lookup: pick the k truncated candidates nearest to the
     current coordinate estimate (``model/corr.py:75-89``).

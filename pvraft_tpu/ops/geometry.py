@@ -24,7 +24,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pvraft_tpu.analysis.contracts import shapecheck
 
+
+@shapecheck("B N 3", "B M 3", out="B N M")
 def pairwise_sqdist(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Squared euclidean distances between two clouds.
 
@@ -40,6 +43,7 @@ def pairwise_sqdist(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return a2 + jnp.swapaxes(b2, -1, -2) - 2.0 * cross
 
 
+@shapecheck("B N 3", "B M 3", out="B N K")
 def knn_indices(
     query: jnp.ndarray,
     points: jnp.ndarray,
@@ -113,6 +117,7 @@ def knn_indices(
     return idx
 
 
+@shapecheck("B M C", "B N K", out="B N K C")
 def gather_neighbors(feats: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Gather per-neighbor features.
 
@@ -137,6 +142,7 @@ class Graph(NamedTuple):
         return self.neighbors.shape[-1]
 
 
+@shapecheck("B N 3", out=("B N K", "B N K 3"))
 def build_graph(pc: jnp.ndarray, k: int, chunk: Optional[int] = None,
                 approx: bool = False) -> Graph:
     """Construct the kNN graph of a cloud with itself.

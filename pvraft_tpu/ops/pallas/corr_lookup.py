@@ -33,9 +33,12 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
 
+from pvraft_tpu.analysis.contracts import shapecheck
+from pvraft_tpu.compat import import_pallas
 from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
 
 from pvraft_tpu.ops.pallas.voxel_corr import (
     _pick_tile,
@@ -127,6 +130,7 @@ def _fused_forward(
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@shapecheck("B N K", "B N K 3", "B N 3", out=("B N C", "B N J", "B N J 3"))
 def fused_corr_lookup(
     corr: jnp.ndarray,
     xyz: jnp.ndarray,

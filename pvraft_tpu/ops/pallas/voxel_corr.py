@@ -29,9 +29,12 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
+from pvraft_tpu.analysis.contracts import shapecheck
+from pvraft_tpu.compat import import_pallas
 from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
 
 
 def _pick_tile(n: int, target: int = 64) -> int:
@@ -129,6 +132,7 @@ def _voxel_forward_pallas(
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+@shapecheck("B N K", "B N K 3", out="B N C", dtype="floating")
 def voxel_bin_means_pallas(
     corr: jnp.ndarray,
     rel: jnp.ndarray,

@@ -31,7 +31,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pvraft_tpu.analysis.contracts import shapecheck
 
+
+@shapecheck("B N K", "B N K 3", out="B N C", dtype="floating")
 def voxel_bin_means(
     corr: jnp.ndarray,
     rel: jnp.ndarray,

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pvraft_tpu.compat import axis_size
 from pvraft_tpu.ops.corr import CorrState, merge_topk_xyz
 
 
@@ -38,7 +39,7 @@ def ring_knn_indices(
     Returns (B, Nq/P, k) int32 indices into the GLOBAL db ordering,
     nearest first (self included when query is db — ``graph.py:60``).
     """
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, nq, _ = query.shape
     chunk = db.shape[1]
@@ -77,6 +78,7 @@ def seq_sharded_graph(pc: jnp.ndarray, k: int, mesh) -> "Graph":
     Returns the same global ``Graph`` as ``ops.geometry.build_graph``."""
     from jax.sharding import PartitionSpec as P
 
+    from pvraft_tpu.compat import shard_map
     from pvraft_tpu.ops.geometry import Graph, gather_neighbors
 
     seq = mesh.shape["seq"]
@@ -88,7 +90,7 @@ def seq_sharded_graph(pc: jnp.ndarray, k: int, mesh) -> "Graph":
         )
     n_data = mesh.shape.get("data", 1)
     bspec = "data" if n_data > 1 and pc.shape[0] % n_data == 0 else None
-    idx = jax.shard_map(
+    idx = shard_map(
         lambda q, d: ring_knn_indices(q, d, k, "seq"),
         mesh=mesh,
         in_specs=(P(bspec, "seq", None), P(bspec, "seq", None)),
@@ -114,7 +116,7 @@ def ring_corr_init(
     global over all N2 — bitwise-comparable to the single-device
     ``corr_init`` up to top-k tie order.
     """
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     b, n1, d = fmap1.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     perm = [(i, (i + 1) % p) for i in range(p)]

@@ -7,6 +7,11 @@ ROOT=${ROOT:-/data/ft3d_preprocessed}
 KITTI_ROOT=${KITTI_ROOT:-/data/kitti_preprocessed}
 EXP=${EXP:-experiments/pvraft}
 
+# Static-analysis gate: AST lint + eval_shape trace-compat audit. A rule
+# violation or an op that no longer traces aborts BEFORE any TPU time is
+# spent (see README "Static analysis & contracts").
+bash scripts/lint.sh
+
 # Stage-1 training: FT3D, 8192 pts, 8 GRU iters, bs=2.
 python train.py --root "$ROOT" --exp_path "$EXP" --dataset FT3D \
   --max_points 8192 --iters 8 --truncate_k 512 --corr_levels 3 \

@@ -65,6 +65,9 @@ def _pin_cpu():
 
 
 def _topology_devices():
+    # Deviceless AOT topology descriptors have no stable home; this script
+    # is the only consumer, so no compat shim.
+    # graftlint: disable-next=GL004 -- experimental import, see above
     from jax.experimental import topologies
 
     topo = topologies.get_topology_desc(TOPOLOGY, "tpu")

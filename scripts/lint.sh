@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Static-analysis gate: graftlint AST rules + eval_shape trace-compat audit.
+# Runs before training jobs (run.sh) and as the standing gate for
+# kernel/sharding PRs (ROADMAP.md). Exits non-zero on any finding.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== graftlint: AST rules over pvraft_tpu/ + tests/"
+python -m pvraft_tpu.analysis lint pvraft_tpu/ tests/
+
+echo "== graftlint: eval_shape trace-compat audit (zero-FLOP abstract traces)"
+# CPU pin: shape propagation needs no accelerator and must not grab one.
+JAX_PLATFORMS=cpu python -m pvraft_tpu.analysis trace

@@ -1,0 +1,259 @@
+"""graftlint engine + rules: every rule has a red/green fixture, the
+suppression syntax works, and the shipped package lints clean."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pvraft_tpu.analysis.engine import all_rules, lint_paths, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ids(src, path="x.py"):
+    return [d.rule_id for d in lint_source(src, path=path)]
+
+
+# --- one red fixture per rule (must trigger EXACTLY that rule) ------------
+
+RED = {
+    "GL001": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+    ),
+    "GL002": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    ),
+    "GL003": (
+        "import jax.numpy as jnp\n"
+        "OFFSETS = jnp.arange(27)\n"
+    ),
+    "GL004": "from jax import shard_map\n",
+    "GL005": "import jax.numpy as jnp\n",  # linted under pvraft_tpu/data/
+    "GL006": (
+        "def f(x, cache={}):\n"
+        "    return cache\n"
+    ),
+    "GL007": (
+        "import jax\n"
+        "def f(x):\n"
+        "    jax.debug.print(f\"x={x}\")\n"
+    ),
+    "GL008": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    assert x > 0\n"
+        "    return x\n"
+    ),
+}
+
+# The same code, corrected (not suppressed): the rule must NOT fire.
+GREEN = {
+    "GL001": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        "def host(y):\n"
+        "    return y.item()\n"  # outside jit: fine
+    ),
+    "GL002": (
+        "import jax\n"
+        "from jax import lax\n"
+        "@jax.jit\n"
+        "def f(x, flag=None):\n"
+        "    if flag is None:\n"          # static: is None
+        "        return x\n"
+        "    if x.shape[0] > 2:\n"        # static: shape metadata
+        "        return x + 1\n"
+        "    return lax.cond(True, lambda: x, lambda: -x)\n"
+    ),
+    "GL003": (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "OFFSETS = np.arange(27)\n"       # np at module scope: fine
+        "def f():\n"
+        "    return jnp.arange(27)\n"     # jnp inside a function: fine
+    ),
+    "GL004": "from pvraft_tpu.compat import shard_map\n",
+    "GL005": "import numpy as np\n",
+    "GL006": (
+        "def f(x, cache=None):\n"
+        "    cache = {} if cache is None else cache\n"
+        "    return cache\n"
+    ),
+    "GL007": (
+        "import jax\n"
+        "def f(x):\n"
+        "    jax.debug.print(\"x={x}\", x=x)\n"
+    ),
+    "GL008": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    assert x.shape[0] > 0\n"     # static shape assert: fine
+        "    return x\n"
+    ),
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(RED))
+def test_rule_fires_exactly_once(rule_id):
+    path = "pvraft_tpu/data/x.py" if rule_id == "GL005" else "x.py"
+    assert ids(RED[rule_id], path=path) == [rule_id]
+
+
+@pytest.mark.parametrize("rule_id", sorted(GREEN))
+def test_rule_green_fixture_clean(rule_id):
+    path = "pvraft_tpu/data/x.py" if rule_id == "GL005" else "x.py"
+    assert ids(GREEN[rule_id], path=path) == []
+
+
+# --- suppressions ---------------------------------------------------------
+
+def test_line_suppression_with_reason():
+    src = "from jax import shard_map  # graftlint: disable=GL004 -- pinned\n"
+    assert ids(src) == []
+
+
+def test_line_suppression_multiple_ids():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    assert x > 0  # graftlint: disable=GL008,GL001\n"
+        "    return x\n"
+    )
+    assert ids(src) == []
+
+
+def test_line_suppression_wrong_id_does_not_silence():
+    src = "from jax import shard_map  # graftlint: disable=GL001\n"
+    assert ids(src) == ["GL004"]
+
+
+def test_disable_next_line_suppression():
+    src = (
+        "# graftlint: disable-next=GL004 -- no stable home for topologies\n"
+        "from jax.experimental import topologies\n"
+        "from jax.experimental import pallas\n"  # next line only
+    )
+    assert ids(src) == ["GL004"]
+
+
+def test_file_suppression():
+    src = (
+        "# graftlint: disable-file=GL004 -- version pin escape hatch\n"
+        "from jax import shard_map\n"
+        "from jax.experimental import pallas\n"
+    )
+    assert ids(src) == []
+
+
+def test_suppression_is_per_line():
+    src = (
+        "from jax import shard_map  # graftlint: disable=GL004\n"
+        "from jax.experimental import pallas\n"  # not suppressed
+    )
+    assert ids(src) == ["GL004"]
+
+
+def test_suppression_in_docstring_is_inert():
+    """Documenting the suppression syntax must not disable rules: only
+    real comment tokens count (the engine's own docstring shows
+    `# graftlint: disable-file=...` as an example)."""
+    src = (
+        '"""Docs.\n'
+        "\n"
+        "    # graftlint: disable-file=GL004 -- just an example\n"
+        '"""\n'
+        "from jax import shard_map\n"
+    )
+    assert ids(src) == ["GL004"]
+
+
+def test_path_scoping_is_invocation_independent(tmp_path):
+    """GL004's compat exemption and GL005's data/ scoping key off the
+    resolved path, not the spelling the linter was invoked with."""
+    pkg = tmp_path / "pvraft_tpu"
+    (pkg / "data").mkdir(parents=True)
+    fragile = "from jax.experimental import pallas\n"
+    (pkg / "compat.py").write_text(fragile)
+    (tmp_path / "compat.py").write_text(fragile)  # NOT the shim
+    (pkg / "data" / "aug.py").write_text("import jax.numpy as jnp\n")
+
+    diags, _ = lint_paths([str(pkg / "compat.py")])
+    assert diags == []  # the real shim is exempt
+    diags, _ = lint_paths([str(tmp_path / "compat.py")])
+    assert [d.rule_id for d in diags] == ["GL004"]
+    diags, _ = lint_paths([str(pkg / "data" / "aug.py")])
+    assert [d.rule_id for d in diags] == ["GL005"]
+
+
+# --- registry / engine ----------------------------------------------------
+
+def test_rule_table_complete():
+    rules = all_rules()
+    assert [r.id for r in rules] == sorted(RED)  # GL001..GL008, unique
+    for r in rules:
+        assert r.title
+        assert r.__doc__ and r.__doc__.strip(), f"{r.id} needs a docstring"
+
+
+def test_syntax_error_reported_not_raised():
+    out = lint_source("def f(:\n", path="bad.py")
+    assert [d.rule_id for d in out] == ["GL000"]
+
+
+def test_compat_module_exempt_from_gl004():
+    src = "from jax.experimental.shard_map import shard_map\n"
+    assert ids(src, path="pvraft_tpu/compat.py") == []
+    assert ids(src, path="pvraft_tpu/other.py") == ["GL004"]
+
+
+# --- the gate: the shipped package lints clean ----------------------------
+
+def test_shipped_package_lints_clean():
+    diags, nfiles = lint_paths(
+        [os.path.join(REPO, "pvraft_tpu"), os.path.join(REPO, "tests")]
+    )
+    assert nfiles > 50
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
+def test_cli_lint_exits_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "pvraft_tpu.analysis", "lint",
+         "pvraft_tpu/", "tests/"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_lint_exits_one_on_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import shard_map\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pvraft_tpu.analysis", "lint", str(bad)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1
+    assert "GL004" in proc.stdout

@@ -1,0 +1,203 @@
+"""@shapecheck contract layer: correct shapes pass, mismatches raise
+readable errors, and with PVRAFT_CHECKS unset the decorator is a provable
+no-op (same function object, same jaxpr)."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pvraft_tpu.analysis.contracts import (
+    ContractSpec,
+    ShapeError,
+    checks_enabled,
+    shapecheck,
+    wrap_with_spec,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- the zero-cost guarantee (PVRAFT_CHECKS unset in tier-1) --------------
+
+def test_disabled_decorator_returns_function_unchanged():
+    assert not checks_enabled()  # tier-1 runs without PVRAFT_CHECKS
+
+    def f(x):
+        return x * 2
+
+    g = shapecheck("B N")(f)
+    assert g is f                      # not a wrapper: byte-identical
+    assert hasattr(g, "__shapecheck__")  # but the contract is recorded
+
+
+def test_disabled_package_ops_are_unwrapped():
+    from pvraft_tpu.ops.corr import corr_init, corr_volume
+    from pvraft_tpu.ops.geometry import build_graph, knn_indices
+    from pvraft_tpu.ops.voxel import voxel_bin_means
+
+    for op in (corr_volume, corr_init, knn_indices, build_graph,
+               voxel_bin_means):
+        assert hasattr(op, "__shapecheck__"), op
+        assert not hasattr(op, "__shapecheck_inner__"), (
+            f"{op.__name__} is wrapped although PVRAFT_CHECKS is unset"
+        )
+
+
+def test_disabled_jaxpr_identical():
+    from pvraft_tpu.ops.corr import corr_volume
+
+    f1 = jnp.zeros((2, 8, 4))
+    f2 = jnp.zeros((2, 6, 4))
+    # The decorated op IS the undecorated function when checks are off,
+    # so the jaxprs are trivially byte-identical — and wrapping the same
+    # function by hand must not change the jaxpr either (checks only read
+    # static metadata).
+    wrapped = wrap_with_spec(corr_volume, corr_volume.__shapecheck__)
+    assert str(jax.make_jaxpr(wrapped)(f1, f2)) == str(
+        jax.make_jaxpr(corr_volume)(f1, f2)
+    )
+
+
+# --- enabled-mode semantics (wrap_with_spec: no env needed) ---------------
+
+def _wrapped(fn, *specs, **kw):
+    return wrap_with_spec(fn, ContractSpec(specs, kw.get("out"),
+                                           kw.get("dtype")))
+
+
+def test_pass_on_correct_shapes():
+    g = _wrapped(lambda a, b: a @ b.T, "N D", "M D", out="N M")
+    out = g(jnp.zeros((4, 3)), jnp.zeros((5, 3)))
+    assert out.shape == (4, 5)
+
+
+def test_rank_mismatch_message():
+    g = _wrapped(lambda a: a, "B N 3")
+    with pytest.raises(ShapeError, match=r"expected rank 3 \[B N 3\]"):
+        g(jnp.zeros((4, 3)))
+
+
+def test_literal_dim_mismatch_message():
+    g = _wrapped(lambda a: a, "B N 3")
+    with pytest.raises(ShapeError, match=r"axis 2 must be 3"):
+        g(jnp.zeros((2, 4, 4)))
+
+
+def test_binding_conflict_across_args():
+    g = _wrapped(lambda a, b: (a, b), "B N 3", "B M 3")
+    with pytest.raises(ShapeError, match=r"B=7.*conflicts with B=2"):
+        g(jnp.zeros((2, 4, 3)), jnp.zeros((7, 5, 3)))
+
+
+def test_output_contract_checked():
+    g = _wrapped(lambda a: a[:, :2], "B N", out="B N")
+    with pytest.raises(ShapeError, match="return value"):
+        g(jnp.zeros((2, 5)))
+
+
+def test_output_tuple_spec_with_none_skips():
+    g = _wrapped(lambda a: (a, "aux"), "B N", out=("B N", None))
+    out, aux = g(jnp.zeros((2, 5)))
+    assert aux == "aux"
+
+
+def test_keyword_passed_argument_is_checked():
+    """A contracted arg passed by keyword must be checked exactly like a
+    positional one (an unchecked kwarg is false confidence)."""
+    g = _wrapped(lambda a, b: b, "N D", "M D")
+    g(jnp.zeros((4, 3)), b=jnp.zeros((5, 3)))
+    with pytest.raises(ShapeError, match=r"argument 1 expected rank 2"):
+        g(jnp.zeros((4, 3)), b=jnp.zeros((9,)))
+    with pytest.raises(ShapeError, match=r"argument 1 expected rank 2"):
+        g(b=jnp.zeros((9,)), a=jnp.zeros((4, 3)))
+
+
+def test_none_spec_skips_argument():
+    g = _wrapped(lambda state, rel: rel, None, "B N 3")
+    assert g({"any": "thing"}, jnp.zeros((1, 2, 3))).shape == (1, 2, 3)
+
+
+def test_wildcard_dim():
+    g = _wrapped(lambda a: a, "B _ 3")
+    g(jnp.zeros((2, 99, 3)))  # any middle dim passes
+
+
+def test_dtype_kind_check():
+    g = _wrapped(lambda a: a, "B N", dtype="floating")
+    g(jnp.zeros((2, 3), jnp.float32))
+    with pytest.raises(ShapeError, match="expected dtype floating"):
+        g(jnp.zeros((2, 3), jnp.int32))
+
+
+def test_non_array_argument_rejected():
+    g = _wrapped(lambda a: a, "B N")
+    with pytest.raises(ShapeError, match="no .shape"):
+        g([1, 2, 3])
+
+
+def test_works_under_jit_and_eval_shape():
+    g = _wrapped(lambda a, b: a @ b.T, "N D", "M D", out="N M")
+    out = jax.jit(g)(jnp.ones((4, 3)), jnp.ones((5, 3)))
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    s = jax.eval_shape(g, jax.ShapeDtypeStruct((4, 3), "float32"),
+                       jax.ShapeDtypeStruct((5, 3), "float32"))
+    assert s.shape == (4, 5)
+
+
+def test_enabled_jaxpr_identical_to_inner():
+    # Even when checks run, they read only static metadata: the traced
+    # computation is unchanged.
+    def f(a, b):
+        return a @ b.T
+
+    g = _wrapped(f, "N D", "M D", out="N M")
+    x, y = jnp.zeros((4, 3)), jnp.zeros((5, 3))
+    assert str(jax.make_jaxpr(g)(x, y)) == str(jax.make_jaxpr(f)(x, y))
+
+
+# --- decorator path with the env var actually set (subprocess) ------------
+
+def test_env_enabled_package_op_enforces_contract():
+    """PVRAFT_CHECKS=1 at import time wraps the shipped ops: good shapes
+    pass, a K/3 axis swap raises ShapeError."""
+    code = (
+        "import jax.numpy as jnp\n"
+        "from pvraft_tpu.ops.corr import corr_volume\n"
+        "from pvraft_tpu.analysis.contracts import ShapeError\n"
+        "assert hasattr(corr_volume, '__shapecheck_inner__')\n"
+        "out = corr_volume(jnp.zeros((2, 8, 4)), jnp.zeros((2, 6, 4)))\n"
+        "assert out.shape == (2, 8, 6)\n"
+        "try:\n"
+        "    corr_volume(jnp.zeros((2, 8, 4)), jnp.zeros((2, 6, 5)))\n"
+        "except ShapeError as e:\n"
+        "    assert 'conflicts with D=4' in str(e), e\n"
+        "else:\n"
+        "    raise SystemExit('no ShapeError on D mismatch')\n"
+        "print('OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PVRAFT_CHECKS": "1"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+# --- the eval_shape trace-compat audit ------------------------------------
+
+def test_trace_audit_all_clean():
+    from pvraft_tpu.analysis.audit import run_audit
+
+    results = run_audit()
+    assert len(results) >= 14
+    bad = [r for r in results if not r.ok]
+    assert not bad, "\n".join(f"{r.name}: {r.detail}" for r in bad)

@@ -7,8 +7,8 @@ import pytest
 
 pytestmark = pytest.mark.slow  # jitted train steps over the 8-device mesh
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
+from pvraft_tpu.compat import shard_map
 from pvraft_tpu.ops.corr import CorrState, corr_init
 from pvraft_tpu.parallel.mesh import make_mesh, replicate, shard_batch
 from pvraft_tpu.parallel.ring import ring_corr_init
