@@ -97,6 +97,22 @@ def _child_variant(name: str) -> None:
     _maybe_pin_cpu()
     kwargs = dict(VARIANTS)[name]
 
+    # Backward-path A/B levers (PR "scatter-free VJPs + remat policy"):
+    # opt-in env flags so the same variant ladder can be re-measured with
+    # the optimized backward and the pair recorded side by side
+    # (BENCHMARKS.md "Backward-path A/B").
+    ab_flags = {}
+    if os.environ.get("PVRAFT_BENCH_SCATTER_FREE", "") == "1":
+        kwargs = dict(kwargs, scatter_free_vjp=True)
+        ab_flags["scatter_free_vjp"] = True
+    remat_policy = os.environ.get("PVRAFT_BENCH_REMAT_POLICY", "")
+    if remat_policy:
+        kwargs = dict(kwargs, remat_policy=remat_policy)
+        ab_flags["remat_policy"] = remat_policy
+    grad_dtype = os.environ.get("PVRAFT_BENCH_GRAD_DTYPE", "") or None
+    if grad_dtype:
+        ab_flags["grad_dtype"] = grad_dtype
+
     import numpy as np
 
     import jax
@@ -136,6 +152,8 @@ def _child_variant(name: str) -> None:
 
     import functools
 
+    from pvraft_tpu.engine.steps import maybe_cast_grads
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, pc1, pc2, mask, gt):
         def loss_fn(p):
@@ -143,6 +161,7 @@ def _child_variant(name: str) -> None:
             return sequence_loss(flows, mask, gt, 0.8)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = maybe_cast_grads(grads, grad_dtype)
         updates, opt_state = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
@@ -169,7 +188,12 @@ def _child_variant(name: str) -> None:
     # CPU fallback steps are minutes each at 8,192 points — keep it short.
     n_steps = 10 if platform != "cpu" else 2
     strategy = "pytree"
-    fuse_k = int(os.environ.get("PVRAFT_BENCH_FUSE", 32))
+    # Default K=8: the configuration PROVEN to execute on chip at the
+    # flagship shape; K=32 is the exact config multistep_probe.jsonl
+    # records as crashing the TPU worker there (a device fault in this
+    # optional probe can leave the child's client unusable, degrading the
+    # valid measurement already in hand). 32 remains an explicit override.
+    fuse_k = int(os.environ.get("PVRAFT_BENCH_FUSE", 8))
     dt = time_pytree(2 if platform != "cpu" else n_steps)
     if platform == "cpu":
         # Repeat the measurement so the artifact records run-to-run spread
@@ -188,7 +212,8 @@ def _child_variant(name: str) -> None:
 
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
         pstep, flat, _ = make_packed_train_step(
-            model, tx, 0.8, ITERS, params, opt_state, donate=True
+            model, tx, 0.8, ITERS, params, opt_state, donate=True,
+            grad_dtype=grad_dtype,
         )
         flat, m = pstep(flat, batch)  # warmup/compile
         jax.block_until_ready(m["loss"])
@@ -240,7 +265,7 @@ def _child_variant(name: str) -> None:
 
                 mstep, _, _ = make_multistep_train_step(
                     model, tx, 0.8, ITERS, params, opt_state, fuse_k,
-                    donate=True,
+                    donate=True, grad_dtype=grad_dtype,
                 )
                 stacked = [
                     {"pc1": jnp.asarray(rng.uniform(-1, 1, pc1.shape)
@@ -301,6 +326,7 @@ def _child_variant(name: str) -> None:
                       "dt_reps": [round(d, 6) for d in dt_reps],
                       "dt_spread": round(spread, 4),
                       "timing_reps": len(dt_reps),
+                      **({"ab_flags": ab_flags} if ab_flags else {}),
                       # Per-rep optimizer-step counts, so a mixed-step-count
                       # rep list can never masquerade as run-to-run spread.
                       # Both reps of the chosen strategy run the same count:
@@ -580,6 +606,10 @@ def main() -> None:
              "unit": _unit(points, iters, batch)}  # overrides the default
     if res.get("strategy") and res["strategy"] != "pytree":
         extra["step_strategy"] = res["strategy"]
+    if res.get("ab_flags"):
+        # Backward-path A/B levers active in this run — the headline must
+        # carry them so an optimized run can never pass as the baseline.
+        extra["ab_flags"] = res["ab_flags"]
     # Repeat spread: lets a future reader classify round-over-round drift
     # as measurement noise vs regression (round-3 verdict weak #1).
     for k in ("dt_reps", "dt_spread", "timing_reps", "steps_per_rep"):

@@ -140,6 +140,48 @@ def _e_knn_lookup():
     )
 
 
+# --- ops/scatter_free (the custom VJPs must TRACE through grad) -----------
+
+@audit_entry("scatter_free.gather_neighbors_onehot[grad]")
+def _e_sf_gather():
+    import jax
+
+    from pvraft_tpu.ops.scatter_free import gather_neighbors_onehot
+
+    def fn(f, i):
+        return jax.grad(lambda f_: gather_neighbors_onehot(f_, i).sum())(f)
+
+    return fn, (_f32(B, M, D), _i32(B, N, K))
+
+
+@audit_entry("scatter_free.take_pair_onehot[grad]")
+def _e_sf_take_pair():
+    import jax
+
+    from pvraft_tpu.ops.scatter_free import take_pair_onehot
+
+    def fn(c, r, nbr):
+        def loss(c_, r_):
+            kc, rx = take_pair_onehot(c_, r_, nbr)
+            return kc.sum() + rx.sum()
+
+        return jax.grad(loss, argnums=(0, 1))(c, r)
+
+    return fn, (_f32(B, N, K), _f32(B, N, K, 3), _i32(B, N, K // 2))
+
+
+@audit_entry("scatter_free.max_pool_argmax[grad]")
+def _e_sf_max_pool():
+    import jax
+
+    from pvraft_tpu.ops.scatter_free import max_pool_argmax
+
+    def fn(h):
+        return jax.grad(lambda h_: max_pool_argmax(h_).sum())(h)
+
+    return fn, (_f32(B, N, K, D),)
+
+
 # --- ops/voxel + Pallas kernels ------------------------------------------
 
 @audit_entry("voxel.voxel_bin_means")
@@ -201,13 +243,14 @@ def _e_ring():
 
 # --- models (full forward passes, abstract params included) ---------------
 
-def _model_entry(refine: bool):
+def _model_entry(refine: bool, **cfg_kwargs):
     import jax
 
     from pvraft_tpu.config import ModelConfig
     from pvraft_tpu.models.raft import PVRaft, PVRaftRefine
 
-    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2)
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2,
+                      **cfg_kwargs)
     model = (PVRaftRefine if refine else PVRaft)(cfg)
 
     # pc2 gets M points and num_iters (T) differs from B: an axis mixup
@@ -230,6 +273,14 @@ def _e_refine():
     return _model_entry(refine=True)
 
 
+@audit_entry("models.PVRaft[scatter_free+save_corr]")
+def _e_pvraft_opt():
+    # The optimized backward path end to end: scatter-free VJPs +
+    # checkpoint_name-tagged corr under the save_corr remat policy.
+    return _model_entry(refine=False, scatter_free_vjp=True,
+                        remat_policy="save_corr")
+
+
 # --- engine (the jitted train step, end to end) ---------------------------
 
 @audit_entry("engine.train_step")
@@ -249,6 +300,32 @@ def _e_train_step():
         params = model.init(jax.random.key(0), pc1, pc2, 3)
         opt_state = tx.init(params)
         step = make_train_step(model, tx, 0.8, 3)
+        batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
+        return step(params, opt_state, batch)
+
+    return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
+
+
+@audit_entry("engine.train_step[optimized_backward]")
+def _e_train_step_opt():
+    # Full optimized train step: scatter-free VJPs, dots remat policy,
+    # bf16 gradient cast — the bench A/B configuration, traced end to end.
+    import jax
+    import optax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.steps import make_train_step
+    from pvraft_tpu.models.raft import PVRaft
+
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2,
+                      scatter_free_vjp=True, remat_policy="dots")
+    model = PVRaft(cfg)
+    tx = optax.sgd(1e-2)
+
+    def fn(pc1, pc2, mask, gt):
+        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        opt_state = tx.init(params)
+        step = make_train_step(model, tx, 0.8, 3, grad_dtype="bfloat16")
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
         return step(params, opt_state, batch)
 

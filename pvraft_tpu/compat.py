@@ -107,3 +107,25 @@ def import_pallas():
     from jax.experimental import pallas  # no stable home yet
 
     return pallas
+
+
+def checkpoint_policies():
+    """``jax.checkpoint_policies`` — the rematerialization policy
+    namespace. Routed here because the remat utilities have moved homes
+    before (``jax.remat`` -> ``jax.checkpoint``, ``checkpoint_name`` out
+    of ``jax.experimental``)."""
+    ns = getattr(jax, "checkpoint_policies", None)
+    if ns is None:  # pragma: no cover - exercised only on future jax
+        raise ImportError(
+            "jax.checkpoint_policies is gone on this jax version; update "
+            "pvraft_tpu/compat.py with its new home"
+        )
+    return ns
+
+
+def checkpoint_name(x, name: str):
+    """``jax.ad_checkpoint.checkpoint_name``: tag a value so a
+    ``save_only_these_names`` remat policy can save exactly it."""
+    from jax.ad_checkpoint import checkpoint_name as fn
+
+    return fn(x, name)

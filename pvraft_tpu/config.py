@@ -15,6 +15,11 @@ import dataclasses
 from typing import Optional, Tuple
 
 
+# Valid ModelConfig.remat_policy values (mapped to jax.checkpoint policies
+# in models/raft.py; "none" defers to the legacy `remat` bool).
+REMAT_POLICIES = ("none", "full", "dots", "dots_no_batch", "save_corr")
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """Architecture hyperparameters of the PV-RAFT flagship model."""
@@ -42,6 +47,26 @@ class ModelConfig:
     use_pallas: Optional[bool] = None
     corr_chunk: Optional[int] = None  # chunked/streaming top-k over N2 if set
     remat: bool = False              # rematerialize each GRU iteration
+    # Checkpointing policy for the rematerialized GRU iteration
+    # (models/raft.py). "none" honors the legacy blanket `remat` bool;
+    # any other value turns remat ON with that jax.checkpoint policy:
+    #   "full"          save nothing — recompute everything (legacy remat)
+    #   "dots"          save matmul/contraction results (checkpoint_dots)
+    #   "dots_no_batch" save only non-batch-dim contractions
+    #   "save_corr"     save the per-iteration corr-lookup output (tagged
+    #                   via checkpoint_name) and recompute the rest — the
+    #                   gather-heavy lookup never reruns in the backward.
+    remat_policy: str = "none"
+    # Scatter-free custom VJPs for the gather-dominated backward: neighbor
+    # gathers (ops/geometry.gather_neighbors), the knn_lookup candidate
+    # selection (ops/corr), and the SetConv k-pool max all swap XLA's
+    # default gather-grad -> scatter-add for one-hot-matmul / argmax
+    # formulations (ops/scatter_free.py) that run on the MXU instead of
+    # serializing. Forward numerics identical; grad parity test-gated
+    # (tests/test_scatter_free.py); jaxprs unchanged when False. Only the
+    # XLA lookup path is affected (the fused Pallas kernel has its own
+    # VJP).
+    scatter_free_vjp: bool = False
     # lax.approx_max_k for the correlation truncation: much faster on TPU
     # (recall ~0.95 by default); exact sort-based top-k when False.
     approx_topk: bool = False
@@ -66,6 +91,11 @@ class ModelConfig:
     seq_shard: bool = False
 
     def __post_init__(self):
+        if self.remat_policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"remat_policy must be one of {REMAT_POLICIES}, "
+                f"got {self.remat_policy!r}"
+            )
         if self.corr_knn > self.truncate_k:
             raise ValueError(
                 f"corr_knn ({self.corr_knn}) must be <= truncate_k "
@@ -172,6 +202,12 @@ class TrainConfig:
     # When set, epoch 0 runs under jax.profiler.trace writing a
     # TensorBoard-viewable profile here (SURVEY.md §5 tracing).
     profile_dir: str = ""
+    # Gradient dtype lever (engine/steps.py): "bfloat16" casts the grads
+    # once right after value_and_grad — the dtype the cross-device
+    # all-reduce and any downstream grad traffic run in — then restores
+    # float32 before Adam (optimizer state stays float32). "float32"
+    # (default) leaves the step byte-identical to the pre-existing one.
+    grad_dtype: str = "float32"
 
     def __post_init__(self):
         # Fail before training, not at the end-of-epoch save.
@@ -179,6 +215,11 @@ class TrainConfig:
             raise ValueError(
                 f"ckpt_backend must be 'msgpack' or 'orbax', "
                 f"got {self.ckpt_backend!r}"
+            )
+        if self.grad_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"grad_dtype must be 'float32' or 'bfloat16', "
+                f"got {self.grad_dtype!r}"
             )
 
 
@@ -244,6 +285,16 @@ class Config:
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
+
+
+def resolve_remat_policy(cfg: ModelConfig) -> Optional[str]:
+    """The effective remat policy name, or None for no remat.
+
+    ``remat_policy`` wins when set; the legacy ``remat`` bool maps to the
+    blanket "full" policy it always meant."""
+    if cfg.remat_policy != "none":
+        return cfg.remat_policy
+    return "full" if cfg.remat else None
 
 
 def resolve_use_pallas(cfg: ModelConfig) -> bool:

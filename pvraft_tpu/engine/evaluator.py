@@ -85,6 +85,17 @@ class Evaluator:
         # group, which also caps memory). The per-batch step stays built
         # for the tail group and for --dump_dir runs.
         self.eval_scan = max(1, cfg.train.eval_scan)
+        if self.eval_scan > 1 and jax.process_count() > 1:
+            # flush_scanned stacks device batches with an EAGER jnp.stack;
+            # on multi-process runs the scene-sharded loader yields
+            # non-fully-addressable global arrays, and eager ops on those
+            # raise. The per-batch path is protocol-identical (fusion only
+            # amortizes dispatch overhead), so fall back rather than fail.
+            self.log.info(
+                "eval_scan > 1 is single-process only (eager stack of "
+                "sharded device batches); falling back to per-batch eval"
+            )
+            self.eval_scan = 1
         if self.eval_scan > 1:
             step = self.eval_step
 
@@ -210,8 +221,9 @@ class Evaluator:
                 continue
             # A smaller (tail) batch: flush any scanned group first so the
             # running means stay in scene order, then fall through to the
-            # per-batch step.
-            count += flush_scanned()
+            # per-batch step. Through log_progress so a log_every crossing
+            # inside the flushed group is not silently skipped.
+            log_progress(flush_scanned())
             metrics, flow = self.eval_step(self.params, b)
             bsize = batch["pc1"].shape[0] * self.shard[1]
             accumulate(metrics, bsize)
@@ -226,7 +238,7 @@ class Evaluator:
                     np.save(os.path.join(scene, "pc2.npy"), batch["pc2"][row])
                     np.save(os.path.join(scene, "flow.npy"), flow_host[row])
             log_progress(bsize)
-        count += flush_scanned()  # partial final group
+        log_progress(flush_scanned())  # partial final group
         means = {
             k: float(v) / max(1, count) for k, v in (dev_sums or {}).items()
         }

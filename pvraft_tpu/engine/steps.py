@@ -11,7 +11,7 @@ all-reduce over ICI — the role ``nn.DataParallel`` plays in the reference
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -21,12 +21,31 @@ from pvraft_tpu.engine.loss import compute_loss, sequence_loss
 from pvraft_tpu.engine.metrics import epe_train, flow_metrics
 
 
+def maybe_cast_grads(grads, grad_dtype: Optional[str]):
+    """The bf16-gradient lever (``TrainConfig.grad_dtype``): cast grads
+    once right after ``value_and_grad`` — the dtype any cross-device
+    all-reduce and downstream grad traffic run in — then restore the
+    original dtype so the optimizer state stays float32. A no-op (and an
+    unchanged jaxpr) for the float32 default.
+
+    Public API: ``bench.py`` and the step profiler apply the same cast to
+    their standalone steps so an A/B labeled ``grad_dtype`` measures
+    exactly what the Trainer runs."""
+    if grad_dtype in (None, "float32", "f32"):
+        return grads
+    dt = jnp.dtype(grad_dtype)
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(dt).astype(g.dtype), grads
+    )
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
     gamma: float,
     num_iters: int,
     donate: bool = True,
+    grad_dtype: Optional[str] = None,
 ) -> Callable:
     """Stage-1 training step: sequence loss over all iteration outputs
     (``tools/engine.py:135-143``)."""
@@ -38,6 +57,7 @@ def make_train_step(
             return loss, flows
 
         (loss, flows), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = maybe_cast_grads(grads, grad_dtype)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         epe = epe_train(flows[-1], batch["mask"], batch["flow"])
@@ -51,6 +71,7 @@ def make_refine_train_step(
     tx: optax.GradientTransformation,
     num_iters: int,
     donate: bool = True,
+    grad_dtype: Optional[str] = None,
 ) -> Callable:
     """Stage-2 step: plain masked-L1 on the single refined flow
     (``tools/engine_refine.py:142``). The backbone is frozen by the model's
@@ -62,6 +83,7 @@ def make_refine_train_step(
             return compute_loss(flow, batch["mask"], batch["flow"]), flow
 
         (loss, flow), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = maybe_cast_grads(grads, grad_dtype)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         epe = epe_train(flow, batch["mask"], batch["flow"])
@@ -79,6 +101,7 @@ def make_packed_train_step(
     opt_state,
     donate: bool = True,
     refine: bool = False,
+    grad_dtype: Optional[str] = None,
 ):
     """``make_train_step`` with the train state crossing the step boundary
     as ONE flat buffer instead of a ~300-leaf pytree.
@@ -99,7 +122,7 @@ def make_packed_train_step(
     ``unravel(flat) -> (params, opt_state)`` for checkpointing.
     """
     step, flat0, unravel = _packed_step_fn(
-        model, tx, gamma, num_iters, params, opt_state, refine
+        model, tx, gamma, num_iters, params, opt_state, refine, grad_dtype
     )
     return (
         jax.jit(step, donate_argnums=(0,) if donate else ()),
@@ -108,7 +131,8 @@ def make_packed_train_step(
     )
 
 
-def _packed_step_fn(model, tx, gamma, num_iters, params, opt_state, refine):
+def _packed_step_fn(model, tx, gamma, num_iters, params, opt_state, refine,
+                    grad_dtype: Optional[str] = None):
     """Unjitted packed-state step body shared by the single-step and the
     scan-fused multi-step factories. Returns ``(step, flat0, unravel)``."""
     from jax.flatten_util import ravel_pytree
@@ -127,6 +151,7 @@ def _packed_step_fn(model, tx, gamma, num_iters, params, opt_state, refine):
             return loss, flows[-1]
 
         (loss, last), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = maybe_cast_grads(grads, grad_dtype)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         epe = epe_train(last, batch["mask"], batch["flow"])
@@ -146,6 +171,7 @@ def make_multistep_train_step(
     steps_per_dispatch: int,
     donate: bool = True,
     refine: bool = False,
+    grad_dtype: Optional[str] = None,
 ):
     """K packed train steps fused into ONE compiled program via
     ``lax.scan`` — one dispatch runs K genuine fwd+bwd+adam steps.
@@ -174,7 +200,7 @@ def make_multistep_train_step(
     if steps_per_dispatch < 1:
         raise ValueError("steps_per_dispatch must be >= 1")
     inner, flat0, unravel = _packed_step_fn(
-        model, tx, gamma, num_iters, params, opt_state, refine
+        model, tx, gamma, num_iters, params, opt_state, refine, grad_dtype
     )
 
     def step(flat, batches):

@@ -57,8 +57,8 @@ from pvraft_tpu.parallel.mesh import (
     make_mesh,
     replicate,
 )
+from pvraft_tpu.profiling import StepTimer, trace_context
 from pvraft_tpu.utils.logging import ExperimentLog, TBWriter
-from pvraft_tpu.utils.profiling import StepTimer, trace_context
 
 
 def build_datasets(cfg: Config):
@@ -94,6 +94,19 @@ def _refine_mask(params) -> Any:
 class Trainer:
     def __init__(self, cfg: Config, mesh=None):
         self.cfg = cfg
+        if cfg.parallel.steps_per_dispatch > 1 and jax.process_count() > 1:
+            # The fused mode stacks K device batches with an EAGER
+            # jnp.stack (training(), below); on multi-host meshes those are
+            # non-fully-addressable global arrays and eager ops on them
+            # raise mid-epoch in multi-process JAX. Fail at construction
+            # with the fix in hand instead.
+            raise ValueError(
+                "parallel.steps_per_dispatch > 1 is single-process only "
+                "(the fused mode stacks sharded device batches eagerly, "
+                "which raises on non-fully-addressable arrays in "
+                "multi-process JAX); set steps_per_dispatch=1 on "
+                "multi-host meshes"
+            )
         self.mesh = mesh if mesh is not None else make_mesh(n_seq=1)
         self.log = ExperimentLog(cfg.exp_path, "Train", cfg.data.dataset)
         self.tb = TBWriter(os.path.join(cfg.exp_path, "logs"))
@@ -201,7 +214,8 @@ class Trainer:
 
         if refine:
             self.train_step = make_refine_train_step(
-                self.model, tx, cfg.train.iters, donate=cfg.parallel.donate
+                self.model, tx, cfg.train.iters, donate=cfg.parallel.donate,
+                grad_dtype=cfg.train.grad_dtype,
             )
             # Refine trains and evals at args.iters (engine_refine.py:199).
             self.eval_iters = cfg.train.iters
@@ -209,6 +223,7 @@ class Trainer:
             self.train_step = make_train_step(
                 self.model, tx, cfg.train.gamma, cfg.train.iters,
                 donate=cfg.parallel.donate,
+                grad_dtype=cfg.train.grad_dtype,
             )
             # Stage-1 val/test run 32 iters (engine.py:197-198).
             self.eval_iters = cfg.train.eval_iters
@@ -237,7 +252,7 @@ class Trainer:
             self.packed_step, self.flat, self.unravel = make_packed_train_step(
                 self.model, tx, cfg.train.gamma, cfg.train.iters,
                 self.params, self.opt_state, donate=cfg.parallel.donate,
-                refine=refine,
+                refine=refine, grad_dtype=cfg.train.grad_dtype,
             )
             # K>1: fuse K optimizer steps into one dispatch (lax.scan over
             # the packed step; engine/steps.py). The single packed_step
@@ -248,6 +263,7 @@ class Trainer:
                     self.params, self.opt_state,
                     cfg.parallel.steps_per_dispatch,
                     donate=cfg.parallel.donate, refine=refine,
+                    grad_dtype=cfg.train.grad_dtype,
                 )
 
         self.ckpt_dir = os.path.join(cfg.exp_path, "checkpoints")

@@ -40,7 +40,9 @@ class CorrLookup(nn.Module):
             vox = voxel_bin_means(
                 state.corr, rel, cfg.corr_levels, cfg.base_scale, cfg.resolution
             )
-            knn_corr, rel_xyz = knn_lookup(state, rel, cfg.corr_knn)
+            knn_corr, rel_xyz = knn_lookup(
+                state, rel, cfg.corr_knn, dense_vjp=cfg.scatter_free_vjp
+            )
 
         # Voxel head (corr.py:15-20).
         v = nn.Dense(128, dtype=dtype, name="out_conv1")(vox)

@@ -27,6 +27,7 @@ class PointEncoder(nn.Module):
     dtype: Optional[jnp.dtype] = None
     graph_chunk: Optional[int] = None
     graph_approx: bool = False
+    dense_vjp: bool = False
     mesh: Optional[jax.sharding.Mesh] = None
 
     @nn.compact
@@ -44,8 +45,12 @@ class PointEncoder(nn.Module):
                 graph = seq_sharded_graph(pc, self.graph_k, self.mesh)
             else:
                 graph = build_graph(pc, self.graph_k, chunk=self.graph_chunk,
-                                    approx=self.graph_approx)
-        x = SetConv(self.width, dtype=self.dtype, name="conv1")(pc, graph)
-        x = SetConv(2 * self.width, dtype=self.dtype, name="conv2")(x, graph)
-        x = SetConv(4 * self.width, dtype=self.dtype, name="conv3")(x, graph)
+                                    approx=self.graph_approx,
+                                    dense_vjp=self.dense_vjp)
+        x = SetConv(self.width, dtype=self.dtype,
+                    dense_vjp=self.dense_vjp, name="conv1")(pc, graph)
+        x = SetConv(2 * self.width, dtype=self.dtype,
+                    dense_vjp=self.dense_vjp, name="conv2")(x, graph)
+        x = SetConv(4 * self.width, dtype=self.dtype,
+                    dense_vjp=self.dense_vjp, name="conv3")(x, graph)
         return x, graph

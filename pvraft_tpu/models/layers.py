@@ -47,10 +47,16 @@ class SetConv(nn.Module):
 
     ``dtype`` (e.g. bfloat16) sets the matmul compute precision; params and
     GroupNorm statistics stay float32.
+
+    ``dense_vjp`` (opt-in via ``ModelConfig.scatter_free_vjp``) swaps the
+    neighbor gather's scatter-add backward and the k-pool max backward for
+    the scatter-free formulations in ``ops/scatter_free.py``; the forward
+    values and the default-path jaxpr are unchanged.
     """
 
     out_ch: int
     dtype: Optional[jnp.dtype] = None
+    dense_vjp: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, graph: Graph) -> jnp.ndarray:
@@ -58,14 +64,20 @@ class SetConv(nn.Module):
         # Width rule of gconv.py:21-24.
         mid = (self.out_ch + c) // 2 if c % 2 == 0 else self.out_ch // 2
 
-        nb = gather_neighbors(x, graph.neighbors)            # (B, N, k, C)
+        nb = gather_neighbors(x, graph.neighbors,
+                              dense_vjp=self.dense_vjp)     # (B, N, k, C)
         edge = nb - x[:, :, None, :]
         h = jnp.concatenate([edge, graph.rel_pos.astype(x.dtype)], axis=-1)
 
         h = nn.Dense(mid, use_bias=False, dtype=self.dtype, name="fc1")(h)
         h = group_norm(h, "gn1")
         h = jax.nn.leaky_relu(h, 0.1)
-        h = jnp.max(h, axis=2)                               # pool over k
+        if self.dense_vjp:
+            from pvraft_tpu.ops.scatter_free import max_pool_argmax
+
+            h = max_pool_argmax(h)                           # pool over k
+        else:
+            h = jnp.max(h, axis=2)                           # pool over k
 
         h = nn.Dense(self.out_ch, use_bias=False, dtype=self.dtype, name="fc2")(h)
         h = group_norm(h, "gn2")

@@ -24,13 +24,34 @@ import jax.numpy as jnp
 from jax import lax
 
 from pvraft_tpu.analysis.contracts import shapecheck
-from pvraft_tpu.config import ModelConfig, compute_dtype
+from pvraft_tpu.config import ModelConfig, compute_dtype, resolve_remat_policy
 from pvraft_tpu.models.corr_block import CorrLookup
 from pvraft_tpu.models.encoder import PointEncoder
 from pvraft_tpu.models.layers import SetConv
 from pvraft_tpu.models.update import UpdateBlock
 from pvraft_tpu.ops.corr import CorrState, corr_init
 from pvraft_tpu.ops.geometry import Graph
+
+
+# checkpoint_name tag of the per-iteration correlation-lookup output; the
+# "save_corr" remat policy saves exactly these values so the gather-heavy
+# lookup never reruns in the backward pass.
+CORR_CKPT_NAME = "corr_lookup"
+
+
+def _remat_policy_fn(name: str):
+    """Map a ``ModelConfig.remat_policy`` name to a jax.checkpoint policy
+    callable (None = save nothing, the blanket full remat)."""
+    if name == "full":
+        return None
+    from pvraft_tpu.compat import checkpoint_policies
+
+    cp = checkpoint_policies()
+    return {
+        "dots": cp.dots_saveable,
+        "dots_no_batch": cp.dots_with_no_batch_dims_saveable,
+        "save_corr": cp.save_only_these_names(CORR_CKPT_NAME),
+    }[name]
 
 
 class UpdateIter(nn.Module):
@@ -43,9 +64,16 @@ class UpdateIter(nn.Module):
         net, coords2, coords1 = carry
         coords2 = lax.stop_gradient(coords2)
         corr = CorrLookup(self.cfg, name="corr_lookup")(state, coords2)
+        if self.cfg.remat_policy == "save_corr":
+            # Tagged only when the policy consumes the tag, so the default
+            # jaxpr stays byte-identical with the flag off.
+            from pvraft_tpu.compat import checkpoint_name
+
+            corr = checkpoint_name(corr, CORR_CKPT_NAME)
         flow = coords2 - coords1
         net, delta = UpdateBlock(
-            self.cfg.hidden_dim, dtype=compute_dtype(self.cfg), name="update_block"
+            self.cfg.hidden_dim, dtype=compute_dtype(self.cfg),
+            dense_vjp=self.cfg.scatter_free_vjp, name="update_block"
         )(net, inp, corr, flow, graph)
         coords2 = coords2 + delta
         return (net, coords2, coords1), coords2 - coords1
@@ -116,6 +144,7 @@ class PVRaft(nn.Module):
         feat = PointEncoder(
             cfg.encoder_width, cfg.graph_k, dtype=dtype,
             graph_chunk=cfg.graph_chunk, graph_approx=cfg.approx_knn,
+            dense_vjp=cfg.scatter_free_vjp,
             mesh=enc_mesh, name="feature_extractor"
         )
         fmap1, graph1 = feat(xyz1)
@@ -129,6 +158,7 @@ class PVRaft(nn.Module):
         fct, graph_ctx = PointEncoder(
             cfg.encoder_width, cfg.graph_k, dtype=dtype,
             graph_chunk=cfg.graph_chunk, graph_approx=cfg.approx_knn,
+            dense_vjp=cfg.scatter_free_vjp,
             mesh=enc_mesh, name="context_extractor"
         )(xyz1, graph=graph1)
         net, inp = jnp.split(fct, [cfg.hidden_dim], axis=-1)
@@ -136,8 +166,13 @@ class PVRaft(nn.Module):
         inp = jax.nn.relu(inp)
 
         step_cls = UpdateIter
-        if cfg.remat:
-            step_cls = nn.remat(UpdateIter, prevent_cse=False)
+        policy_name = resolve_remat_policy(cfg)
+        if policy_name is not None:
+            policy = _remat_policy_fn(policy_name)
+            # Omit the kwarg entirely for the blanket policy so the legacy
+            # remat=True jaxpr is untouched.
+            remat_kwargs = {} if policy is None else {"policy": policy}
+            step_cls = nn.remat(UpdateIter, prevent_cse=False, **remat_kwargs)
         scan = nn.scan(
             step_cls,
             variable_broadcast="params",
@@ -174,8 +209,12 @@ class PVRaftRefine(nn.Module):
 
         n = self.cfg.encoder_width
         dtype = compute_dtype(self.cfg)
-        x = SetConv(n, dtype=dtype, name="ref_conv1")(flow, graph1)
-        x = SetConv(2 * n, dtype=dtype, name="ref_conv2")(x, graph1)
-        x = SetConv(4 * n, dtype=dtype, name="ref_conv3")(x, graph1)
+        dense = self.cfg.scatter_free_vjp
+        x = SetConv(n, dtype=dtype, dense_vjp=dense,
+                    name="ref_conv1")(flow, graph1)
+        x = SetConv(2 * n, dtype=dtype, dense_vjp=dense,
+                    name="ref_conv2")(x, graph1)
+        x = SetConv(4 * n, dtype=dtype, dense_vjp=dense,
+                    name="ref_conv3")(x, graph1)
         delta = nn.Dense(3, dtype=jnp.float32, name="fc")(x)
         return flow + delta

@@ -57,11 +57,13 @@ class FlowHead(nn.Module):
     state, fused to a 3-channel flow delta (delta emitted in float32)."""
 
     dtype: Optional[jnp.dtype] = None
+    dense_vjp: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, graph: Graph) -> jnp.ndarray:
         out = nn.Dense(64, dtype=self.dtype, name="conv1")(x)
-        out_set = SetConv(64, dtype=self.dtype, name="setconv")(x, graph)
+        out_set = SetConv(64, dtype=self.dtype, dense_vjp=self.dense_vjp,
+                          name="setconv")(x, graph)
         h = jnp.concatenate([out_set.astype(out.dtype), out], axis=-1)
         h = jax.nn.relu(nn.Dense(64, dtype=self.dtype, name="out_conv1")(h))
         return nn.Dense(3, dtype=jnp.float32, name="out_conv2")(h)
@@ -72,6 +74,7 @@ class UpdateBlock(nn.Module):
 
     hidden: int = 64
     dtype: Optional[jnp.dtype] = None
+    dense_vjp: bool = False
 
     @nn.compact
     def __call__(
@@ -85,5 +88,6 @@ class UpdateBlock(nn.Module):
         motion = MotionEncoder(self.hidden, dtype=self.dtype, name="motion_encoder")(flow, corr)
         x = jnp.concatenate([inp.astype(motion.dtype), motion], axis=-1)
         net = ConvGRU(self.hidden, dtype=self.dtype, name="gru")(net, x)
-        delta = FlowHead(dtype=self.dtype, name="flow_head")(net, graph)
+        delta = FlowHead(dtype=self.dtype, dense_vjp=self.dense_vjp,
+                         name="flow_head")(net, graph)
         return net, delta

@@ -130,7 +130,8 @@ def corr_init(
 
 
 @shapecheck(None, "B N K 3", out=("B N J", "B N J 3"))
-def knn_lookup(state: CorrState, rel: jnp.ndarray, k: int):
+def knn_lookup(state: CorrState, rel: jnp.ndarray, k: int,
+               dense_vjp: bool = False):
     """Point-branch lookup: pick the k truncated candidates nearest to the
     current coordinate estimate (``model/corr.py:75-89``).
 
@@ -138,9 +139,20 @@ def knn_lookup(state: CorrState, rel: jnp.ndarray, k: int):
     (precomputed by the caller and shared with the voxel branch). Returns:
       knn_corr (B, N, k) — their correlation values,
       rel_xyz  (B, N, k, 3) — their positions relative to the coords.
+
+    ``dense_vjp`` replaces the two ``take_along_axis`` backwards (scatter-
+    adds over the K candidate axis) with one shared one-hot matmul
+    (``ops/scatter_free.py``); forward values and the default-path jaxpr
+    are unchanged. Opt-in via ``ModelConfig.scatter_free_vjp``; only the
+    XLA fallback path is affected (the fused Pallas lookup has its own
+    VJP).
     """
     dist = jnp.sum(rel * rel, axis=-1)  # (B, N, K)
     _, nbr = lax.top_k(-dist, k)                      # (B, N, k)
+    if dense_vjp:
+        from pvraft_tpu.ops.scatter_free import take_pair_onehot
+
+        return take_pair_onehot(state.corr, rel, nbr)
     knn_corr = jnp.take_along_axis(state.corr, nbr, axis=-1)
     rel_xyz = jnp.take_along_axis(rel, nbr[..., None], axis=2)
     return knn_corr, rel_xyz

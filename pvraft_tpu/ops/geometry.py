@@ -118,11 +118,22 @@ def knn_indices(
 
 
 @shapecheck("B M C", "B N K", out="B N K C")
-def gather_neighbors(feats: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+def gather_neighbors(
+    feats: jnp.ndarray, idx: jnp.ndarray, dense_vjp: bool = False
+) -> jnp.ndarray:
     """Gather per-neighbor features.
 
     feats: (B, M, C), idx: (B, N, k) -> (B, N, k, C).
+
+    ``dense_vjp`` swaps XLA's default gather-grad (a scatter-add, which
+    serializes on TPU) for the scatter-free one-hot-matmul VJP
+    (``ops/scatter_free.py``); forward values and the default-path jaxpr
+    are unchanged. Opt-in via ``ModelConfig.scatter_free_vjp``.
     """
+    if dense_vjp:
+        from pvraft_tpu.ops.scatter_free import gather_neighbors_onehot
+
+        return gather_neighbors_onehot(feats, idx)
     return jax.vmap(lambda f, i: f[i])(feats, idx)
 
 
@@ -144,12 +155,14 @@ class Graph(NamedTuple):
 
 @shapecheck("B N 3", out=("B N K", "B N K 3"))
 def build_graph(pc: jnp.ndarray, k: int, chunk: Optional[int] = None,
-                approx: bool = False) -> Graph:
+                approx: bool = False, dense_vjp: bool = False) -> Graph:
     """Construct the kNN graph of a cloud with itself.
 
     pc: (B, N, 3). Mirrors ``Graph.construct_graph`` (``graph.py:27-89``)
-    with batched tensors instead of flat edge lists.
+    with batched tensors instead of flat edge lists. ``dense_vjp`` routes
+    the coordinate gather's backward through the scatter-free VJP (the
+    cloud receives gradient via ``rel_pos``).
     """
     idx = knn_indices(pc, pc, k, chunk=chunk, approx=approx)
-    nb = gather_neighbors(pc, idx)
+    nb = gather_neighbors(pc, idx, dense_vjp=dense_vjp)
     return Graph(neighbors=idx, rel_pos=nb - pc[:, :, None, :])

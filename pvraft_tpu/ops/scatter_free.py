@@ -1,0 +1,257 @@
+"""Scatter-free custom VJPs for the gather-dominated hot path.
+
+XLA differentiates every gather into a scatter-add, and on TPU scatter is
+a serialized per-update loop the MXU cannot help with — the backward pass
+of PV-RAFT's hot loop (neighbor gathers in ``SetConv``, the candidate
+selection in ``knn_lookup``, the k-neighbor max-pool) is therefore
+scatter-bound even though the forward is gather/matmul-bound. These
+custom VJPs rewrite each backward as a **one-hot matmul** (a batched
+segment-sum expressed as a dense contraction), the dense-primitive
+restructuring PointTransformerX argues for (PAPERS.md): the "scatter" of
+``K`` cotangent rows into ``M`` bins becomes ``onehot(idx) @ g`` on the
+MXU.
+
+Memory discipline: the one-hot tensor is never materialized beyond
+``ONEHOT_ELEM_BUDGET`` elements — larger problems stream the flattened
+gather axis (accumulating carry) or the batch-like point axis (stacked
+outputs) under ``lax.scan``.
+
+All of these are **opt-in** via ``ModelConfig.scatter_free_vjp``; with
+the flag off the callers' jaxprs are byte-identical to the pre-existing
+XLA-default paths. Grad parity against the XLA default is test-gated
+(``tests/test_scatter_free.py``). Tie semantics of ``max_pool_argmax``:
+the full cotangent goes to the FIRST maximum (torch semantics) where the
+XLA default splits it across ties — identical whenever the max is unique.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pvraft_tpu.analysis.contracts import shapecheck
+
+# Peak one-hot footprint allowed inside a backward before the problem is
+# chunked under lax.scan (elements, not bytes; 1<<24 = 16M elems = 64 MB
+# fp32 — comfortably inside a v5e core's working set next to the
+# activations the same backward already holds).
+ONEHOT_ELEM_BUDGET = 1 << 24
+
+
+def _int_cotangent(idx: jnp.ndarray):
+    """The float0 zero cotangent custom_vjp requires for integer primals."""
+    return np.zeros(np.shape(idx), dtype=jax.dtypes.float0)
+
+
+def _scatter_add_onehot(
+    idx_flat: jnp.ndarray, g_flat: jnp.ndarray, m: int
+) -> jnp.ndarray:
+    """Sum cotangent rows into their index bins via one-hot matmuls.
+
+    idx_flat: (B, P) int32, g_flat: (B, P, C) -> (B, M, C) with
+    ``out[b, idx_flat[b, p]] += g_flat[b, p]`` — the segment-sum that XLA
+    would emit as scatter-add, expressed as ``onehot^T @ g`` so it runs on
+    the MXU. P is streamed in chunks (accumulating carry) when the one-hot
+    would exceed ``ONEHOT_ELEM_BUDGET``.
+    """
+    b, p = idx_flat.shape
+    c = g_flat.shape[-1]
+    acc_dtype = jnp.promote_types(g_flat.dtype, jnp.float32)
+    bins = jnp.arange(m, dtype=idx_flat.dtype)
+
+    n_chunks = max(1, -(-(b * p * m) // ONEHOT_ELEM_BUDGET))
+    if n_chunks == 1:
+        oh = (idx_flat[..., None] == bins).astype(acc_dtype)      # (B, P, M)
+        out = jnp.einsum(
+            "bpm,bpc->bmc", oh, g_flat.astype(acc_dtype),
+            preferred_element_type=acc_dtype,
+        )
+        return out.astype(g_flat.dtype)
+
+    chunk = -(-p // n_chunks)
+    pad = n_chunks * chunk - p
+    # Zero-padded cotangent rows contribute nothing wherever their
+    # (padded-to-0) index lands, so padding is exact.
+    idx_p = jnp.pad(idx_flat, ((0, 0), (0, pad)))
+    g_p = jnp.pad(g_flat, ((0, 0), (0, pad), (0, 0)))
+    idx_c = jnp.swapaxes(idx_p.reshape(b, n_chunks, chunk), 0, 1)
+    g_c = jnp.swapaxes(g_p.reshape(b, n_chunks, chunk, c), 0, 1)
+
+    def step(acc, xs):
+        ic, gc = xs
+        oh = (ic[..., None] == bins).astype(acc_dtype)
+        return acc + jnp.einsum(
+            "bpm,bpc->bmc", oh, gc.astype(acc_dtype),
+            preferred_element_type=acc_dtype,
+        ), None
+
+    acc0 = jnp.zeros((b, m, c), acc_dtype)
+    acc, _ = lax.scan(step, acc0, (idx_c, g_c))
+    return acc.astype(g_flat.dtype)
+
+
+# --- gather_neighbors -------------------------------------------------------
+
+# Static data (bin counts) rides as nondiff_argnums: custom_vjp residuals
+# are pytrees of arrays, so shapes/dtypes must never be residual leaves.
+# Cotangent dtypes already equal the primal output dtypes, so no dtype
+# bookkeeping is needed.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _gather_onehot(m: int, feats: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    del m
+    return jax.vmap(lambda f, i: f[i])(feats, idx)
+
+
+def _gather_onehot_fwd(m, feats, idx):
+    del m
+    return jax.vmap(lambda f, i: f[i])(feats, idx), idx
+
+
+def _gather_onehot_bwd(m, idx, g):
+    b = idx.shape[0]
+    df = _scatter_add_onehot(
+        idx.reshape(b, -1), g.reshape(b, -1, g.shape[-1]), m
+    )
+    return df, _int_cotangent(idx)
+
+
+_gather_onehot.defvjp(_gather_onehot_fwd, _gather_onehot_bwd)
+
+
+@shapecheck("B M C", "B N K", out="B N K C")
+def gather_neighbors_onehot(feats: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``ops.geometry.gather_neighbors`` with a scatter-free backward.
+
+    feats: (B, M, C), idx: (B, N, k) -> (B, N, k, C). Forward is the same
+    batched gather; the VJP accumulates ``d feats`` with one-hot matmuls
+    instead of XLA's scatter-add.
+    """
+    return _gather_onehot(feats.shape[1], feats, idx)
+
+
+# --- knn_lookup candidate selection ----------------------------------------
+
+
+def _take_pair_impl(corr, rel, nbr):
+    knn_corr = jnp.take_along_axis(corr, nbr, axis=-1)
+    rel_xyz = jnp.take_along_axis(rel, nbr[..., None], axis=2)
+    return knn_corr, rel_xyz
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _take_pair_onehot(k_total, corr, rel, nbr):
+    del k_total
+    return _take_pair_impl(corr, rel, nbr)
+
+
+def _take_pair_fwd(k_total, corr, rel, nbr):
+    del k_total
+    return _take_pair_impl(corr, rel, nbr), nbr
+
+
+def _take_pair_bwd(k_total, nbr, gs):
+    g_corr, g_rel = gs
+    b, n, j = nbr.shape
+    acc_dtype = jnp.promote_types(g_corr.dtype, jnp.float32)
+    bins = jnp.arange(k_total, dtype=nbr.dtype)
+
+    def dense(nc, g1, g2):
+        # nc: (B, n', j); one (B, n', j, K) one-hot feeds BOTH cotangents.
+        oh = (nc[..., None] == bins).astype(acc_dtype)
+        dc = jnp.einsum("bnjk,bnj->bnk", oh, g1.astype(acc_dtype),
+                        preferred_element_type=acc_dtype)
+        dr = jnp.einsum("bnjk,bnjc->bnkc", oh, g2.astype(acc_dtype),
+                        preferred_element_type=acc_dtype)
+        return dc, dr
+
+    n_chunks = max(1, -(-(b * n * j * k_total) // ONEHOT_ELEM_BUDGET))
+    if n_chunks == 1:
+        dc, dr = dense(nbr, g_corr, g_rel)
+    else:
+        # N is a batch axis here: stream it with stacked outputs.
+        chunk = -(-n // n_chunks)
+        pad = n_chunks * chunk - n
+
+        def pad_n(x):
+            return jnp.pad(x, ((0, 0), (0, pad)) + ((0, 0),) * (x.ndim - 2))
+
+        def to_chunks(x):
+            return jnp.swapaxes(
+                pad_n(x).reshape((b, n_chunks, chunk) + x.shape[2:]), 0, 1
+            )
+
+        def step(_, xs):
+            return None, dense(*xs)
+
+        _, (dc_c, dr_c) = lax.scan(
+            step, None, (to_chunks(nbr), to_chunks(g_corr), to_chunks(g_rel))
+        )
+        dc = jnp.swapaxes(dc_c, 0, 1).reshape(b, n_chunks * chunk, k_total)
+        dc = dc[:, :n]
+        dr = jnp.swapaxes(dr_c, 0, 1).reshape(
+            b, n_chunks * chunk, k_total, g_rel.shape[-1]
+        )[:, :n]
+    return dc.astype(g_corr.dtype), dr.astype(g_rel.dtype), _int_cotangent(nbr)
+
+
+_take_pair_onehot.defvjp(_take_pair_fwd, _take_pair_bwd)
+
+
+@shapecheck("B N K", "B N K 3", "B N J", out=("B N J", "B N J 3"))
+def take_pair_onehot(
+    corr: jnp.ndarray, rel: jnp.ndarray, nbr: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The ``knn_lookup`` candidate selection with a scatter-free backward.
+
+    corr: (B, N, K), rel: (B, N, K, 3), nbr: (B, N, j) indices into the K
+    axis -> (knn_corr (B, N, j), rel_xyz (B, N, j, 3)). One shared
+    ``(B, N, j, K)`` one-hot turns both ``take_along_axis`` backwards into
+    per-row matmuls over the K candidate axis.
+    """
+    return _take_pair_onehot(corr.shape[-1], corr, rel, nbr)
+
+
+# --- SetConv k-neighbor max-pool -------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _max_pool_argmax(k, h):
+    del k
+    return jnp.max(h, axis=2)
+
+
+def _max_pool_fwd(k, h):
+    del k
+    # Residual is the int argmax (B, N, C) — k x smaller than saving h,
+    # which matters under remat policies that would otherwise rebuild the
+    # full (B, N, k, C) pre-pool tensor just to re-derive the max mask.
+    return jnp.max(h, axis=2), jnp.argmax(h, axis=2).astype(jnp.int32)
+
+
+def _max_pool_bwd(k, amax, g):
+    sel = (
+        jnp.arange(k, dtype=amax.dtype)[None, None, :, None]
+        == amax[:, :, None, :]
+    )
+    return (jnp.where(sel, g[:, :, None, :], 0),)
+
+
+_max_pool_argmax.defvjp(_max_pool_fwd, _max_pool_bwd)
+
+
+@shapecheck("B N K C", out="B N C")
+def max_pool_argmax(h: jnp.ndarray) -> jnp.ndarray:
+    """``jnp.max(h, axis=2)`` with a scatter-free, argmax-residual VJP.
+
+    h: (B, N, k, C) -> (B, N, C). The backward routes the cotangent to the
+    first maximum along k via a dense comparison against the saved int32
+    argmax — no recomputation of h, no tie-splitting division.
+    """
+    return _max_pool_argmax(h.shape[2], h)

@@ -1,0 +1,18 @@
+"""Profiling subsystem.
+
+Promoted from ``pvraft_tpu/utils/profiling.py`` (which remains as a
+re-export shim): the wall-clock :class:`StepTimer` / ``trace_context``
+primitives plus the per-stage train-step profiler that produces the
+``artifacts/step_profile.json`` evidence record (the instrument the
+round-5 perf correction demanded — BENCHMARKS.md).
+"""
+
+from pvraft_tpu.profiling.step_profiler import (  # noqa: F401
+    BREAKDOWN_STAGES,
+    MEASUREMENTS,
+    SCHEMA_VERSION,
+    derive_breakdown,
+    profile_step,
+    validate_step_profile,
+)
+from pvraft_tpu.profiling.timers import StepTimer, trace_context  # noqa: F401

@@ -1,0 +1,278 @@
+"""Per-stage train-step profiler.
+
+The instrument the round-5 perf correction demanded (BENCHMARKS.md): every
+sub-second "device time" measured through the remote tunnel without a host
+fetch is suspect — ``block_until_ready`` has been observed to return before
+execution. This profiler times a ladder of cumulative programs, each
+synced by the only thing the tunnel cannot fake (a host scalar fetch of a
+value data-dependent on the program's output) and fed fresh (perturbed)
+inputs per call so result memoization cannot serve cache hits.
+
+Measured cumulative programs (flagship step anatomy):
+
+    encoder    PointEncoder forward on ONE cloud (kNN graph + 3 SetConvs)
+    corr_cum   both clouds encoded + the truncated correlation build
+    fwd1/fwdN  full model forward at 1 / N GRU iterations
+    fwdbwd     value_and_grad of the sequence loss (no optimizer)
+    step       the full train step (fwd + bwd + adam)
+
+Their pairwise differences telescope into the per-stage breakdown the
+artifact schema guarantees sums to the measured total step time:
+
+    encoder     = 2 x encoder              (both clouds)
+    corr_init   = corr_cum - 2 x encoder   (correlation build alone)
+    gru_forward = fwdN - corr_cum          (GRU loop + context encoder
+                                            + heads — the rest of fwd)
+    backward    = fwdbwd - fwdN
+    optimizer   = step - fwdbwd
+
+Runs identically on CPU and TPU (the host-fetch sync is what makes the
+TPU numbers honest; on CPU it is merely free). Individual derived stages
+can go slightly negative under timing noise — the validator checks the
+telescoped sum, which is exact by construction, and flags negatives.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+SCHEMA_VERSION = "pvraft_step_profile/v1"
+
+# Cumulative host-synced programs, in ladder order.
+MEASUREMENTS = ("encoder", "corr_cum", "fwd1", "fwdN", "fwdbwd", "step")
+
+# Derived per-stage breakdown; telescopes to measurements["step"]["sec"].
+BREAKDOWN_STAGES = ("encoder", "corr_init", "gru_forward", "backward",
+                    "optimizer")
+
+
+def derive_breakdown(measurements: Dict[str, dict]) -> Dict[str, float]:
+    """Telescoped per-stage seconds from the cumulative measurements."""
+    sec = {k: measurements[k]["sec"] for k in MEASUREMENTS}
+    return {
+        "encoder": round(2 * sec["encoder"], 6),
+        "corr_init": round(sec["corr_cum"] - 2 * sec["encoder"], 6),
+        "gru_forward": round(sec["fwdN"] - sec["corr_cum"], 6),
+        "backward": round(sec["fwdbwd"] - sec["fwdN"], 6),
+        "optimizer": round(sec["step"] - sec["fwdbwd"], 6),
+    }
+
+
+def validate_step_profile(record: dict, rel_tol: float = 0.02) -> List[str]:
+    """Schema problems of a step-profile record ([] = valid).
+
+    Checks the keys ``artifacts/README.md`` indexes and the one property
+    the artifact exists to certify: the per-stage breakdown sums to the
+    measured total step time (telescoping makes this exact up to
+    rounding; ``rel_tol`` absorbs the rounding)."""
+    problems: List[str] = []
+    for key in ("schema", "platform", "variant", "points", "batch", "iters",
+                "truncate_k", "host_synced", "measurements", "breakdown_s",
+                "total_step_s"):
+        if key not in record:
+            problems.append(f"missing key {key!r}")
+    if problems:
+        return problems
+    if record["schema"] != SCHEMA_VERSION:
+        problems.append(
+            f"schema {record['schema']!r} != {SCHEMA_VERSION!r}")
+    if record["host_synced"] is not True:
+        problems.append("host_synced must be true (non-synced timings are "
+                        "dispatch rates, not device work)")
+    for name in MEASUREMENTS:
+        entry = record["measurements"].get(name)
+        if entry is None:
+            problems.append(f"missing measurement {name!r}")
+        elif "sec" not in entry:
+            problems.append(
+                f"measurement {name!r} has no 'sec' "
+                f"({entry.get('error', 'no error recorded')})")
+        elif not entry["sec"] > 0:
+            problems.append(f"measurement {name!r} sec={entry['sec']} <= 0")
+    bd = record["breakdown_s"]
+    if set(bd) != set(BREAKDOWN_STAGES):
+        problems.append(
+            f"breakdown stages {sorted(bd)} != {sorted(BREAKDOWN_STAGES)}")
+    if problems:
+        return problems
+    total = record["total_step_s"]
+    sum_bd = sum(bd.values())
+    if abs(sum_bd - total) > max(rel_tol * abs(total), 1e-4):
+        problems.append(
+            f"breakdown sums to {sum_bd:.6f}s but total_step_s is "
+            f"{total:.6f}s (|diff| > {rel_tol:.0%})")
+    negatives = [
+        k for k, v in bd.items() if v < -max(rel_tol * abs(total), 1e-4)
+    ]
+    if negatives:
+        # More than tolerance-level negative: the measurement ladder is
+        # inconsistent (not just sub-tolerance timing noise).
+        problems.append(
+            f"negative derived stages {negatives} (timing noise larger "
+            "than the stage; increase reps)")
+    return problems
+
+
+def profile_step(
+    cfg,
+    points: int = 8192,
+    batch: int = 2,
+    iters: int = 8,
+    reps: int = 2,
+    gamma: float = 0.8,
+    lr: float = 1e-3,
+    grad_dtype: Optional[str] = None,
+    variant: str = "custom",
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Profile the flagship train step stage by stage; return the record.
+
+    ``cfg`` is a :class:`~pvraft_tpu.config.ModelConfig`; every knob that
+    changes the step's content (scatter_free_vjp, remat_policy,
+    compute_dtype, use_pallas, approx_topk, ...) is honored, so A/B runs
+    are one config swap apart. ``grad_dtype`` mirrors
+    ``TrainConfig.grad_dtype`` through the same ``engine/steps`` cast.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pvraft_tpu.config import compute_dtype
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.engine.steps import maybe_cast_grads
+    from pvraft_tpu.models import PVRaft
+    from pvraft_tpu.models.encoder import PointEncoder
+    from pvraft_tpu.ops.corr import corr_init
+
+    say = log or (lambda msg: None)
+    model = PVRaft(cfg)
+    platform = jax.devices()[0].platform
+
+    rng = np.random.default_rng(0)
+    pc1 = jnp.asarray(
+        rng.uniform(-1, 1, (batch, points, 3)).astype(np.float32))
+    pc2 = jnp.asarray(
+        rng.uniform(-1, 1, (batch, points, 3)).astype(np.float32))
+    mask = jnp.ones((batch, points), jnp.float32)
+    gt = pc2 - pc1
+    # Init on a small cloud (params are point-count independent) — but it
+    # must still hold >= truncate_k candidate points for corr_init.
+    n_init = min(points, max(256, cfg.truncate_k))
+    params = model.init(
+        jax.random.key(0), pc1[:, :n_init], pc2[:, :n_init], 2)
+    tx = optax.adam(lr)
+    opt_state = tx.init(params)
+
+    enc = PointEncoder(cfg.encoder_width, cfg.graph_k,
+                       dtype=compute_dtype(cfg),
+                       graph_chunk=cfg.graph_chunk,
+                       graph_approx=cfg.approx_knn,
+                       dense_vjp=cfg.scatter_free_vjp)
+    enc_params = enc.init(jax.random.key(1), pc1[:, :n_init])
+
+    @jax.jit
+    def f_encoder(eps):
+        fmap, _ = enc.apply(enc_params, pc1 + eps)
+        return jnp.sum(fmap.astype(jnp.float32))
+
+    @jax.jit
+    def f_corr_cum(eps):
+        fmap1, _ = enc.apply(enc_params, pc1 + eps)
+        fmap2, _ = enc.apply(enc_params, pc2 + eps)
+        st = corr_init(fmap1, fmap2, pc2 + eps, cfg.truncate_k,
+                       cfg.corr_chunk, approx=cfg.approx_topk)
+        return jnp.sum(st.corr.astype(jnp.float32))
+
+    def fwd(n_iters):
+        @jax.jit
+        def f(eps):
+            flows, _ = model.apply(params, pc1 + eps, pc2 + eps, n_iters)
+            return jnp.sum(flows[-1].astype(jnp.float32))
+
+        return f
+
+    def loss_fn(p, eps):
+        flows, _ = model.apply(p, pc1 + eps, pc2 + eps, iters)
+        return sequence_loss(flows, mask, gt, gamma)
+
+    @jax.jit
+    def f_fwdbwd(eps):
+        loss, grads = jax.value_and_grad(loss_fn)(params, eps)
+        gsum = sum(jnp.sum(jnp.abs(g).astype(jnp.float32))
+                   for g in jax.tree_util.tree_leaves(grads))
+        return loss + 0.0 * gsum
+
+    @jax.jit
+    def f_step(eps):
+        loss, grads = jax.value_and_grad(loss_fn)(params, eps)
+        grads = maybe_cast_grads(grads, grad_dtype)
+        updates, _ = tx.update(grads, opt_state)
+        new_params = optax.apply_updates(params, updates)
+        psum = sum(jnp.sum(jnp.abs(q).astype(jnp.float32))
+                   for q in jax.tree_util.tree_leaves(new_params))
+        return loss + 0.0 * psum
+
+    programs = [
+        ("encoder", f_encoder),
+        ("corr_cum", f_corr_cum),
+        ("fwd1", fwd(1)),
+        ("fwdN", fwd(iters)),
+        ("fwdbwd", f_fwdbwd),
+        ("step", f_step),
+    ]
+
+    eps_counter = [0.0]
+
+    def fresh_eps():
+        eps_counter[0] += 1e-6
+        return jnp.float32(eps_counter[0])
+
+    record = {
+        "schema": SCHEMA_VERSION,
+        "platform": platform,
+        "variant": variant,
+        "points": points, "batch": batch, "iters": iters,
+        "truncate_k": cfg.truncate_k,
+        "host_synced": True,
+        "config": {
+            "compute_dtype": cfg.compute_dtype,
+            "use_pallas": cfg.use_pallas,
+            "approx_topk": cfg.approx_topk,
+            "approx_knn": cfg.approx_knn,
+            "scatter_free_vjp": cfg.scatter_free_vjp,
+            "remat": cfg.remat,
+            "remat_policy": cfg.remat_policy,
+            "grad_dtype": grad_dtype or "float32",
+        },
+        "measurements": {},
+    }
+    for name, fn in programs:
+        entry: dict = {}
+        try:
+            t0 = time.perf_counter()
+            # float(np.asarray(...)): the host fetch IS the sync.
+            float(np.asarray(fn(fresh_eps())))  # compile + first run
+            entry["first_call_s"] = round(time.perf_counter() - t0, 2)
+            dts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(np.asarray(fn(fresh_eps())))
+                dts.append(time.perf_counter() - t0)
+            entry["sec_reps"] = [round(d, 6) for d in dts]
+            entry["sec"] = round(min(dts), 6)
+        except Exception as e:  # noqa: BLE001 — keep profiling other stages
+            entry["error"] = repr(e)[:300]
+        record["measurements"][name] = entry
+        say(f"[step_profile] {name}: {entry}")
+
+    meas = record["measurements"]
+    if all("sec" in meas.get(k, {}) for k in MEASUREMENTS):
+        record["breakdown_s"] = derive_breakdown(meas)
+        record["total_step_s"] = meas["step"]["sec"]
+        if iters > 1:
+            record["per_iter_s"] = round(
+                (meas["fwdN"]["sec"] - meas["fwd1"]["sec"]) / (iters - 1), 6)
+    return record

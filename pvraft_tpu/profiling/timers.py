@@ -1,0 +1,46 @@
+"""Wall-clock profiling hooks.
+
+The reference's only tracing facility is a commented-out autograd profiler
+block (``tools/engine.py:136-139``). Here tracing is first-class but
+optional: a ``jax.profiler`` trace context (TensorBoard-viewable) and a
+``block_until_ready``-based step timer (SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace_context(log_dir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler.trace`` when a dir is given, no-op otherwise."""
+    if log_dir:
+        with jax.profiler.trace(log_dir):
+            yield
+    else:
+        yield
+
+
+class StepTimer:
+    """Wall-clock step timing with device sync."""
+
+    def __init__(self) -> None:
+        self.times: List[float] = []
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, *sync_on) -> float:
+        for x in sync_on:
+            jax.block_until_ready(x)
+        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        self.times.append(dt)
+        return dt
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / max(1, len(self.times))

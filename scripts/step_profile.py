@@ -1,22 +1,19 @@
 #!/usr/bin/env python
 """Honest on-chip component breakdown of the flagship train step.
 
-Every earlier sub-second "device time" figure measured through the axon
-tunnel without a host fetch is suspect (block_until_ready has returned
-before execution; BENCHMARKS.md round-5 caveats). This script times each
-stage of the flagship program with the only sync the tunnel cannot fake —
-a host scalar fetch of a value data-dependent on the stage's output — and
-fresh (perturbed) inputs per call so result memoization cannot serve
-cache hits.
+Thin CLI over :mod:`pvraft_tpu.profiling.step_profiler` — every stage is
+synced by a host scalar fetch of a value data-dependent on the stage's
+output (the only sync the remote tunnel cannot fake; BENCHMARKS.md
+round-5 caveats) and fed fresh (perturbed) inputs per call so result
+memoization cannot serve cache hits.
 
-Stages (flagship: 8,192 pts, bs=2, K=512, knn=32, bf16+pallas+approx):
-  encoder      PointEncoder fwd on one cloud (kNN graph + 3 SetConvs)
-  corr_init    feature matmul + truncated top-k (approx) + xyz gather
-  fwd1/fwd8    full forward at 1 / 8 GRU iterations (slope = per-iter)
-  fwdbwd8      value_and_grad of the sequence loss (no optimizer)
-  step8        the full train step (fwd+bwd+adam)
-
-Writes artifacts/step_profile.json (one JSON line to stdout).
+Writes the validated ``artifacts/step_profile.json`` record (per-stage
+breakdown — encoder / corr_init / gru_forward / backward / optimizer —
+telescoping to the measured total step time) and prints it as one JSON
+line. ``--cpu`` without explicit sizes shrinks to a labeled CPU-feasible
+config (the flagship 8,192-pt step is minutes per program on the host),
+mirroring ``bench.py``'s CPU-fallback discipline; the record carries the
+measured sizes so it can never masquerade as the flagship.
 """
 
 from __future__ import annotations
@@ -25,160 +22,89 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+VARIANTS = {
+    "bf16+pallas+approx": dict(compute_dtype="bfloat16", use_pallas=True,
+                               approx_topk=True),
+    "bf16+pallas+approx+aknn": dict(compute_dtype="bfloat16",
+                                    use_pallas=True, approx_topk=True,
+                                    approx_knn=True),
+    "bf16+approx": dict(compute_dtype="bfloat16", use_pallas=False,
+                        approx_topk=True),
+    "bf16": dict(compute_dtype="bfloat16", use_pallas=False),
+    "fp32": dict(use_pallas=False),
+}
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--points", type=int, default=8192)
-    p.add_argument("--batch", type=int, default=2)
-    p.add_argument("--k", type=int, default=512)
-    p.add_argument("--reps", type=int, default=2)
-    p.add_argument("--variant", default="bf16+pallas+approx")
+    p.add_argument("--points", type=int, default=None)
+    p.add_argument("--batch", type=int, default=None)
+    p.add_argument("--k", type=int, default=None)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--reps", type=int, default=None)
+    p.add_argument("--variant", default=None,
+                   help="named variant (default: bf16+pallas+approx on "
+                        "TPU, fp32 on --cpu)")
+    p.add_argument("--scatter_free", action="store_true",
+                   help="A/B flag: ModelConfig.scatter_free_vjp=True")
+    p.add_argument("--remat_policy", default="none",
+                   help="A/B flag: ModelConfig.remat_policy")
+    p.add_argument("--grad_dtype", default=None,
+                   help="A/B flag: bfloat16 gradient cast "
+                        "(TrainConfig.grad_dtype semantics)")
     p.add_argument("--out", default="artifacts/step_profile.json")
     from _backend import add_cpu_flag, maybe_pin_cpu
 
     add_cpu_flag(p)
     a = p.parse_args()
 
-    import numpy as np
-
-    import jax
-
     maybe_pin_cpu(a.cpu)
-    import jax.numpy as jnp
-    import optax
 
     from pvraft_tpu.config import ModelConfig
-    from pvraft_tpu.engine.loss import sequence_loss
-    from pvraft_tpu.models import PVRaft
-    from pvraft_tpu.models.encoder import PointEncoder
-    from pvraft_tpu.ops.corr import corr_init
+    from pvraft_tpu.profiling import profile_step, validate_step_profile
 
-    VARIANTS = {
-        "bf16+pallas+approx": dict(compute_dtype="bfloat16", use_pallas=True,
-                                   approx_topk=True),
-        "bf16+pallas+approx+aknn": dict(compute_dtype="bfloat16",
-                                        use_pallas=True, approx_topk=True,
-                                        approx_knn=True),
-        "bf16+approx": dict(compute_dtype="bfloat16", use_pallas=False,
-                            approx_topk=True),
-        "bf16": dict(compute_dtype="bfloat16", use_pallas=False),
-        "fp32": dict(use_pallas=False),
-    }
-    cfg = ModelConfig(truncate_k=a.k, **VARIANTS[a.variant])
-    model = PVRaft(cfg)
-    platform = jax.devices()[0].platform
+    # Flagship defaults; --cpu shrinks (labeled) unless sizes are pinned.
+    points = a.points if a.points is not None else (2048 if a.cpu else 8192)
+    batch = a.batch if a.batch is not None else 2
+    k = a.k if a.k is not None else (256 if a.cpu else 512)
+    # Default min-of-2 reps: the CPU host shows ~10% run-to-run drift
+    # (BENCHMARKS.md round-3 note), enough to invert adjacent ladder
+    # rungs at reps=1.
+    reps = a.reps if a.reps is not None else 2
+    variant = a.variant or ("fp32" if a.cpu else "bf16+pallas+approx")
 
-    rng = np.random.default_rng(0)
-    pc1 = jnp.asarray(rng.uniform(-1, 1, (a.batch, a.points, 3))
-                      .astype(np.float32))
-    pc2 = jnp.asarray(rng.uniform(-1, 1, (a.batch, a.points, 3))
-                      .astype(np.float32))
-    mask = jnp.ones((a.batch, a.points), jnp.float32)
-    gt = pc2 - pc1
-    n_init = min(a.points, max(256, a.k))
-    params = model.init(jax.random.key(0), pc1[:, :n_init], pc2[:, :n_init], 2)
-    tx = optax.adam(1e-3)
-    opt_state = tx.init(params)
+    kwargs = dict(VARIANTS[variant])
+    if a.scatter_free:
+        kwargs["scatter_free_vjp"] = True
+        variant += "+sfvjp"
+    if a.remat_policy != "none":
+        kwargs["remat_policy"] = a.remat_policy
+        variant += f"+remat:{a.remat_policy}"
+    if a.grad_dtype:
+        variant += f"+grads:{a.grad_dtype}"
+    cfg = ModelConfig(truncate_k=k, **kwargs)
 
-    from pvraft_tpu.config import compute_dtype as _cd
+    record = profile_step(
+        cfg, points=points, batch=batch, iters=a.iters, reps=reps,
+        grad_dtype=a.grad_dtype, variant=variant,
+        log=lambda msg: print(msg, file=sys.stderr),
+    )
+    problems = validate_step_profile(record) if "breakdown_s" in record \
+        else ["incomplete measurements (see stage errors)"]
+    record["valid"] = not problems
+    if problems:
+        record["problems"] = problems
+        print(f"[step_profile] INVALID: {problems}", file=sys.stderr)
 
-    enc = PointEncoder(cfg.encoder_width, cfg.graph_k, dtype=_cd(cfg),
-                       graph_chunk=cfg.graph_chunk,
-                       graph_approx=cfg.approx_knn)
-    enc_params = enc.init(jax.random.key(1), pc1[:, :n_init])
-
-    @jax.jit
-    def f_encoder(eps):
-        fmap, _ = enc.apply(enc_params, pc1 + eps)
-        return jnp.sum(fmap.astype(jnp.float32))
-
-    @jax.jit
-    def f_corr_init(eps):
-        fmap1, _ = enc.apply(enc_params, pc1 + eps)
-        fmap2, _ = enc.apply(enc_params, pc2 + eps)
-        st = corr_init(fmap1, fmap2, pc2 + eps, cfg.truncate_k,
-                       cfg.corr_chunk, approx=cfg.approx_topk)
-        return jnp.sum(st.corr.astype(jnp.float32))
-
-    def fwd(n_iters):
-        @jax.jit
-        def f(eps):
-            flows, _ = model.apply(params, pc1 + eps, pc2 + eps, n_iters)
-            return jnp.sum(flows[-1].astype(jnp.float32))
-
-        return f
-
-    @jax.jit
-    def f_fwdbwd(eps):
-        def loss_fn(p):
-            flows, _ = model.apply(p, pc1 + eps, pc2 + eps, 8)
-            return sequence_loss(flows, mask, gt, 0.8)
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        gsum = sum(jnp.sum(jnp.abs(g).astype(jnp.float32))
-                   for g in jax.tree_util.tree_leaves(grads))
-        return loss + 0.0 * gsum
-
-    @jax.jit
-    def f_step(eps):
-        def loss_fn(p):
-            flows, _ = model.apply(p, pc1 + eps, pc2 + eps, 8)
-            return sequence_loss(flows, mask, gt, 0.8)
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, _ = tx.update(grads, opt_state)
-        new_params = optax.apply_updates(params, updates)
-        psum = sum(jnp.sum(jnp.abs(q).astype(jnp.float32))
-                   for q in jax.tree_util.tree_leaves(new_params))
-        return loss + 0.0 * psum
-
-    stages = [
-        ("encoder", f_encoder),
-        ("corr_init", f_corr_init),
-        ("fwd1", fwd(1)),
-        ("fwd8", fwd(8)),
-        ("fwdbwd8", f_fwdbwd),
-        ("step8", f_step),
-    ]
-    record = {"platform": platform, "variant": a.variant,
-              "points": a.points, "batch": a.batch, "truncate_k": a.k,
-              "host_synced": True, "stages": {}}
-    eps_counter = [0.0]
-
-    def fresh_eps():
-        eps_counter[0] += 1e-6
-        return jnp.float32(eps_counter[0])
-
-    for name, fn in stages:
-        entry = {}
-        try:
-            t0 = time.perf_counter()
-            float(np.asarray(fn(fresh_eps())))  # compile + first run
-            entry["first_call_s"] = round(time.perf_counter() - t0, 2)
-            dts = []
-            for _ in range(a.reps):
-                t0 = time.perf_counter()
-                float(np.asarray(fn(fresh_eps())))
-                dts.append(time.perf_counter() - t0)
-            entry["sec_reps"] = [round(d, 4) for d in dts]
-            entry["sec"] = round(min(dts), 4)
-        except Exception as e:  # noqa: BLE001 — keep profiling other stages
-            entry["error"] = repr(e)[:300]
-        record["stages"][name] = entry
-        print(f"[step_profile] {name}: {entry}", file=sys.stderr)
-
-    s = record["stages"]
-    if "sec" in s.get("fwd8", {}) and "sec" in s.get("fwd1", {}):
-        record["per_iter_s"] = round((s["fwd8"]["sec"] - s["fwd1"]["sec"]) / 7,
-                                     4)
     print(json.dumps(record))
+    os.makedirs(os.path.dirname(a.out) or ".", exist_ok=True)
     with open(a.out, "w") as f:
         json.dump(record, f, indent=1)
-    return 0
+    return 0 if not problems else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,262 @@
+"""Grad parity of the scatter-free custom VJPs vs the XLA defaults, plus
+the flags-off jaxpr-unchanged guarantee and the remat-policy numerics."""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pvraft_tpu.config import ModelConfig, resolve_remat_policy
+from pvraft_tpu.ops import scatter_free as sf
+from pvraft_tpu.ops.corr import CorrState, knn_lookup
+from pvraft_tpu.ops.geometry import gather_neighbors
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+# --- op-level grad parity ---------------------------------------------------
+
+
+def test_gather_neighbors_grad_parity(rng):
+    feats = jnp.asarray(rng.normal(size=(2, 13, 5)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, 13, size=(2, 7, 4)).astype(np.int32))
+    w = jnp.asarray(rng.normal(size=(2, 7, 4, 5)).astype(np.float32))
+
+    def loss(f, dense):
+        return jnp.sum(jnp.sin(gather_neighbors(f, idx, dense_vjp=dense)) * w)
+
+    g_ref = jax.grad(lambda f: loss(f, False))(feats)
+    g_new = jax.grad(lambda f: loss(f, True))(feats)
+    np.testing.assert_allclose(np.asarray(g_new), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gather_neighbors_grad_parity_chunked(rng, monkeypatch):
+    # Force the streaming (lax.scan) backward, incl. a ragged final chunk.
+    monkeypatch.setattr(sf, "ONEHOT_ELEM_BUDGET", 64)
+    feats = jnp.asarray(rng.normal(size=(2, 13, 5)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, 13, size=(2, 7, 4)).astype(np.int32))
+
+    def loss(f, dense):
+        return jnp.sum(jnp.cos(gather_neighbors(f, idx, dense_vjp=dense)))
+
+    g_ref = jax.grad(lambda f: loss(f, False))(feats)
+    g_new = jax.grad(lambda f: loss(f, True))(feats)
+    np.testing.assert_allclose(np.asarray(g_new), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_knn_lookup_grad_parity(rng):
+    corr = jnp.asarray(rng.normal(size=(2, 6, 9)).astype(np.float32))
+    xyz = jnp.asarray(rng.normal(size=(2, 6, 9, 3)).astype(np.float32))
+    coords = jnp.asarray(rng.normal(size=(2, 6, 3)).astype(np.float32))
+
+    def loss(c, co, dense):
+        rel = xyz - co[:, :, None, :]
+        kc, rx = knn_lookup(CorrState(corr=c, xyz=xyz), rel, 4,
+                            dense_vjp=dense)
+        return jnp.sum(jnp.sin(kc)) + jnp.sum(jnp.cos(rx))
+
+    g_ref = jax.grad(lambda c, co: loss(c, co, False), (0, 1))(corr, coords)
+    g_new = jax.grad(lambda c, co: loss(c, co, True), (0, 1))(corr, coords)
+    for a, b in zip(g_new, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_take_pair_grad_parity_chunked(rng, monkeypatch):
+    monkeypatch.setattr(sf, "ONEHOT_ELEM_BUDGET", 32)
+    corr = jnp.asarray(rng.normal(size=(2, 7, 9)).astype(np.float32))
+    rel = jnp.asarray(rng.normal(size=(2, 7, 9, 3)).astype(np.float32))
+    nbr = jnp.asarray(rng.integers(0, 9, size=(2, 7, 4)).astype(np.int32))
+
+    def ref(c, r):
+        kc = jnp.take_along_axis(c, nbr, axis=-1)
+        rx = jnp.take_along_axis(r, nbr[..., None], axis=2)
+        return jnp.sum(jnp.sin(kc)) + jnp.sum(jnp.cos(rx))
+
+    def new(c, r):
+        kc, rx = sf.take_pair_onehot(c, r, nbr)
+        return jnp.sum(jnp.sin(kc)) + jnp.sum(jnp.cos(rx))
+
+    g_ref = jax.grad(ref, (0, 1))(corr, rel)
+    g_new = jax.grad(new, (0, 1))(corr, rel)
+    for a, b in zip(g_new, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_max_pool_grad_parity(rng):
+    # Continuous random data: maxima unique with probability 1, where the
+    # XLA default (tie-splitting) and the argmax VJP agree exactly.
+    h = jnp.asarray(rng.normal(size=(2, 6, 4, 5)).astype(np.float32))
+    g_ref = jax.grad(lambda x: jnp.sum(jnp.sin(jnp.max(x, axis=2))))(h)
+    g_new = jax.grad(lambda x: jnp.sum(jnp.sin(sf.max_pool_argmax(x))))(h)
+    np.testing.assert_allclose(np.asarray(g_new), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_max_pool_tie_goes_to_first():
+    # Documented tie semantics: full cotangent to the FIRST max (torch),
+    # where the XLA default splits it.
+    h = jnp.zeros((1, 1, 3, 1), jnp.float32)
+    g = jax.grad(lambda x: jnp.sum(sf.max_pool_argmax(x)))(h)
+    np.testing.assert_array_equal(
+        np.asarray(g)[0, 0, :, 0], np.asarray([1.0, 0.0, 0.0]))
+
+
+def test_scatter_free_forward_identical(rng):
+    feats = jnp.asarray(rng.normal(size=(2, 13, 5)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, 13, size=(2, 7, 4)).astype(np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(gather_neighbors(feats, idx)),
+        np.asarray(gather_neighbors(feats, idx, dense_vjp=True)),
+    )
+
+
+# --- flags-off jaxpr unchanged ----------------------------------------------
+
+
+def test_gather_neighbors_default_jaxpr_unchanged(rng):
+    feats = jnp.zeros((2, 13, 5), jnp.float32)
+    idx = jnp.zeros((2, 7, 4), jnp.int32)
+    got = jax.make_jaxpr(gather_neighbors)(feats, idx)
+    # The pre-PR implementation, verbatim.
+    want = jax.make_jaxpr(jax.vmap(lambda f, i: f[i]))(feats, idx)
+    assert str(got) == str(want)
+
+
+def test_knn_lookup_default_jaxpr_unchanged(rng):
+    state = CorrState(corr=jnp.zeros((2, 6, 9), jnp.float32),
+                      xyz=jnp.zeros((2, 6, 9, 3), jnp.float32))
+    rel = jnp.zeros((2, 6, 9, 3), jnp.float32)
+
+    def pre_pr(corr, rel):
+        from jax import lax
+
+        dist = jnp.sum(rel * rel, axis=-1)
+        _, nbr = lax.top_k(-dist, 4)
+        knn_corr = jnp.take_along_axis(corr, nbr, axis=-1)
+        rel_xyz = jnp.take_along_axis(rel, nbr[..., None], axis=2)
+        return knn_corr, rel_xyz
+
+    got = jax.make_jaxpr(lambda c, r: knn_lookup(
+        CorrState(corr=c, xyz=state.xyz), r, 4))(state.corr, rel)
+    want = jax.make_jaxpr(pre_pr)(state.corr, rel)
+    assert str(got) == str(want)
+
+
+def test_model_jaxpr_custom_vjp_only_when_opted_in():
+    cfg_off = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8,
+                          use_pallas=False)
+    cfg_on = dataclasses.replace(cfg_off, scatter_free_vjp=True)
+    from pvraft_tpu.models import PVRaft
+
+    pc = jnp.zeros((1, 32, 3), jnp.float32)
+
+    def traced(cfg):
+        model = PVRaft(cfg)
+        params = jax.eval_shape(
+            lambda: model.init(jax.random.key(0), pc, pc, 2))
+        return str(jax.make_jaxpr(
+            lambda p: model.apply(p, pc, pc, 2))(params))
+
+    assert "custom_vjp" not in traced(cfg_off)
+    assert "custom_vjp" in traced(cfg_on)
+
+
+def test_model_grads_scatter_free_match_default(rng):
+    # End to end through PVRaft: every wired-in VJP (encoder + update
+    # SetConv gathers and max-pools, graph build, knn_lookup) against the
+    # XLA default backward. fp32: the formulations are reassociation-free,
+    # so parity is essentially exact.
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (1, 40, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (1, 40, 3)).astype(np.float32))
+    base = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8,
+                       use_pallas=False)
+    g0 = _tiny_grads(base, pc1, pc2)
+    g1 = _tiny_grads(dataclasses.replace(base, scatter_free_vjp=True),
+                     pc1, pc2)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# --- remat policies ---------------------------------------------------------
+
+
+def _tiny_grads(cfg, pc1, pc2):
+    from pvraft_tpu.models import PVRaft
+
+    model = PVRaft(cfg)
+    params = model.init(jax.random.key(0), pc1, pc2, 2)
+
+    def loss(p):
+        flows, _ = model.apply(p, pc1, pc2, 2)
+        return jnp.sum(flows ** 2)
+
+    return jax.grad(loss)(params)
+
+
+@pytest.mark.parametrize("policy", ["full", "dots", "dots_no_batch",
+                                    "save_corr"])
+def test_remat_policy_grads_match_no_remat(policy, rng):
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (1, 40, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (1, 40, 3)).astype(np.float32))
+    base = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8,
+                       use_pallas=False)
+    g0 = _tiny_grads(base, pc1, pc2)
+    g1 = _tiny_grads(dataclasses.replace(base, remat_policy=policy),
+                     pc1, pc2)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_resolve_remat_policy():
+    base = ModelConfig(truncate_k=16, corr_knn=8)
+    assert resolve_remat_policy(base) is None
+    assert resolve_remat_policy(
+        dataclasses.replace(base, remat=True)) == "full"
+    assert resolve_remat_policy(
+        dataclasses.replace(base, remat_policy="dots")) == "dots"
+    # Policy wins over the legacy bool.
+    assert resolve_remat_policy(
+        dataclasses.replace(base, remat=True, remat_policy="save_corr")
+    ) == "save_corr"
+
+
+def test_invalid_remat_policy_rejected():
+    with pytest.raises(ValueError, match="remat_policy"):
+        ModelConfig(truncate_k=16, corr_knn=8, remat_policy="everything")
+
+
+# --- bf16 gradients ---------------------------------------------------------
+
+
+def test_grad_dtype_cast():
+    from pvraft_tpu.engine.steps import maybe_cast_grads
+
+    g = {"w": jnp.asarray([1.0 + 1e-7], jnp.float32)}
+    out = maybe_cast_grads(g, "bfloat16")
+    assert out["w"].dtype == jnp.float32            # restored for optax
+    # Value went through bf16 (1 + 1e-7 is not representable there).
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray([1.0]))
+    # float32 default is the identity — same object, unchanged jaxpr.
+    assert maybe_cast_grads(g, None) is g
+    assert maybe_cast_grads(g, "float32") is g
+
+
+def test_grad_dtype_config_validation():
+    from pvraft_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="grad_dtype"):
+        TrainConfig(grad_dtype="float16")
+    assert TrainConfig(grad_dtype="bfloat16").grad_dtype == "bfloat16"

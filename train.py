@@ -73,6 +73,19 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--approx_knn", action="store_true",
                    help="approximate encoder kNN graph selection (faster on TPU)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat_policy", default="none",
+                   help="jax.checkpoint policy for the GRU iteration: "
+                        "none|full|dots|dots_no_batch|save_corr (overrides "
+                        "--remat; save_corr keeps the corr-lookup output "
+                        "and recomputes the rest)")
+    p.add_argument("--scatter_free_vjp", action="store_true",
+                   help="scatter-free custom VJPs for the gather-heavy "
+                        "backward (one-hot-matmul grads; "
+                        "ops/scatter_free.py)")
+    p.add_argument("--grad_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="cast gradients once after value_and_grad (the "
+                        "all-reduce dtype); optimizer state stays float32")
     p.add_argument("--host_roundtrip", action="store_true",
                    help="with --packed_state: round-trip the flat train "
                         "state through the host between steps (fastest "
@@ -110,6 +123,8 @@ def config_from_args(a: argparse.Namespace) -> Config:
             use_pallas=a.use_pallas,
             corr_chunk=a.corr_chunk,
             remat=a.remat,
+            remat_policy=a.remat_policy,
+            scatter_free_vjp=a.scatter_free_vjp,
             approx_topk=a.approx_topk, approx_knn=a.approx_knn,
             graph_chunk=a.graph_chunk,
             scan_unroll=a.scan_unroll,
@@ -128,6 +143,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
             checkpoint_interval=a.checkpoint_interval, refine=a.refine,
             ckpt_backend=a.ckpt_backend,
             seed=a.seed, lr_schedule=a.lr_schedule, profile_dir=a.profile_dir,
+            grad_dtype=a.grad_dtype,
         ),
         parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel,
                                 packed_state=a.packed_state,
