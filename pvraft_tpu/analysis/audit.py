@@ -332,6 +332,92 @@ def _e_train_step_opt():
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
+@audit_entry("engine.train_step[telemetry]")
+def _e_train_step_telemetry():
+    # The telemetry-armed step traces end to end: the in-jit monitors
+    # (obs/monitors.py) ride back as an extra metrics leaf.
+    import jax
+    import optax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.steps import make_train_step
+    from pvraft_tpu.models.raft import PVRaft
+
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2)
+    model = PVRaft(cfg)
+    tx = optax.sgd(1e-2)
+
+    def fn(pc1, pc2, mask, gt):
+        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        opt_state = tx.init(params)
+        step = make_train_step(model, tx, 0.8, 3, telemetry=True)
+        batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
+        return step(params, opt_state, batch)
+
+    return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
+
+
+@audit_entry("engine.train_step[telemetry_off_jaxpr]")
+def _e_train_step_telemetry_off_jaxpr():
+    # Guarantee audit (GL009's dynamic twin): with telemetry OFF the
+    # train-step jaxpr is byte-identical to the pre-telemetry step body,
+    # replicated here verbatim as the golden. The comparison runs at
+    # entry-build time (abstract trace only, zero FLOPs); a mismatch
+    # raises and the audit reports this entry FAIL.
+    import jax
+    import optax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.engine.metrics import epe_train
+    from pvraft_tpu.engine.steps import make_train_step, maybe_cast_grads
+    from pvraft_tpu.models.raft import PVRaft
+
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2)
+    model = PVRaft(cfg)
+    tx = optax.sgd(1e-2)
+    pc1, pc2, mask, gt = (
+        _f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
+    params = jax.eval_shape(
+        lambda a, b: model.init(jax.random.key(0), a, b, 3), pc1, pc2)
+    opt_state = jax.eval_shape(tx.init, params)
+    batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
+
+    def step(params, opt_state, batch):  # named `step`: pjit keeps the name
+        def loss_fn(p):
+            flows, _ = model.apply(p, batch["pc1"], batch["pc2"], 3)
+            loss = sequence_loss(flows, batch["mask"], batch["flow"], 0.8)
+            return loss, flows
+
+        (loss, flows), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = maybe_cast_grads(grads, None)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        epe = epe_train(flows[-1], batch["mask"], batch["flow"])
+        return params, opt_state, {"loss": loss, "epe": epe}
+
+    # Both sides identically jitted (donation marks live in the pjit
+    # params), so the strings compare the step bodies alone. Embedded
+    # object reprs (custom_jvp thunks) carry memory addresses; normalize
+    # those — everything else must match byte for byte.
+    import re
+
+    def jaxpr_str(fn):
+        s = str(jax.make_jaxpr(fn)(params, opt_state, batch))
+        return re.sub(r"0x[0-9a-f]+", "0x0", s)
+
+    factory_step = make_train_step(model, tx, 0.8, 3, telemetry=False)
+    got = jaxpr_str(factory_step)
+    want = jaxpr_str(jax.jit(step, donate_argnums=(0, 1)))
+    if got != want:
+        raise AssertionError(
+            "telemetry=False train-step jaxpr differs from the "
+            "pre-telemetry golden (the default path must be untouched)")
+
+    return lambda p: p["loss"], ({"loss": _f32()},)
+
+
 def run_audit(verbose: bool = False) -> List[AuditResult]:
     """eval_shape every registered entry. Never raises; failures become
     ``AuditResult(ok=False)`` so one broken op can't hide the rest."""

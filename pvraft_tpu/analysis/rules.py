@@ -1,4 +1,4 @@
-"""graftlint rules GL001-GL008 — the TPU failure modes worth automating.
+"""graftlint rules GL001-GL009 — the TPU failure modes worth automating.
 
 Each rule's class docstring is its user-facing documentation (printed by
 ``python -m pvraft_tpu.analysis lint --list-rules``). Suppress any rule
@@ -510,3 +510,49 @@ class AssertOnTracer(Rule):
                             "inside jit runs at trace time; use "
                             "checkify.check or @shapecheck",
                         )
+
+
+# --- GL009 ----------------------------------------------------------------
+
+@register
+class UngatedDebugCallbackInJit(Rule):
+    """Ungated ``jax.debug.print``/``callback``/``breakpoint`` inside jit.
+
+    Debug callbacks compile INTO the program: every step pays a
+    device->host round-trip that serializes the dispatch pipeline — the
+    exact overhead the telemetry monitors (``pvraft_tpu/obs/monitors.py``)
+    exist to avoid (they return plain array leaves instead). A callback
+    is acceptable only behind a static debug flag so production traces
+    never contain it: lexically inside an ``if`` (a config/env gate makes
+    the call disappear from the trace when off), or suppressed with a
+    reason. The telemetry-off audit
+    (``analysis/audit.py:engine.train_step[telemetry_off_jaxpr]``)
+    enforces the same invariant dynamically for the train step.
+    """
+
+    id = "GL009"
+    title = "ungated-debug-callback-in-jit"
+
+    _CALLS = ("debug.print", "debug.callback", "debug.breakpoint")
+
+    def check(self, ctx: LintContext) -> Iterable[Diagnostic]:
+        jitted = jit_context_functions(ctx.tree)
+        for fn in jitted:
+            for node in _own_statements(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func)
+                if not any(dotted.endswith(c) for c in self._CALLS):
+                    continue
+                gated = any(
+                    isinstance(a, ast.If) for a in _ancestors(node)
+                    if any(b is fn for b in _ancestors(a))
+                )
+                if not gated:
+                    yield ctx.diag(
+                        node, self.id,
+                        f"`{dotted}` inside jit with no static gate "
+                        "compiles a host round-trip into every step; "
+                        "guard it with a debug flag `if` or return the "
+                        "value as a metrics leaf (obs/monitors.py)",
+                    )

@@ -208,6 +208,27 @@ class TrainConfig:
     # float32 before Adam (optimizer state stays float32). "float32"
     # (default) leaves the step byte-identical to the pre-existing one.
     grad_dtype: str = "float32"
+    # Run-health telemetry (pvraft_tpu/obs). When on, the jitted train
+    # step also returns in-jit numerics monitors (global + per-group grad
+    # norms, update/param ratio, per-GRU-iteration delta_flow norms, a
+    # NaN/Inf sentinel — obs/monitors.py) as an extra metrics leaf, the
+    # trainer runs trailing-window divergence detection on the loss, and
+    # a detector trip dumps the offending (batch, params, opt_state) to
+    # <exp_path>/snapshots/ for scripts/run_doctor.py replay. Off
+    # (default) leaves the train-step jaxpr byte-identical to the
+    # pre-telemetry step (test-gated, like scatter_free_vjp).
+    telemetry: bool = False
+    # Trailing window (healthy steps) of the loss z-score detector.
+    divergence_window: int = 64
+    # Trip when loss > mean + zscore * std over the trailing window;
+    # 0 disables the z-score trigger (the NaN/Inf sentinel stays armed).
+    divergence_zscore: float = 6.0
+    # Snapshots dumped per run at most (a persistently sick run must not
+    # fill the disk with near-identical state dumps).
+    max_snapshots: int = 3
+    # Stop training (raise) after the first snapshot instead of running
+    # on with corrupt state; off reproduces let-it-run behavior.
+    halt_on_divergence: bool = False
 
     def __post_init__(self):
         # Fail before training, not at the end-of-epoch save.
@@ -220,6 +241,16 @@ class TrainConfig:
             raise ValueError(
                 f"grad_dtype must be 'float32' or 'bfloat16', "
                 f"got {self.grad_dtype!r}"
+            )
+        if self.divergence_window < 2:
+            raise ValueError(
+                f"divergence_window must be >= 2, "
+                f"got {self.divergence_window}"
+            )
+        if self.divergence_zscore < 0:
+            raise ValueError(
+                f"divergence_zscore must be >= 0 (0 disables), "
+                f"got {self.divergence_zscore}"
             )
 
 

@@ -21,13 +21,13 @@ from pvraft_tpu.data.loader import device_prefetch
 from pvraft_tpu.engine.checkpoint import load_checkpoint, load_torch_checkpoint
 from pvraft_tpu.engine.steps import make_eval_step
 from pvraft_tpu.models import PVRaft, PVRaftRefine
+from pvraft_tpu.obs import RunTelemetry
 from pvraft_tpu.parallel.mesh import (
     device_batch,
     eval_scene_shard,
     make_mesh,
     replicate,
 )
-from pvraft_tpu.utils.logging import ExperimentLog
 
 
 def build_eval_dataset(cfg: Config):
@@ -47,7 +47,13 @@ class Evaluator:
     def __init__(self, cfg: Config, mesh=None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(n_seq=1)
-        self.log = ExperimentLog(cfg.exp_path, "TestAlone", cfg.data.dataset)
+        # Same unified sink as the Trainer: standalone eval runs emit a
+        # pvraft_events/v1 stream (header + final eval event) next to the
+        # text log, so run tooling reads one format for both entry points.
+        self.telemetry = RunTelemetry(cfg.exp_path, "TestAlone",
+                                      cfg.data.dataset)
+        self.log = self.telemetry.log
+        self.telemetry.emit_header(cfg, mode="eval")
         self.dataset = build_eval_dataset(cfg)
         # eval_batch scenes run concurrently, sharded over the mesh data
         # axis; 0 = one scene per data-axis device. Per-scene metrics keep
@@ -246,4 +252,13 @@ class Evaluator:
             f"{self.cfg.data.dataset} ({count} scenes): "
             + " ".join(f"{k}={v:.4f}" for k, v in sorted(means.items()))
         )
+        # Standalone eval has no epoch axis; -1 marks "not an epoch loop"
+        # in the event stream.
+        self.telemetry.emit_eval(
+            self.cfg.data.dataset, epoch=-1, scenes=count, metrics=means)
         return means
+
+    def close(self) -> None:
+        """Release the telemetry sink (event file, TB writer, log file
+        handlers) — same contract as ``Trainer.close``. Idempotent."""
+        self.telemetry.close()

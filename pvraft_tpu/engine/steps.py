@@ -46,9 +46,16 @@ def make_train_step(
     num_iters: int,
     donate: bool = True,
     grad_dtype: Optional[str] = None,
+    telemetry: bool = False,
 ) -> Callable:
     """Stage-1 training step: sequence loss over all iteration outputs
-    (``tools/engine.py:135-143``)."""
+    (``tools/engine.py:135-143``).
+
+    ``telemetry=True`` adds the in-jit numerics monitors
+    (``obs/monitors.py``) as a ``metrics["telemetry"]`` leaf — a few
+    fused reductions, no host callback; with the flag off the branch is
+    Python-level dead code and the jaxpr stays byte-identical
+    (test-gated, ``tests/test_obs.py``)."""
 
     def step(params, opt_state, batch):
         def loss_fn(p):
@@ -59,9 +66,20 @@ def make_train_step(
         (loss, flows), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         grads = maybe_cast_grads(grads, grad_dtype)
         updates, opt_state = tx.update(grads, opt_state, params)
+        if telemetry:
+            # params here are still PRE-update (the ratio's denominator).
+            # Off path: both branches are Python-dead, the statement
+            # sequence matches the pre-telemetry step exactly, and the
+            # jaxpr stays byte-identical.
+            from pvraft_tpu.obs.monitors import telemetry_leaves
+
+            tel = telemetry_leaves(params, grads, updates, loss, flows)
         params = optax.apply_updates(params, updates)
         epe = epe_train(flows[-1], batch["mask"], batch["flow"])
-        return params, opt_state, {"loss": loss, "epe": epe}
+        metrics = {"loss": loss, "epe": epe}
+        if telemetry:
+            metrics["telemetry"] = tel
+        return params, opt_state, metrics
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
@@ -72,10 +90,14 @@ def make_refine_train_step(
     num_iters: int,
     donate: bool = True,
     grad_dtype: Optional[str] = None,
+    telemetry: bool = False,
 ) -> Callable:
     """Stage-2 step: plain masked-L1 on the single refined flow
     (``tools/engine_refine.py:142``). The backbone is frozen by the model's
-    ``stop_gradient`` (plus the optimizer mask built in the Trainer)."""
+    ``stop_gradient`` (plus the optimizer mask built in the Trainer).
+
+    ``telemetry`` as in :func:`make_train_step`; the refine model returns
+    one flow, so there is no per-iteration ``delta_flow_norm`` leaf."""
 
     def step(params, opt_state, batch):
         def loss_fn(p):
@@ -85,9 +107,16 @@ def make_refine_train_step(
         (loss, flow), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         grads = maybe_cast_grads(grads, grad_dtype)
         updates, opt_state = tx.update(grads, opt_state, params)
+        if telemetry:
+            from pvraft_tpu.obs.monitors import telemetry_leaves
+
+            tel = telemetry_leaves(params, grads, updates, loss, flows=None)
         params = optax.apply_updates(params, updates)
         epe = epe_train(flow, batch["mask"], batch["flow"])
-        return params, opt_state, {"loss": loss, "epe": epe}
+        metrics = {"loss": loss, "epe": epe}
+        if telemetry:
+            metrics["telemetry"] = tel
+        return params, opt_state, metrics
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
@@ -102,6 +131,7 @@ def make_packed_train_step(
     donate: bool = True,
     refine: bool = False,
     grad_dtype: Optional[str] = None,
+    telemetry: bool = False,
 ):
     """``make_train_step`` with the train state crossing the step boundary
     as ONE flat buffer instead of a ~300-leaf pytree.
@@ -122,7 +152,8 @@ def make_packed_train_step(
     ``unravel(flat) -> (params, opt_state)`` for checkpointing.
     """
     step, flat0, unravel = _packed_step_fn(
-        model, tx, gamma, num_iters, params, opt_state, refine, grad_dtype
+        model, tx, gamma, num_iters, params, opt_state, refine, grad_dtype,
+        telemetry,
     )
     return (
         jax.jit(step, donate_argnums=(0,) if donate else ()),
@@ -132,7 +163,8 @@ def make_packed_train_step(
 
 
 def _packed_step_fn(model, tx, gamma, num_iters, params, opt_state, refine,
-                    grad_dtype: Optional[str] = None):
+                    grad_dtype: Optional[str] = None,
+                    telemetry: bool = False):
     """Unjitted packed-state step body shared by the single-step and the
     scan-fused multi-step factories. Returns ``(step, flat0, unravel)``."""
     from jax.flatten_util import ravel_pytree
@@ -153,10 +185,21 @@ def _packed_step_fn(model, tx, gamma, num_iters, params, opt_state, refine,
         (loss, last), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         grads = maybe_cast_grads(grads, grad_dtype)
         updates, opt_state = tx.update(grads, opt_state, params)
+        if telemetry:
+            # Packed-mode monitors: the loss aux carries only the LAST
+            # flow (the (T, ...) stack never crosses the packed
+            # boundary), so there is no delta_flow_norm leaf here; the
+            # rest matches make_train_step's telemetry exactly.
+            from pvraft_tpu.obs.monitors import telemetry_leaves
+
+            tel = telemetry_leaves(params, grads, updates, loss, flows=None)
         params = optax.apply_updates(params, updates)
         epe = epe_train(last, batch["mask"], batch["flow"])
+        metrics = {"loss": loss, "epe": epe}
+        if telemetry:
+            metrics["telemetry"] = tel
         new_flat, _ = ravel_pytree((params, opt_state))
-        return new_flat, {"loss": loss, "epe": epe}
+        return new_flat, metrics
 
     return step, flat0, unravel
 
@@ -172,6 +215,7 @@ def make_multistep_train_step(
     donate: bool = True,
     refine: bool = False,
     grad_dtype: Optional[str] = None,
+    telemetry: bool = False,
 ):
     """K packed train steps fused into ONE compiled program via
     ``lax.scan`` — one dispatch runs K genuine fwd+bwd+adam steps.
@@ -200,7 +244,8 @@ def make_multistep_train_step(
     if steps_per_dispatch < 1:
         raise ValueError("steps_per_dispatch must be >= 1")
     inner, flat0, unravel = _packed_step_fn(
-        model, tx, gamma, num_iters, params, opt_state, refine, grad_dtype
+        model, tx, gamma, num_iters, params, opt_state, refine, grad_dtype,
+        telemetry,
     )
 
     def step(flat, batches):

@@ -51,6 +51,8 @@ from pvraft_tpu.engine.steps import (
     make_train_step,
 )
 from pvraft_tpu.models import PVRaft, PVRaftRefine
+from pvraft_tpu.obs import DivergenceDetector, RunTelemetry, dump_snapshot
+from pvraft_tpu.obs.divergence import DivergenceHalt
 from pvraft_tpu.parallel.mesh import (
     device_batch,
     eval_scene_shard,
@@ -58,7 +60,6 @@ from pvraft_tpu.parallel.mesh import (
     replicate,
 )
 from pvraft_tpu.profiling import StepTimer, trace_context
-from pvraft_tpu.utils.logging import ExperimentLog, TBWriter
 
 
 def build_datasets(cfg: Config):
@@ -108,8 +109,45 @@ class Trainer:
                 "multi-host meshes"
             )
         self.mesh = mesh if mesh is not None else make_mesh(n_seq=1)
-        self.log = ExperimentLog(cfg.exp_path, "Train", cfg.data.dataset)
-        self.tb = TBWriter(os.path.join(cfg.exp_path, "logs"))
+        # One sink for everything the run reports: the pvraft_events/v1
+        # JSONL (process 0 only), TensorBoard scalars, and the text log
+        # all consume the same event stream (pvraft_tpu/obs/events.py).
+        self.telemetry = RunTelemetry(cfg.exp_path, "Train", cfg.data.dataset)
+        self.log = self.telemetry.log
+        self.tb = self.telemetry.tb
+        self.telemetry.emit_header(cfg, mode="train")
+        # Divergence detection + crash snapshots (TrainConfig.telemetry).
+        # Snapshots need host copies of the batch AND the pre-step train
+        # state; on multi-process meshes the local host batch is only this
+        # process's slice and np.asarray on the global batch raises, so
+        # snapshot capture is single-process only (detection and the
+        # in-jit monitors still run everywhere).
+        self.detector = (
+            DivergenceDetector(cfg.train.divergence_window,
+                               cfg.train.divergence_zscore)
+            if cfg.train.telemetry else None
+        )
+        self.snap_dir = os.path.join(cfg.exp_path, "snapshots")
+        self.snapshots_taken = 0
+        # Divergence events emitted so far: once state is corrupt every
+        # later step re-trips the sentinel, and a 100k-step run must not
+        # flood the event log with 100k identical records — after the cap
+        # the stream is muted (one notice), the FIRST trips stay visible.
+        self.trips_emitted = 0
+        self._snap_capable = jax.process_count() == 1
+        if cfg.train.telemetry and not self._snap_capable:
+            self.log.info(
+                "telemetry: divergence snapshots disabled on multi-process "
+                "runs (the offending global batch is not host-addressable); "
+                "monitors + detection stay on"
+            )
+        if cfg.train.telemetry and cfg.parallel.steps_per_dispatch > 1:
+            self.log.info(
+                "telemetry: divergence snapshots disabled with "
+                "steps_per_dispatch > 1 (per-step pre-states never exist "
+                "outside the fused scan); monitors + detection stay on at "
+                "dispatch granularity"
+            )
         self.best_epe = float("inf")
         self.begin_epoch = 0
         self.step_count = 0
@@ -216,6 +254,7 @@ class Trainer:
             self.train_step = make_refine_train_step(
                 self.model, tx, cfg.train.iters, donate=cfg.parallel.donate,
                 grad_dtype=cfg.train.grad_dtype,
+                telemetry=cfg.train.telemetry,
             )
             # Refine trains and evals at args.iters (engine_refine.py:199).
             self.eval_iters = cfg.train.iters
@@ -224,6 +263,7 @@ class Trainer:
                 self.model, tx, cfg.train.gamma, cfg.train.iters,
                 donate=cfg.parallel.donate,
                 grad_dtype=cfg.train.grad_dtype,
+                telemetry=cfg.train.telemetry,
             )
             # Stage-1 val/test run 32 iters (engine.py:197-198).
             self.eval_iters = cfg.train.eval_iters
@@ -253,6 +293,7 @@ class Trainer:
                 self.model, tx, cfg.train.gamma, cfg.train.iters,
                 self.params, self.opt_state, donate=cfg.parallel.donate,
                 refine=refine, grad_dtype=cfg.train.grad_dtype,
+                telemetry=cfg.train.telemetry,
             )
             # K>1: fuse K optimizer steps into one dispatch (lax.scan over
             # the packed step; engine/steps.py). The single packed_step
@@ -264,6 +305,7 @@ class Trainer:
                     cfg.parallel.steps_per_dispatch,
                     donate=cfg.parallel.donate, refine=refine,
                     grad_dtype=cfg.train.grad_dtype,
+                    telemetry=cfg.train.telemetry,
                 )
 
         self.ckpt_dir = os.path.join(cfg.exp_path, "checkpoints")
@@ -312,21 +354,118 @@ class Trainer:
     def _device_batch(self, batch: Dict[str, np.ndarray], on_indivisible="error"):
         return device_batch(batch, self.mesh, on_indivisible)
 
-    def training(self, epoch: int) -> Dict[str, float]:
-        cfg = self.cfg
-        timer = StepTimer()
-        # Per-step metrics stay on device until the epoch ends, so host
-        # logging never forces a dispatch sync inside the hot loop.
-        dev_metrics = []
-        profile = cfg.train.profile_dir if epoch == self.begin_epoch else None
-        steps_k = cfg.parallel.steps_per_dispatch if self.packed else 1
-        with trace_context(profile or None):
-            timer.start()
-            last = None
-            stream = device_prefetch(
-                self.train_loader.epoch(epoch), self._device_batch,
-                depth=cfg.parallel.device_prefetch,
+    # -- telemetry helpers ---------------------------------------------------
+
+    # Divergence events emitted per run before muting (snapshots are
+    # bounded separately by TrainConfig.max_snapshots).
+    MAX_DIVERGENCE_EVENTS = 10
+
+    def _capture_state(self):
+        """DEVICE-side copy of the CURRENT (pre-step) train state, in
+        whichever form the active mode carries it. A jnp copy dispatches
+        asynchronously — no host sync in the hot loop; the D2H transfer
+        happens only in ``_handle_trip`` when a snapshot is actually
+        written. The copy is ordered before the step's donation by data
+        dependence."""
+        if self.packed:
+            return ("flat", jnp.copy(self.flat))
+        return ("trees", (jax.tree_util.tree_map(jnp.copy, self.params),
+                          jax.tree_util.tree_map(jnp.copy, self.opt_state)))
+
+    def _state_trees(self, state):
+        """Fetch a ``_capture_state`` capture to numpy (params, opt_state)."""
+        kind, payload = state
+        if kind == "flat":
+            params, opt_state = self.unravel(payload)
+        else:
+            params, opt_state = payload
+        return (jax.tree_util.tree_map(np.asarray, params),
+                jax.tree_util.tree_map(np.asarray, opt_state))
+
+    def _handle_trip(self, trip, epoch: int, step: int, prev_state,
+                     host_batch) -> None:
+        """A divergence detector firing: snapshot (when the offending
+        batch + pre-step state were captured and the budget allows), then
+        the divergence event, then optionally halt."""
+        if self.trips_emitted >= self.MAX_DIVERGENCE_EVENTS:
+            return
+        self.trips_emitted += 1
+        snap_path = None
+        if (prev_state is not None and host_batch is not None
+                and self.snapshots_taken < self.cfg.train.max_snapshots):
+            params_np, opt_np = self._state_trees(prev_state)
+            snap_path = dump_snapshot(
+                self.snap_dir, host_batch, params_np, opt_np,
+                step=step, epoch=epoch, reason=trip.reason, loss=trip.loss,
+                cfg=self.cfg,
+                extra_meta={
+                    "zscore": trip.zscore,
+                    # The doctor rebuilds the optax chain exactly (the
+                    # schedule's state shape differs from a constant-lr
+                    # adam's, and restore is structural).
+                    "schedule": {
+                        "steps_per_epoch": max(1, len(self.train_loader)),
+                        "dataset_size": len(self.train_ds),
+                    },
+                },
             )
+            self.snapshots_taken += 1
+            self.telemetry.emit_snapshot(epoch, step, snap_path, trip.reason)
+        self.telemetry.emit_divergence(
+            epoch, step, trip.reason, trip.loss, zscore=trip.zscore,
+            snapshot=snap_path,
+        )
+        if self.trips_emitted == self.MAX_DIVERGENCE_EVENTS:
+            self.log.info(
+                f"telemetry: {self.trips_emitted} divergence events "
+                "emitted; muting further divergence reporting for this "
+                "run (state is likely persistently corrupt — see the "
+                "first snapshot)"
+            )
+        if self.cfg.train.halt_on_divergence:
+            # Caught by training(), which flushes the epoch's buffered
+            # step events before re-raising.
+            raise DivergenceHalt(
+                f"training diverged at epoch {epoch} step {step} "
+                f"({trip.reason}, loss={trip.loss})"
+                + (f"; snapshot dumped to {snap_path} — replay with "
+                   f"scripts/run_doctor.py" if snap_path else "")
+            )
+
+    @staticmethod
+    def _tel_records(m) -> Optional[list]:
+        """Per-optimizer-step host telemetry dicts from one metrics leaf
+        (fused dispatches carry ``(K,)`` sub-leaves; ``delta_flow_norm``
+        is a per-step ``(T,)`` vector and only exists unfused)."""
+        tel = m.get("telemetry")
+        if tel is None:
+            return None
+        host = jax.tree_util.tree_map(np.asarray, tel)
+        n = len(np.atleast_1d(np.asarray(m["loss"])))
+
+        def pick(v, j):
+            arr = np.asarray(v)
+            return (arr[j] if n > 1 else arr).tolist()
+
+        return [
+            {
+                key: (
+                    {g: pick(x, j) for g, x in value.items()}
+                    if isinstance(value, dict) else pick(value, j)
+                )
+                for key, value in host.items()
+            }
+            for j in range(n)
+        ]
+
+    def _train_loop(self, stream, steps_k, watch, tel_on, observe,
+                    dev_metrics) -> Optional[DivergenceHalt]:
+        """One epoch's dispatch loop (all three modes). A
+        ``halt_on_divergence`` trip is caught and RETURNED, not raised:
+        the caller flushes the epoch's buffered step events — the
+        trajectory leading into the trip — before re-raising."""
+        cfg = self.cfg
+        try:
             if steps_k > 1:
                 # Fused mode: stack K sharded batches (leading axis K; the
                 # batch-axis sharding propagates through the stack) and run
@@ -341,15 +480,23 @@ class Trainer:
                         pending = []
                         self.flat, m = self.multi_step(self.flat, batches)
                         dev_metrics.append(m)
-                        last = m
+                        if tel_on:
+                            observe(m, None, None)
                 for b in pending:
                     self.flat, m = self.packed_step(self.flat, b)
                     dev_metrics.append(m)
-                    last = m
+                    if tel_on:
+                        observe(m, None, None)
             else:
-                for b in stream:
+                for item in stream:
+                    hb, b = item if watch else (None, item)
+                    prev_state = (
+                        self._capture_state()
+                        if watch and self.snapshots_taken < cfg.train.max_snapshots
+                        else None
+                    )
                     if self.packed:
-                        if self.cfg.parallel.host_roundtrip:
+                        if cfg.parallel.host_roundtrip:
                             # Break the chained-executable dependency
                             # through the host: D2H+H2D of one flat buffer
                             # per step (identical floats; see
@@ -361,33 +508,119 @@ class Trainer:
                             self.params, self.opt_state, b
                         )
                     dev_metrics.append(m)
-                    last = m
-            if last is not None:
-                timer.stop(last["loss"])
+                    if tel_on:
+                        observe(m, hb, prev_state)
+        except DivergenceHalt as e:
+            return e
+        return None
+
+    def training(self, epoch: int) -> Dict[str, float]:
+        cfg = self.cfg
+        timer = StepTimer()
+        # Per-step metrics stay on device until the epoch ends, so host
+        # logging never forces a dispatch sync inside the hot loop —
+        # EXCEPT under telemetry, whose divergence check is one scalar
+        # fetch per step (the documented cost of arming it; the jitted
+        # program itself still has no host callback).
+        dev_metrics = []
+        profile = cfg.train.profile_dir if epoch == self.begin_epoch else None
+        steps_k = cfg.parallel.steps_per_dispatch if self.packed else 1
+        tel_on = self.detector is not None
+        # Snapshot capture additionally keeps the host batch and a
+        # device-side copy of the pre-step state per step (D2H happens
+        # only when a snapshot is written); single-dispatch modes only
+        # (a fused dispatch's intermediate states never exist outside
+        # the scan).
+        watch = tel_on and self._snap_capable and steps_k == 1
+        steps_seen = 0
+
+        def observe(m, host_batch, prev_state):
+            nonlocal steps_seen
+            losses = np.atleast_1d(np.asarray(m["loss"]))
+            nonfinite = np.atleast_1d(np.asarray(m["telemetry"]["nonfinite"]))
+            for j, loss in enumerate(losses):
+                steps_seen += 1
+                trip = self.detector.update(
+                    float(loss), int(nonfinite[min(j, len(nonfinite) - 1)])
+                )
+                if trip is not None:
+                    self._handle_trip(
+                        trip, epoch, self.step_count + steps_seen,
+                        prev_state, host_batch,
+                    )
+
+        if profile:
+            self.telemetry.emit_trace_window("start", profile, epoch)
+        with trace_context(profile or None):
+            timer.start()
+            prep = (
+                (lambda hb: (hb, self._device_batch(hb))) if watch
+                else self._device_batch
+            )
+            stream = device_prefetch(
+                self.train_loader.epoch(epoch), prep,
+                depth=cfg.parallel.device_prefetch,
+            )
+            halt = self._train_loop(
+                stream, steps_k, watch, tel_on, observe, dev_metrics)
+            if dev_metrics:
+                timer.stop(dev_metrics[-1]["loss"])
+        if profile:
+            self.telemetry.emit_trace_window("stop", profile, epoch)
         if self.packed:
             # Unpack once per epoch so eval and checkpointing see the
             # trained state without per-step tree traffic.
             self.params, self.opt_state = self.unravel(self.flat)
         # Fused-dispatch metric leaves arrive as (K,) arrays; flattening
-        # keeps per-optimizer-step logging identical in every mode.
-        losses = [
-            float(v)
-            for m in dev_metrics
-            for v in np.atleast_1d(np.asarray(m["loss"]))
-        ]
-        epes = [
-            float(v)
-            for m in dev_metrics
-            for v in np.atleast_1d(np.asarray(m["epe"]))
-        ]
-        n_steps = len(losses)
-        for i, (l, e) in enumerate(zip(losses, epes)):
-            self.tb.add_scalar("Train/Loss", l, self.step_count + i + 1)
-            self.tb.add_scalar("Train/EPE", e, self.step_count + i + 1)
+        # keeps per-optimizer-step logging identical in every mode. Each
+        # flattened step becomes one structured `step` event (which also
+        # writes the reference Train/Loss+Train/EPE TB scalars).
+        step_rows = []
+        for m in dev_metrics:
+            ls = np.atleast_1d(np.asarray(m["loss"]))
+            es = np.atleast_1d(np.asarray(m["epe"]))
+            tels = self._tel_records(m) or [None] * len(ls)
+            step_rows.extend(
+                (float(l), float(e), t) for l, e, t in zip(ls, es, tels)
+            )
+        n_steps = len(step_rows)
+        for i, (l, e, t) in enumerate(step_rows):
+            self.telemetry.emit_step(
+                epoch, self.step_count + i + 1, l, e, telemetry=t
+            )
         self.step_count += n_steps
-        mean_loss = float(np.mean(losses)) if losses else float("nan")
-        mean_epe = float(np.mean(epes)) if epes else float("nan")
-        step_ms = timer.mean / max(1, n_steps) * 1e3
+        if halt is not None:
+            # The step events above (the run's trajectory INTO the trip)
+            # are flushed; no epoch summary or checkpoint for a halted
+            # epoch — the state is corrupt by definition.
+            raise halt
+        if n_steps == 0:
+            # Empty epoch (loader yielded nothing): an explicit steps=0
+            # event instead of NaN means leaking into the TB/event
+            # history downstream dashboards aggregate over.
+            self.telemetry.emit_epoch_summary(epoch, steps=0)
+            self.log.info(f"epoch {epoch}: steps=0 (empty epoch — loader "
+                          "yielded no batches)")
+            save_checkpoint(
+                self.ckpt_dir,
+                jax.tree_util.tree_map(np.asarray, self.params),
+                jax.tree_util.tree_map(np.asarray, self.opt_state),
+                epoch,
+                cfg.train.checkpoint_interval,
+                backend=cfg.train.ckpt_backend,
+            )
+            self.telemetry.emit_checkpoint(epoch, "last", path=self.ckpt_dir)
+            return {"loss": float("nan"), "epe": float("nan"),
+                    "step_ms": 0.0}
+        losses = [l for l, _, _ in step_rows]
+        epes = [e for _, e, _ in step_rows]
+        mean_loss = float(np.mean(losses))
+        mean_epe = float(np.mean(epes))
+        step_ms = timer.mean / n_steps * 1e3
+        self.telemetry.emit_epoch_summary(
+            epoch, steps=n_steps, loss=mean_loss, epe=mean_epe,
+            step_ms=round(step_ms, 3),
+        )
         self.log.info(
             f"epoch {epoch}: loss {mean_loss:.4f} epe {mean_epe:.4f} "
             f"step {step_ms:.1f} ms"
@@ -400,6 +633,7 @@ class Trainer:
             cfg.train.checkpoint_interval,
             backend=cfg.train.ckpt_backend,
         )
+        self.telemetry.emit_checkpoint(epoch, "last", path=self.ckpt_dir)
         return {"loss": mean_loss, "epe": mean_epe, "step_ms": step_ms}
 
     def val_test(self, epoch: int, mode: str = "val") -> Dict[str, float]:
@@ -451,13 +685,9 @@ class Trainer:
             f"({count / max(eval_s, 1e-9):.1f} scenes/s, "
             f"eval_batch={self.eval_batch})"
         )
-        tag = mode.capitalize()
-        for k, t in [
-            ("loss", "Loss"), ("epe3d", "EPE"), ("outlier", "Outlier"),
-            ("acc3d_relax", "Acc3dRelax"), ("acc3d_strict", "Acc3dStrict"),
-        ]:
-            if k in means:
-                self.tb.add_scalar(f"{tag}/{t}", means[k], epoch)
+        # One structured eval event; the sink writes the reference
+        # <Mode>/<Metric> TB scalars from the same record.
+        self.telemetry.emit_eval(mode, epoch, count, means)
         self.log.info(f"{mode} epoch {epoch}: " + " ".join(
             f"{k}={v:.4f}" for k, v in sorted(means.items())
         ))
@@ -472,6 +702,7 @@ class Trainer:
                 best=True,
                 backend=self.cfg.train.ckpt_backend,
             )
+            self.telemetry.emit_checkpoint(epoch, "best", path=self.ckpt_dir)
         return means
 
     def fit(self) -> Dict[str, float]:
@@ -482,4 +713,11 @@ class Trainer:
             self.val_test(epoch, "val")
         result = self.val_test(self.cfg.train.num_epochs - 1, "test")
         wait_for_saves()  # async (orbax) writes must land before exit
+        self.close()
         return result
+
+    def close(self) -> None:
+        """Release the telemetry sink (event file, TB writer, log file
+        handlers). Idempotent; Trainers used beyond ``fit`` (tests, drive
+        scripts) should call this when done."""
+        self.telemetry.close()

@@ -1,0 +1,40 @@
+"""Run telemetry subsystem.
+
+Three pillars (ISSUE 3 / ROADMAP "run-health telemetry"):
+
+* :mod:`pvraft_tpu.obs.monitors` — in-jit numerics monitors returned as
+  an extra metrics leaf of the train step (``TrainConfig.telemetry``
+  gated; default-off jaxpr byte-identical);
+* :mod:`pvraft_tpu.obs.events` — the ``pvraft_events/v1`` structured
+  JSONL event log + validator, with :class:`RunTelemetry` fanning the
+  same stream out to TensorBoard and the text log;
+* :mod:`pvraft_tpu.obs.divergence` — trailing-window divergence
+  detection and ``pvraft_snapshot/v1`` crash snapshots, replayed by
+  ``scripts/run_doctor.py``.
+"""
+
+from pvraft_tpu.obs.divergence import (  # noqa: F401
+    SNAPSHOT_SCHEMA,
+    DivergenceDetector,
+    Trip,
+    dump_snapshot,
+    load_snapshot,
+)
+from pvraft_tpu.obs.events import (  # noqa: F401
+    EVENT_TYPES,
+    SCHEMA_VERSION,
+    EventLog,
+    RunTelemetry,
+    run_metadata,
+    sanitize,
+    validate_event,
+    validate_events,
+    validate_events_file,
+)
+from pvraft_tpu.obs.monitors import (  # noqa: F401
+    TELEMETRY_LEAVES,
+    delta_flow_norms,
+    global_norm,
+    nonfinite_count,
+    telemetry_leaves,
+)

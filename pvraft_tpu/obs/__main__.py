@@ -1,0 +1,42 @@
+"""CLI: validate ``pvraft_events/v1`` JSONL files.
+
+    python -m pvraft_tpu.obs validate artifacts/*.events.jsonl
+
+Exits non-zero on any schema problem — wired into ``scripts/lint.sh`` so
+a malformed committed event log fails the standing gate, same as a lint
+finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pvraft_tpu.obs.events import validate_events_file
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("python -m pvraft_tpu.obs")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    val = sub.add_parser(
+        "validate", help="validate pvraft_events/v1 JSONL files")
+    val.add_argument("paths", nargs="+", help="event-log files")
+    args = parser.parse_args(argv)
+
+    failed = 0
+    for path in args.paths:
+        try:
+            problems = validate_events_file(path)
+        except OSError as e:
+            problems = [f"{path}: unreadable: {e}"]
+        if problems:
+            failed += 1
+            for p in problems:
+                print(p, file=sys.stderr)
+        else:
+            print(f"{path}: OK")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

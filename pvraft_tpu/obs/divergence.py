@@ -1,0 +1,165 @@
+"""Divergence detection + crash snapshots.
+
+The trainer's last line of defense for the failure mode PAPER.md's
+iterative refinement makes expensive: a single bad batch or LR spike
+corrupts every downstream GRU iteration, and by the time ``Train/Loss``
+reads ``nan`` the state that produced it is gone (the step donates its
+input buffers). Two triggers:
+
+* ``nonfinite`` — the in-jit sentinel (``obs/monitors.py``) counted a
+  non-finite element in loss/grads/flows;
+* ``zscore`` — the loss sits more than ``zscore`` trailing standard
+  deviations above the trailing-window mean (an LR spike shows here
+  steps before anything overflows).
+
+On a trip the trainer dumps the OFFENDING step's inputs — the batch, and
+the params/opt_state as they were BEFORE the update — to
+``experiments/<exp>/snapshots/step_<n>/``; ``scripts/run_doctor.py``
+replays that exact step on CPU and names the first non-finite stage.
+
+Snapshot layout (``pvraft_snapshot/v1``):
+
+    step_<n>/meta.json    schema, step/epoch/reason, loss, config
+    step_<n>/batch.npz    pc1, pc2, flow, mask (host numpy)
+    step_<n>/state.msgpack  flax-serialized {params, opt_state}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from collections import deque
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+SNAPSHOT_SCHEMA = "pvraft_snapshot/v1"
+
+
+class DivergenceHalt(RuntimeError):
+    """Raised by the trainer when ``halt_on_divergence`` is set and the
+    detector trips. A distinct type so the training loop can flush the
+    epoch's buffered step events (the trajectory leading INTO the trip —
+    the context worth the most) before re-raising."""
+
+
+@dataclasses.dataclass
+class Trip:
+    """One detector firing."""
+
+    reason: str                  # "nonfinite" | "zscore"
+    loss: float
+    zscore: Optional[float] = None
+
+
+class DivergenceDetector:
+    """Trailing-window loss monitor (host-side, O(window) floats).
+
+    ``update(loss, nonfinite)`` is called once per optimizer step with
+    host scalars; returns a :class:`Trip` when the run looks unhealthy,
+    else None. The window only accumulates healthy steps, so one spike
+    does not inflate the trailing std and mask the next one."""
+
+    def __init__(self, window: int = 64, zscore: float = 6.0,
+                 min_steps: int = 8):
+        if window < 2:
+            raise ValueError(f"window must be >= 2, got {window}")
+        self.window = window
+        self.zscore = zscore
+        # Clamp to the window: a min_steps the deque can never reach
+        # would silently disarm the z-score trigger for the whole run.
+        self.min_steps = min(max(2, min_steps), window)
+        self.losses: deque = deque(maxlen=window)
+
+    def update(self, loss: float, nonfinite: int = 0) -> Optional[Trip]:
+        if nonfinite > 0 or not np.isfinite(loss):
+            return Trip(reason="nonfinite", loss=float(loss))
+        if self.zscore > 0 and len(self.losses) >= self.min_steps:
+            mean = float(np.mean(self.losses))
+            std = float(np.std(self.losses))
+            # A flat-lined window (std ~ 0) would make any wiggle an
+            # infinite z-score; floor the scale at 1e-6 of the mean.
+            scale = max(std, 1e-6 * max(abs(mean), 1.0))
+            z = (float(loss) - mean) / scale
+            if z > self.zscore:
+                return Trip(reason="zscore", loss=float(loss),
+                            zscore=round(z, 2))
+        self.losses.append(float(loss))
+        return None
+
+
+def dump_snapshot(
+    snap_dir: str,
+    batch: Dict[str, np.ndarray],
+    params: Any,
+    opt_state: Any,
+    *,
+    step: int,
+    epoch: int,
+    reason: str,
+    loss: float,
+    cfg=None,
+    extra_meta: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write one ``pvraft_snapshot/v1`` directory; returns its path.
+
+    ``params``/``opt_state`` must be host numpy trees captured BEFORE the
+    offending update (the state the replay needs); ``batch`` the host
+    batch that triggered it."""
+    from flax import serialization
+
+    from pvraft_tpu.obs.events import sanitize
+
+    out = os.path.join(snap_dir, f"step_{step:07d}")
+    os.makedirs(out, exist_ok=True)
+    np.savez(os.path.join(out, "batch.npz"),
+             **{k: np.asarray(v) for k, v in batch.items()})
+    # to_state_dict: optax states are NamedTuple chains msgpack cannot
+    # pack; the state-dict form round-trips via from_state_dict against a
+    # freshly built optimizer state (same move as engine/checkpoint.py).
+    payload = {
+        "params": serialization.to_state_dict(params),
+        "opt_state": serialization.to_state_dict(opt_state),
+    }
+    tmp = os.path.join(out, "state.msgpack.tmp")
+    with open(tmp, "wb") as f:
+        f.write(serialization.msgpack_serialize(payload))
+    os.replace(tmp, os.path.join(out, "state.msgpack"))
+    meta = {
+        "schema": SNAPSHOT_SCHEMA,
+        "step": step,
+        "epoch": epoch,
+        "reason": reason,
+        "loss": sanitize(float(loss)),
+        "config": (
+            sanitize(dataclasses.asdict(cfg))
+            if dataclasses.is_dataclass(cfg) else sanitize(cfg or {})
+        ),
+    }
+    if extra_meta:
+        meta.update(sanitize(extra_meta))
+    with open(os.path.join(out, "meta.json"), "w", encoding="utf-8") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    return out
+
+
+def load_snapshot(path: str):
+    """Load a snapshot dir -> (meta, batch dict, params, opt_state).
+
+    ``opt_state`` comes back as the raw deserialized pytree (dicts/lists
+    of numpy arrays) — structurally enough for the doctor's numerics
+    replay; rebuilding the exact optax NamedTuple chain is the caller's
+    job when it wants to run the real optimizer update."""
+    from flax import serialization
+
+    with open(os.path.join(path, "meta.json"), "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    if meta.get("schema") != SNAPSHOT_SCHEMA:
+        raise ValueError(
+            f"{path}: schema {meta.get('schema')!r} != {SNAPSHOT_SCHEMA!r}")
+    with np.load(os.path.join(path, "batch.npz")) as z:
+        batch = {k: z[k] for k in z.files}
+    with open(os.path.join(path, "state.msgpack"), "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    return meta, batch, payload["params"], payload["opt_state"]

@@ -1,0 +1,409 @@
+"""Structured run events: the ``pvraft_events/v1`` JSONL schema.
+
+One run = one append-only JSONL file whose first record is a
+``run_header`` (config + git + device metadata) followed by typed events.
+The schema is the machine-readable ledger of run health: every consumer
+— TensorBoard scalars, the text log, the divergence doctor, future
+dashboards — reads the SAME stream instead of each subsystem logging its
+own private format (``RunTelemetry`` below is that fan-out).
+
+Schema (every record):
+
+    {"schema": "pvraft_events/v1", "type": <event type>, "time": <unix>,
+     "seq": <monotonic per-file index>, ...type-specific fields}
+
+Event types and their required fields:
+
+    run_header  run_id, mode, config, git{commit,dirty}, devices
+                {platform, device_count, process_index, process_count},
+                versions{jax}
+    step        epoch, step, loss, epe        [+ telemetry{...}]
+    epoch_summary  epoch, steps               [+ loss, epe, step_ms]
+    eval        mode, epoch, scenes, metrics
+    checkpoint  epoch, kind                   [+ path]
+    trace_window  action ("start"|"stop"), trace_dir, epoch
+    divergence  epoch, step, reason ("nonfinite"|"zscore"), loss
+                [+ zscore, snapshot]
+    snapshot    epoch, step, path, reason
+
+Non-finite floats are encoded as the strings ``"NaN"``/``"Infinity"``/
+``"-Infinity"`` (JSON has no spelling for them; a diverging run's whole
+point is to record them faithfully). ``validate_events`` accepts those
+spellings anywhere a number is required.
+
+Writing is process-0-only under multi-process JAX (every process calls
+``emit``; non-zero ranks no-op) so a pod run produces ONE event file, not
+``process_count`` interleaved ones.
+
+Validate from the command line (wired into ``scripts/lint.sh``):
+
+    python -m pvraft_tpu.obs validate artifacts/*.events.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, IO, List, Optional
+
+SCHEMA_VERSION = "pvraft_events/v1"
+
+# type -> (required fields, optional fields). "seq"/"schema"/"type"/"time"
+# are stamped by EventLog and required on every record.
+EVENT_TYPES: Dict[str, tuple] = {
+    "run_header": (
+        ("run_id", "mode", "config", "git", "devices", "versions"), ()),
+    "step": (("epoch", "step", "loss", "epe"), ("telemetry",)),
+    "epoch_summary": (("epoch", "steps"), ("loss", "epe", "step_ms")),
+    "eval": (("mode", "epoch", "scenes", "metrics"), ()),
+    "checkpoint": (("epoch", "kind"), ("path",)),
+    "trace_window": (("action", "trace_dir", "epoch"), ()),
+    "divergence": (("epoch", "step", "reason", "loss"),
+                   ("zscore", "snapshot")),
+    "snapshot": (("epoch", "step", "path", "reason"), ()),
+}
+
+_BASE_FIELDS = ("schema", "type", "time", "seq")
+
+# Fields that must hold a number (or the non-finite string spellings).
+_NUMERIC_FIELDS = {
+    "step": ("epoch", "step", "loss", "epe"),
+    "epoch_summary": ("epoch", "steps"),
+    "eval": ("epoch", "scenes"),
+    "checkpoint": ("epoch",),
+    "trace_window": ("epoch",),
+    "divergence": ("epoch", "step", "loss"),
+    "snapshot": ("epoch", "step"),
+}
+
+_NONFINITE_STRINGS = ("NaN", "Infinity", "-Infinity")
+
+
+def sanitize(value: Any) -> Any:
+    """Make a value JSON-strict: non-finite floats become their string
+    spellings, numpy scalars/arrays become python numbers/lists, dicts
+    and lists recurse. (``json.dumps`` would happily emit bare ``NaN``,
+    which is NOT valid JSON and breaks strict parsers downstream.)"""
+    if isinstance(value, dict):
+        return {str(k): sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [sanitize(v) for v in value]
+    if hasattr(value, "tolist") and not isinstance(value, (str, bytes)):
+        return sanitize(value.tolist())
+    if isinstance(value, float):
+        if value != value:
+            return "NaN"
+        if value == float("inf"):
+            return "Infinity"
+        if value == float("-inf"):
+            return "-Infinity"
+    return value
+
+
+def _is_number(value: Any) -> bool:
+    if isinstance(value, bool):
+        return False
+    if isinstance(value, (int, float)):
+        return True
+    return isinstance(value, str) and value in _NONFINITE_STRINGS
+
+
+def validate_event(record: Any, seq: Optional[int] = None) -> List[str]:
+    """Schema problems of one event record ([] = valid)."""
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, not an object"]
+    problems: List[str] = []
+    for key in _BASE_FIELDS:
+        if key not in record:
+            problems.append(f"missing base field {key!r}")
+    if problems:
+        return problems
+    if record["schema"] != SCHEMA_VERSION:
+        problems.append(
+            f"schema {record['schema']!r} != {SCHEMA_VERSION!r}")
+    etype = record["type"]
+    if etype not in EVENT_TYPES:
+        problems.append(f"unknown event type {etype!r}")
+        return problems
+    if not _is_number(record["time"]):
+        problems.append(f"time {record['time']!r} is not a number")
+    if seq is not None and record["seq"] != seq:
+        problems.append(f"seq {record['seq']!r} != expected {seq}")
+    required, optional = EVENT_TYPES[etype]
+    for key in required:
+        if key not in record:
+            problems.append(f"{etype}: missing field {key!r}")
+    known = set(_BASE_FIELDS) | set(required) | set(optional)
+    for key in record:
+        if key not in known:
+            problems.append(f"{etype}: unknown field {key!r}")
+    for key in _NUMERIC_FIELDS.get(etype, ()):
+        if key in record and not _is_number(record[key]):
+            problems.append(
+                f"{etype}: field {key!r}={record[key]!r} is not a number")
+    if etype == "divergence" and record.get("reason") not in (
+            "nonfinite", "zscore"):
+        problems.append(
+            f"divergence: reason {record.get('reason')!r} must be "
+            "'nonfinite' or 'zscore'")
+    if etype == "trace_window" and record.get("action") not in (
+            "start", "stop"):
+        problems.append(
+            f"trace_window: action {record.get('action')!r} must be "
+            "'start' or 'stop'")
+    return problems
+
+
+def validate_events(lines: List[str], path: str = "<events>") -> List[str]:
+    """Problems of a whole event stream ([] = valid): every line strict
+    JSON + per-record schema, first record a ``run_header``, ``seq``
+    strictly sequential from 0."""
+    problems: List[str] = []
+    records: List[Any] = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            problems.append(f"{path}:{i + 1}: blank line")
+            continue
+        try:
+            # parse_constant rejects the bare NaN/Infinity tokens that a
+            # naive json.dumps emits — those are NOT valid JSON and the
+            # writer must use the string spellings instead.
+            records.append(json.loads(
+                line, parse_constant=lambda c: (_ for _ in ()).throw(
+                    ValueError(f"bare {c} token (invalid strict JSON)"))))
+        except ValueError as e:
+            problems.append(f"{path}:{i + 1}: not strict JSON: {e}")
+            records.append(None)
+    if not records:
+        problems.append(f"{path}: empty event stream")
+        return problems
+    if isinstance(records[0], dict) and records[0].get("type") != "run_header":
+        problems.append(
+            f"{path}:1: first record must be run_header, got "
+            f"{records[0].get('type')!r}")
+    for i, record in enumerate(records):
+        if record is None:
+            continue
+        for p in validate_event(record, seq=i):
+            problems.append(f"{path}:{i + 1}: {p}")
+    return problems
+
+
+def validate_events_file(path: str) -> List[str]:
+    with open(path, "r", encoding="utf-8") as f:
+        return validate_events(f.read().splitlines(), path=path)
+
+
+def _git_metadata(repo_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Best-effort {commit, dirty}; never raises (training must not fail
+    because the run dir is not a git checkout)."""
+    import subprocess
+
+    cwd = repo_dir or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=cwd, capture_output=True,
+            text=True, timeout=5, check=True).stdout.strip()
+        dirty = bool(subprocess.run(
+            ["git", "status", "--porcelain"], cwd=cwd, capture_output=True,
+            text=True, timeout=5, check=True).stdout.strip())
+        return {"commit": commit, "dirty": dirty}
+    except Exception:
+        return {"commit": None, "dirty": None}
+
+
+def run_metadata(cfg=None, mode: str = "train") -> Dict[str, Any]:
+    """The run_header payload: config, git, devices, versions."""
+    import dataclasses
+
+    import jax
+
+    config = (
+        sanitize(dataclasses.asdict(cfg)) if dataclasses.is_dataclass(cfg)
+        else sanitize(cfg or {})
+    )
+    return {
+        "run_id": f"{mode}-{os.getpid()}-{int(time.time())}",
+        "mode": mode,
+        "config": config,
+        "git": _git_metadata(),
+        "devices": {
+            "platform": jax.devices()[0].platform,
+            "device_count": jax.device_count(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        },
+        "versions": {"jax": jax.__version__},
+    }
+
+
+class EventLog:
+    """Append-only ``pvraft_events/v1`` JSONL writer.
+
+    Process-0-only by default: non-zero ranks construct fine and every
+    ``emit`` is a no-op, so callers never branch on rank. Each record is
+    validated on emit — an invalid event is a programmer error and raises
+    immediately rather than poisoning the file."""
+
+    def __init__(self, path: str, enabled: Optional[bool] = None):
+        if enabled is None:
+            import jax
+
+            enabled = jax.process_index() == 0
+        self.path = path
+        self.enabled = bool(enabled)
+        self.seq = 0
+        self._f: Optional[IO[str]] = None
+        if self.enabled:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            needs_newline = False
+            if os.path.exists(path) and os.path.getsize(path) > 0:
+                # Resumed run (train.py --resume reuses the exp dir):
+                # continue the seq chain where the previous process left
+                # off, or appended records would fail their own
+                # validator ('seq != expected'). A crash can leave a
+                # partial final line (no trailing newline); terminate it
+                # so the new records don't merge onto it — that one
+                # truncated record stays invalid (its bytes are gone),
+                # but the seq chain and every later record stay clean.
+                with open(path, "rb") as f:
+                    data = f.read()
+                newlines = data.count(b"\n")
+                needs_newline = not data.endswith(b"\n")
+                self.seq = newlines + (1 if needs_newline else 0)
+            self._f = open(path, "a", encoding="utf-8")
+            if needs_newline:
+                self._f.write("\n")
+                self._f.flush()
+
+    def emit(self, etype: str, **fields: Any) -> Optional[Dict[str, Any]]:
+        if not self.enabled:
+            return None
+        record: Dict[str, Any] = {
+            "schema": SCHEMA_VERSION,
+            "type": etype,
+            "time": round(time.time(), 3),
+            "seq": self.seq,
+        }
+        record.update(sanitize(fields))
+        problems = validate_event(record, seq=self.seq)
+        if problems:
+            raise ValueError(
+                f"invalid {etype!r} event: {problems} (record={record!r})")
+        assert self._f is not None
+        self._f.write(json.dumps(record, sort_keys=True) + "\n")
+        self._f.flush()
+        self.seq += 1
+        return record
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+        self.enabled = False
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RunTelemetry:
+    """The unified run sink: ONE ``emit`` call per happening, fanned out
+    to the structured event log (JSONL), TensorBoard scalars, and the
+    text ``ExperimentLog`` — the pre-existing consumers re-plumbed over
+    the event stream instead of each being called ad hoc.
+
+    TB tag mapping (reference tag names preserved, ``tools/engine.py:
+    149-158,209-234``): ``step`` events write ``Train/Loss``+``Train/EPE``
+    at the global step; ``eval`` events write ``<Mode>/<Metric>`` at the
+    epoch; telemetry sub-leaves write under ``telemetry/...``."""
+
+    # eval metric key -> reference TB tag suffix.
+    _EVAL_TAGS = (
+        ("loss", "Loss"), ("epe3d", "EPE"), ("outlier", "Outlier"),
+        ("acc3d_relax", "Acc3dRelax"), ("acc3d_strict", "Acc3dStrict"),
+    )
+
+    def __init__(self, exp_path: str, mode: str = "Train",
+                 dataset: str = "", events_name: Optional[str] = None):
+        from pvraft_tpu.utils.logging import ExperimentLog, TBWriter
+
+        self.log = ExperimentLog(exp_path, mode, dataset)
+        self.tb = TBWriter(os.path.join(exp_path, "logs"))
+        name = events_name or f"{mode.lower()}.events.jsonl"
+        self.events = EventLog(os.path.join(exp_path, name))
+
+    def info(self, msg: str) -> None:
+        self.log.info(msg)
+
+    def emit_header(self, cfg=None, mode: str = "train") -> None:
+        self.events.emit("run_header", **run_metadata(cfg, mode=mode))
+
+    def emit_step(self, epoch: int, step: int, loss: float, epe: float,
+                  telemetry: Optional[Dict[str, Any]] = None) -> None:
+        fields: Dict[str, Any] = {
+            "epoch": epoch, "step": step, "loss": loss, "epe": epe}
+        if telemetry is not None:
+            fields["telemetry"] = telemetry
+        self.events.emit("step", **fields)
+        self.tb.add_scalar("Train/Loss", loss, step)
+        self.tb.add_scalar("Train/EPE", epe, step)
+        if telemetry is not None:
+            for key in ("grad_norm", "update_ratio"):
+                if key in telemetry:
+                    self.tb.add_scalar(
+                        f"telemetry/{key}", telemetry[key], step)
+
+    def emit_epoch_summary(self, epoch: int, steps: int, **extra) -> None:
+        self.events.emit("epoch_summary", epoch=epoch, steps=steps, **extra)
+
+    def emit_eval(self, mode: str, epoch: int, scenes: int,
+                  metrics: Dict[str, float]) -> None:
+        self.events.emit("eval", mode=mode, epoch=epoch, scenes=scenes,
+                         metrics=metrics)
+        tag = mode.capitalize()
+        for key, suffix in self._EVAL_TAGS:
+            if key in metrics:
+                self.tb.add_scalar(f"{tag}/{suffix}", metrics[key], epoch)
+
+    def emit_checkpoint(self, epoch: int, kind: str,
+                        path: Optional[str] = None) -> None:
+        fields: Dict[str, Any] = {"epoch": epoch, "kind": kind}
+        if path is not None:
+            fields["path"] = path
+        self.events.emit("checkpoint", **fields)
+
+    def emit_trace_window(self, action: str, trace_dir: str,
+                          epoch: int) -> None:
+        self.events.emit("trace_window", action=action,
+                         trace_dir=trace_dir, epoch=epoch)
+
+    def emit_divergence(self, epoch: int, step: int, reason: str,
+                        loss: float, zscore: Optional[float] = None,
+                        snapshot: Optional[str] = None) -> None:
+        fields: Dict[str, Any] = {
+            "epoch": epoch, "step": step, "reason": reason, "loss": loss}
+        if zscore is not None:
+            fields["zscore"] = zscore
+        if snapshot is not None:
+            fields["snapshot"] = snapshot
+        self.events.emit("divergence", **fields)
+        self.log.info(
+            f"DIVERGENCE at epoch {epoch} step {step}: {reason} "
+            f"(loss={loss})" + (f" snapshot={snapshot}" if snapshot else ""))
+
+    def emit_snapshot(self, epoch: int, step: int, path: str,
+                      reason: str) -> None:
+        self.events.emit("snapshot", epoch=epoch, step=step, path=path,
+                         reason=reason)
+
+    def close(self) -> None:
+        self.events.close()
+        self.tb.close()
+        self.log.close()

@@ -1,0 +1,124 @@
+"""In-jit numerics monitors.
+
+The instrument behind ``TrainConfig.telemetry``: a pure function of the
+train step's intermediates (pre-update params, grads, optax updates, the
+per-iteration flow stack, the loss) returning a small pytree of scalars
+that rides back to the host as one extra metrics leaf. Everything here is
+ordinary traced jnp — no ``jax.debug.print``/``callback``, no host sync —
+so the hot loop's dispatch pipeline is untouched and the only cost is the
+handful of reductions XLA fuses into the step.
+
+Gating discipline (same contract as ``scatter_free_vjp`` and the
+``@shapecheck`` layer): the step factories call :func:`telemetry_leaves`
+only when the flag is on, so the default-off jaxpr is byte-identical to
+the pre-telemetry step (test-gated in ``tests/test_obs.py`` and audited
+by ``analysis/audit.py:engine.train_step[telemetry_off_jaxpr]``).
+
+What is monitored and why (PAPER.md: the GRU refinement is iterative, so
+one bad step corrupts every later iteration):
+
+* ``grad_norm`` / ``param_norm`` / ``update_ratio`` — the classic LR
+  health triple: update/param ratio drifting above ~1e-2 is the earliest
+  visible symptom of an LR spike, well before the loss moves.
+* ``grad_norm_by_group`` — global l2 norm per top-level param group
+  (feature_extractor, context_extractor, update_iter, ...): names WHICH
+  subnetwork blew up, not just that something did.
+* ``delta_flow_norm`` — RMS norm of each GRU iteration's flow update
+  ``(T,)``: healthy runs contract (later iterations refine less);
+  divergence shows as the tail growing instead.
+* ``nonfinite`` — count of non-finite elements across loss + grads +
+  flows: the sentinel the trainer's divergence detector trips on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Leaf names of the telemetry sub-dict every monitored step returns
+# (``grad_norm_by_group`` is itself a dict keyed by param-group name).
+TELEMETRY_LEAVES = (
+    "grad_norm", "param_norm", "update_ratio", "grad_norm_by_group",
+    "delta_flow_norm", "nonfinite",
+)
+
+_EPS = 1e-12
+
+
+def global_norm(tree: Any) -> jnp.ndarray:
+    """Global l2 norm over every leaf of a pytree, accumulated in f32
+    (bf16 leaves must not square-overflow the reduction)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    )
+
+
+def nonfinite_count(*trees: Any) -> jnp.ndarray:
+    """Total count of non-finite elements across all leaves of all trees
+    (int32; 0 on a healthy step)."""
+    total = jnp.zeros((), jnp.int32)
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if not jnp.issubdtype(leaf.dtype, jnp.floating):
+                continue
+            total = total + jnp.sum(
+                (~jnp.isfinite(leaf)).astype(jnp.int32)
+            )
+    return total
+
+
+def _param_groups(tree: Any) -> Dict[str, Any]:
+    """Top-level named param groups of a flax variable dict: the children
+    of the ``params`` collection when present, else the tree's own
+    top-level children, else the whole tree as one group."""
+    if isinstance(tree, dict) and "params" in tree:
+        tree = tree["params"]
+    if isinstance(tree, dict) and tree:
+        return dict(tree)
+    return {"all": tree}
+
+
+def delta_flow_norms(flows: jnp.ndarray) -> jnp.ndarray:
+    """Per-GRU-iteration RMS update norm, shape ``(T,)``.
+
+    ``flows`` is the stage-1 stacked ``(T, B, N, 3)`` output; iteration
+    t's update is ``flows[t] - flows[t-1]`` (the first iteration starts
+    from zero flow, ``models/raft.py`` carry init)."""
+    prev = jnp.concatenate([jnp.zeros_like(flows[:1]), flows[:-1]], axis=0)
+    delta = (flows - prev).astype(jnp.float32)
+    return jnp.sqrt(jnp.mean(jnp.square(delta), axis=(1, 2, 3)))
+
+
+def telemetry_leaves(
+    params: Any,
+    grads: Any,
+    updates: Any,
+    loss: jnp.ndarray,
+    flows: Optional[jnp.ndarray] = None,
+) -> Dict[str, Any]:
+    """The in-jit telemetry pytree (see module docstring for the leaves).
+
+    ``params`` must be the PRE-update params (the ratio denominates the
+    state the update is applied to); ``flows`` is the stacked stage-1
+    iteration output, or None on the refine step (single flow — there is
+    no iteration trajectory to monitor)."""
+    pnorm = global_norm(params)
+    out: Dict[str, Any] = {
+        "grad_norm": global_norm(grads),
+        "param_norm": pnorm,
+        "update_ratio": global_norm(updates) / (pnorm + _EPS),
+        "grad_norm_by_group": {
+            name: global_norm(sub)
+            for name, sub in sorted(_param_groups(grads).items())
+        },
+    }
+    monitored = [loss, grads] if flows is None else [loss, grads, flows]
+    if flows is not None:
+        out["delta_flow_norm"] = delta_flow_norms(flows)
+    out["nonfinite"] = nonfinite_count(*monitored)
+    return out

@@ -35,8 +35,22 @@ class TBWriter:
             self._writer.add_scalar(tag, float(value), step)
 
     def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
+        """Flush + release the backend writer. Exception-safe and
+        idempotent: a writer whose flush dies mid-close (disk full,
+        backend already torn down at interpreter exit) must not mask the
+        error that actually killed the run — the in-memory history stays
+        inspectable either way."""
+        writer, self._writer = self._writer, None
+        if writer is None:
+            return
+        try:
+            writer.flush()
+        except Exception:
+            pass
+        try:
+            writer.close()
+        except Exception:
+            pass
 
 
 class ExperimentLog:
@@ -68,3 +82,19 @@ class ExperimentLog:
 
     def info(self, msg: str) -> None:
         self.logger.info(msg)
+
+    def close(self) -> None:
+        """Release this experiment's file handlers.
+
+        Loggers are process-global (``logging.getLogger`` caches by
+        name), so without this every Trainer/Evaluator instantiation in
+        a long-lived process — pytest sessions most of all — leaks an
+        open file descriptor per experiment dir. Only handlers attached
+        by this class are removed; idempotent."""
+        for handler in list(self.logger.handlers):
+            if isinstance(handler, logging.FileHandler):
+                self.logger.removeHandler(handler)
+                try:
+                    handler.close()
+                except Exception:
+                    pass

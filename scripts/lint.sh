@@ -11,3 +11,15 @@ python -m pvraft_tpu.analysis lint pvraft_tpu/ tests/
 echo "== graftlint: eval_shape trace-compat audit (zero-FLOP abstract traces)"
 # CPU pin: shape propagation needs no accelerator and must not grab one.
 JAX_PLATFORMS=cpu python -m pvraft_tpu.analysis trace
+
+echo "== pvraft_events/v1: committed event logs validate"
+# Any event log shipped as evidence (artifacts/) plus the golden test
+# fixture must parse against the schema — a drifted writer fails the
+# gate here, before a TPU run produces unreadable telemetry.
+event_logs=$(ls artifacts/*.events.jsonl tests/fixtures/*.events.jsonl 2>/dev/null || true)
+if [ -n "$event_logs" ]; then
+  # shellcheck disable=SC2086 -- word splitting over the file list is intended
+  python -m pvraft_tpu.obs validate $event_logs
+else
+  echo "(no committed event logs)"
+fi

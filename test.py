@@ -98,6 +98,7 @@ def main(argv=None) -> None:
     if a.torch_weights:
         ev.load_torch(a.torch_weights)
     means = ev.run(dump_dir=a.dump_dir)
+    ev.close()
     print({k: round(v, 4) for k, v in sorted(means.items())})
 
 

@@ -55,6 +55,13 @@ RED = {
         "    assert x > 0\n"
         "    return x\n"
     ),
+    "GL009": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    jax.debug.print(\"x={x}\", x=x)\n"  # format string: GL007 quiet
+        "    return x\n"
+    ),
 }
 
 # The same code, corrected (not suppressed): the rule must NOT fire.
@@ -103,6 +110,17 @@ GREEN = {
         "def f(x):\n"
         "    assert x.shape[0] > 0\n"     # static shape assert: fine
         "    return x\n"
+    ),
+    "GL009": (
+        "import jax\n"
+        "DEBUG = False\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if DEBUG:\n"                 # static gate: trace-time dead
+        "        jax.debug.print(\"x={x}\", x=x)\n"
+        "    return x\n"
+        "def host(x):\n"
+        "    jax.debug.print(\"x={x}\", x=x)\n"  # outside jit: fine
     ),
 }
 

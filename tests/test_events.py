@@ -1,0 +1,200 @@
+"""pvraft_events/v1: schema validator red/green per event type, the
+non-finite float encoding, the EventLog writer discipline, the committed
+golden fixture, and the CLI gate."""
+
+import json
+import os
+
+import pytest
+
+from pvraft_tpu.obs import (
+    EventLog,
+    RunTelemetry,
+    run_metadata,
+    sanitize,
+    validate_event,
+    validate_events,
+    validate_events_file,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "golden_run.events.jsonl")
+
+
+# --- sanitize ---------------------------------------------------------------
+
+
+def test_sanitize_nonfinite_and_numpy():
+    import numpy as np
+
+    out = sanitize({
+        "a": float("nan"), "b": float("inf"), "c": float("-inf"),
+        "d": np.float32(1.5), "e": np.arange(3), "f": [float("nan")],
+    })
+    assert out == {"a": "NaN", "b": "Infinity", "c": "-Infinity",
+                   "d": 1.5, "e": [0, 1, 2], "f": ["NaN"]}
+    # The result must be STRICT json (no bare NaN tokens).
+    assert "NaN" not in json.dumps(out).replace('"NaN"', "")
+
+
+# --- per-record validation --------------------------------------------------
+
+
+def _record(etype, seq=0, **fields):
+    base = {"schema": "pvraft_events/v1", "type": etype, "time": 1.0,
+            "seq": seq}
+    base.update(fields)
+    return base
+
+
+def test_validate_event_green():
+    assert validate_event(
+        _record("step", epoch=0, step=1, loss=0.5, epe=1.0), seq=0) == []
+    assert validate_event(
+        _record("step", epoch=0, step=1, loss="NaN", epe="Infinity"),
+        seq=0) == []  # non-finite spellings are numbers
+    assert validate_event(
+        _record("epoch_summary", epoch=0, steps=0), seq=0) == []
+
+
+@pytest.mark.parametrize("mutate, fragment", [
+    (lambda r: r.pop("schema"), "missing base field"),
+    (lambda r: r.update(schema="pvraft_events/v0"), "!="),
+    (lambda r: r.update(type="nope"), "unknown event type"),
+    (lambda r: r.pop("loss"), "missing field 'loss'"),
+    (lambda r: r.update(loss="oops"), "not a number"),
+    (lambda r: r.update(extra_field=1), "unknown field"),
+    (lambda r: r.update(seq=7), "seq"),
+])
+def test_validate_event_red(mutate, fragment):
+    record = _record("step", epoch=0, step=1, loss=0.5, epe=1.0)
+    mutate(record)
+    problems = validate_event(record, seq=0)
+    assert problems and any(fragment in p for p in problems), problems
+
+
+def test_validate_event_enum_fields():
+    bad = _record("divergence", epoch=0, step=1, reason="bored", loss=1.0)
+    assert any("reason" in p for p in validate_event(bad, seq=0))
+    bad = _record("trace_window", action="pause", trace_dir="/x", epoch=0)
+    assert any("action" in p for p in validate_event(bad, seq=0))
+
+
+# --- stream-level validation ------------------------------------------------
+
+
+def test_validate_events_header_first_and_seq():
+    lines = [json.dumps(_record("step", seq=0, epoch=0, step=1, loss=1.0,
+                                epe=1.0))]
+    problems = validate_events(lines)
+    assert any("first record must be run_header" in p for p in problems)
+
+
+def test_validate_events_rejects_bare_nan_token():
+    # json.dumps happily writes bare NaN — which is NOT strict JSON and
+    # exactly what sanitize() exists to prevent.
+    line = json.dumps(_record("step", epoch=0, step=1,
+                              loss=float("nan"), epe=1.0))
+    assert "NaN" in line
+    problems = validate_events([line])
+    assert any("not strict JSON" in p for p in problems)
+
+
+def test_validate_events_blank_line_and_empty():
+    assert any("empty" in p for p in validate_events([]))
+    problems = validate_events(["", ""])
+    assert any("blank line" in p for p in problems)
+
+
+# --- EventLog writer --------------------------------------------------------
+
+
+def test_eventlog_writes_valid_stream(tmp_path):
+    path = str(tmp_path / "run.events.jsonl")
+    log = EventLog(path, enabled=True)
+    log.emit("run_header", **run_metadata({}, mode="train"))
+    log.emit("step", epoch=0, step=1, loss=float("nan"), epe=0.5)
+    log.emit("epoch_summary", epoch=0, steps=1, loss=0.5, epe=0.5)
+    log.close()
+    assert validate_events_file(path) == []
+    records = [json.loads(l) for l in open(path)]
+    assert [r["seq"] for r in records] == [0, 1, 2]
+    assert records[1]["loss"] == "NaN"
+
+
+def test_eventlog_rejects_invalid_emit(tmp_path):
+    log = EventLog(str(tmp_path / "x.jsonl"), enabled=True)
+    with pytest.raises(ValueError, match="invalid"):
+        log.emit("step", epoch=0)  # missing required fields
+    with pytest.raises(ValueError, match="unknown event type"):
+        log.emit("definitely_not_a_type", foo=1)
+    log.close()
+
+
+def test_eventlog_resume_continues_seq(tmp_path):
+    # A resumed run (train.py --resume reuses the exp dir) appends to the
+    # same file; the seq chain must continue or the stream fails its own
+    # validator.
+    path = str(tmp_path / "run.events.jsonl")
+    log = EventLog(path, enabled=True)
+    log.emit("run_header", **run_metadata({}, mode="train"))
+    log.emit("step", epoch=0, step=1, loss=1.0, epe=1.0)
+    log.close()
+    resumed = EventLog(path, enabled=True)
+    assert resumed.seq == 2
+    resumed.emit("run_header", **run_metadata({}, mode="train"))
+    resumed.emit("step", epoch=1, step=2, loss=0.9, epe=0.9)
+    resumed.close()
+    assert validate_events_file(path) == []
+
+
+def test_eventlog_disabled_is_noop(tmp_path):
+    path = str(tmp_path / "x.jsonl")
+    log = EventLog(path, enabled=False)  # the non-zero-rank role
+    assert log.emit("step", epoch=0, step=1, loss=1.0, epe=1.0) is None
+    log.close()
+    assert not os.path.exists(path)
+
+
+# --- RunTelemetry fan-out ---------------------------------------------------
+
+
+def test_run_telemetry_fans_out_to_tb_and_events(tmp_path):
+    sink = RunTelemetry(str(tmp_path / "exp"), "Train", "synthetic")
+    sink.emit_header({}, mode="train")
+    sink.emit_step(0, 1, 0.5, 1.0,
+                   telemetry={"grad_norm": 2.0, "update_ratio": 1e-4})
+    sink.emit_eval("val", 0, 4, {"epe3d": 0.9, "loss": 0.4})
+    sink.close()
+    # TB consumers saw the reference tags…
+    assert sink.tb.history["Train/Loss"] == [(1, 0.5)]
+    assert sink.tb.history["telemetry/grad_norm"] == [(1, 2.0)]
+    assert sink.tb.history["Val/EPE"] == [(0, 0.9)]
+    # …and the event stream is the same information, valid.
+    path = str(tmp_path / "exp" / "train.events.jsonl")
+    assert validate_events_file(path) == []
+    types = [json.loads(l)["type"] for l in open(path)]
+    assert types == ["run_header", "step", "eval"]
+
+
+# --- golden fixture + CLI ---------------------------------------------------
+
+
+def test_golden_fixture_validates():
+    assert validate_events_file(FIXTURE) == []
+    records = [json.loads(l) for l in open(FIXTURE)]
+    types = {r["type"] for r in records}
+    # The fixture exercises every event type the schema defines.
+    from pvraft_tpu.obs import EVENT_TYPES
+
+    assert types == set(EVENT_TYPES)
+
+
+def test_cli_validate(tmp_path, capsys):
+    from pvraft_tpu.obs.__main__ import main
+
+    assert main(["validate", FIXTURE]) == 0
+    bad = tmp_path / "bad.events.jsonl"
+    bad.write_text('{"not": "an event"}\n')
+    assert main(["validate", str(bad)]) == 1
+    assert main(["validate", FIXTURE, str(bad)]) == 1

@@ -1,0 +1,80 @@
+"""utils/logging: TBWriter in-memory fallback + exception-safe close,
+ExperimentLog handler dedup + close (the file-descriptor leak across
+Trainer re-instantiations)."""
+
+import logging
+import os
+
+from pvraft_tpu.utils.logging import ExperimentLog, TBWriter
+
+
+def test_tbwriter_in_memory_history(tmp_path, monkeypatch):
+    # Even with a real backend importable, history records everything —
+    # and with the import broken the writer must degrade, not die.
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_torch(name, *a, **k):
+        if name.startswith("torch"):
+            raise ImportError("forced for test")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_torch)
+    tb = TBWriter(str(tmp_path / "logs"))
+    assert tb._writer is None
+    tb.add_scalar("Train/Loss", 1.5, 1)
+    tb.add_scalar("Train/Loss", 1.25, 2)
+    assert tb.history["Train/Loss"] == [(1, 1.5), (2, 1.25)]
+    tb.close()  # no-op without a backend
+
+
+def test_tbwriter_close_is_exception_safe_and_idempotent(tmp_path):
+    tb = TBWriter(str(tmp_path / "logs"))
+    tb.add_scalar("x", 1.0, 0)
+
+    class Dying:
+        def flush(self):
+            raise RuntimeError("disk full")
+
+        def close(self):
+            raise RuntimeError("already torn down")
+
+    tb._writer = Dying()
+    tb.close()  # must not raise
+    assert tb._writer is None
+    tb.close()  # idempotent
+    assert tb.history["x"] == [(0, 1.0)]
+
+
+def test_experimentlog_handler_dedup(tmp_path):
+    exp = str(tmp_path / "exp")
+    a = ExperimentLog(exp, "Train", "synthetic")
+    n = len(a.logger.handlers)
+    b = ExperimentLog(exp, "Train", "synthetic")
+    # Same experiment dir + mode: the second instantiation must reuse
+    # the handler, not stack a duplicate (double-logged lines).
+    assert len(b.logger.handlers) == n
+    a.close()
+
+
+def test_experimentlog_close_releases_handlers(tmp_path):
+    exp = str(tmp_path / "exp")
+    log = ExperimentLog(exp, "Train", "synthetic")
+    log.info("hello")
+    assert any(isinstance(h, logging.FileHandler)
+               for h in log.logger.handlers)
+    log.close()
+    assert not any(isinstance(h, logging.FileHandler)
+                   for h in log.logger.handlers)
+    log.close()  # idempotent
+    # A fresh instance re-attaches exactly one handler and logs fine.
+    log2 = ExperimentLog(exp, "Train", "synthetic")
+    assert sum(isinstance(h, logging.FileHandler)
+               for h in log2.logger.handlers) == 1
+    log2.info("again")
+    log2.close()
+    path = os.path.join(exp, "logs", "Train_synthetic.log")
+    with open(path) as f:
+        content = f.read()
+    assert "hello" in content and "again" in content

@@ -1,0 +1,360 @@
+"""Run-telemetry subsystem: in-jit monitors, the telemetry-off jaxpr
+guarantee, the divergence detector, snapshot round-trips, and the
+forced-NaN -> snapshot -> run_doctor pipeline (the acceptance path)."""
+
+import dataclasses
+import json
+import os
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pvraft_tpu.config import ModelConfig
+from pvraft_tpu.obs import (
+    DivergenceDetector,
+    delta_flow_norms,
+    dump_snapshot,
+    global_norm,
+    load_snapshot,
+    nonfinite_count,
+    telemetry_leaves,
+    validate_events_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- monitors ---------------------------------------------------------------
+
+
+def test_global_norm_matches_reference():
+    tree = {"a": jnp.asarray([3.0, 4.0]), "b": {"c": jnp.zeros((2, 2))}}
+    assert float(global_norm(tree)) == pytest.approx(5.0)
+    assert float(global_norm({})) == 0.0
+
+
+def test_nonfinite_count_counts_across_trees():
+    a = jnp.asarray([1.0, np.nan, np.inf])
+    b = {"x": jnp.asarray([[np.nan]]), "i": jnp.asarray([1, 2])}  # ints skip
+    assert int(nonfinite_count(a, b)) == 3
+    assert int(nonfinite_count(jnp.ones(4))) == 0
+
+
+def test_delta_flow_norms_first_iter_is_absolute():
+    flows = jnp.stack([jnp.full((1, 4, 3), 2.0), jnp.full((1, 4, 3), 5.0)])
+    out = np.asarray(delta_flow_norms(flows))
+    # iter 0 update = flows[0] - 0; iter 1 update = flows[1] - flows[0].
+    np.testing.assert_allclose(out, [2.0, 3.0], rtol=1e-6)
+
+
+def test_telemetry_leaves_shape_and_groups():
+    params = {"params": {"enc": {"w": jnp.ones((3,))},
+                         "gru": {"w": jnp.ones((2,))}}}
+    grads = jax.tree_util.tree_map(lambda x: x * 0.1, params)
+    updates = jax.tree_util.tree_map(lambda x: x * -0.01, params)
+    flows = jnp.ones((2, 1, 4, 3))
+    out = telemetry_leaves(params, grads, updates, jnp.float32(1.0), flows)
+    assert sorted(out) == ["delta_flow_norm", "grad_norm",
+                           "grad_norm_by_group", "nonfinite", "param_norm",
+                           "update_ratio"]
+    assert sorted(out["grad_norm_by_group"]) == ["enc", "gru"]
+    assert out["delta_flow_norm"].shape == (2,)
+    assert int(out["nonfinite"]) == 0
+    ratio = float(out["update_ratio"])
+    assert ratio == pytest.approx(0.01, rel=1e-4)
+
+
+# --- divergence detector ----------------------------------------------------
+
+
+def test_detector_trips_on_nonfinite():
+    det = DivergenceDetector(window=8, zscore=0.0)
+    assert det.update(1.0) is None
+    trip = det.update(float("nan"))
+    assert trip is not None and trip.reason == "nonfinite"
+    trip = det.update(2.0, nonfinite=5)  # sentinel outranks a finite loss
+    assert trip is not None and trip.reason == "nonfinite"
+
+
+def test_detector_zscore_trip_and_recovery():
+    det = DivergenceDetector(window=16, zscore=4.0, min_steps=4)
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        assert det.update(1.0 + 0.01 * rng.standard_normal()) is None
+    trip = det.update(50.0)
+    assert trip is not None and trip.reason == "zscore" and trip.zscore > 4
+    # The spike was NOT folded into the window: a healthy loss after it
+    # is healthy, and a second identical spike still trips.
+    assert det.update(1.0) is None
+    assert det.update(50.0) is not None
+
+
+def test_detector_min_steps_clamped_to_window():
+    # A window smaller than the default min_steps must still arm the
+    # z-score trigger (the deque can never exceed its maxlen).
+    det = DivergenceDetector(window=4, zscore=4.0)
+    for _ in range(4):
+        assert det.update(1.0) is None
+    assert det.update(100.0) is not None
+
+
+def test_detector_zscore_disabled():
+    det = DivergenceDetector(window=8, zscore=0.0)
+    for loss in [1.0] * 6 + [1e9]:
+        assert det.update(loss) is None  # only the sentinel is armed
+
+
+# --- snapshots --------------------------------------------------------------
+
+
+def test_snapshot_roundtrip(tmp_path):
+    import optax
+
+    params = {"params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    batch = {"pc1": np.ones((1, 4, 3), np.float32),
+             "pc2": np.ones((1, 4, 3), np.float32),
+             "flow": np.zeros((1, 4, 3), np.float32),
+             "mask": np.ones((1, 4), np.float32)}
+    path = dump_snapshot(
+        str(tmp_path), batch, params, opt_state,
+        step=7, epoch=1, reason="nonfinite", loss=float("nan"),
+        cfg=None, extra_meta={"zscore": None},
+    )
+    assert os.path.basename(path) == "step_0000007"
+    meta, batch2, params2, opt2 = load_snapshot(path)
+    assert meta["step"] == 7 and meta["reason"] == "nonfinite"
+    assert meta["loss"] == "NaN"
+    np.testing.assert_array_equal(batch2["pc1"], batch["pc1"])
+    np.testing.assert_array_equal(params2["params"]["w"],
+                                  params["params"]["w"])
+    # The opt_state round-trips through from_state_dict into a freshly
+    # built structure (what run_doctor does).
+    from flax import serialization
+
+    restored = serialization.from_state_dict(tx.init(params), opt2)
+    assert int(restored[0].count) == 0
+
+
+def test_load_snapshot_rejects_wrong_schema(tmp_path):
+    path = dump_snapshot(
+        str(tmp_path), {"x": np.zeros(1)}, {"w": np.zeros(1)}, {},
+        step=1, epoch=0, reason="zscore", loss=2.0)
+    meta_path = os.path.join(path, "meta.json")
+    meta = json.load(open(meta_path))
+    meta["schema"] = "pvraft_snapshot/v0"
+    json.dump(meta, open(meta_path, "w"))
+    with pytest.raises(ValueError, match="schema"):
+        load_snapshot(path)
+
+
+# --- the telemetry-off jaxpr guarantee --------------------------------------
+
+
+def _norm_addrs(s: str) -> str:
+    return re.sub(r"0x[0-9a-f]+", "0x0", s)
+
+
+def test_train_step_telemetry_off_jaxpr_identical():
+    """With telemetry off the train-step jaxpr is byte-identical (modulo
+    embedded object addresses) to the pre-telemetry step body, replicated
+    here verbatim — the same golden the trace audit enforces
+    (analysis/audit.py: engine.train_step[telemetry_off_jaxpr])."""
+    import optax
+
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.engine.metrics import epe_train
+    from pvraft_tpu.engine.steps import make_train_step, maybe_cast_grads
+    from pvraft_tpu.models import PVRaft
+
+    cfg = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8,
+                      use_pallas=False)
+    model = PVRaft(cfg)
+    tx = optax.adam(1e-3)
+    sds = lambda *s: jax.ShapeDtypeStruct(s, "float32")
+    pc1, pc2, mask, gt = sds(1, 32, 3), sds(1, 32, 3), sds(1, 32), sds(1, 32, 3)
+    params = jax.eval_shape(
+        lambda a, b: model.init(jax.random.key(0), a, b, 2), pc1, pc2)
+    opt_state = jax.eval_shape(tx.init, params)
+    batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
+
+    def step(params, opt_state, batch):  # the pre-PR body, verbatim
+        def loss_fn(p):
+            flows, _ = model.apply(p, batch["pc1"], batch["pc2"], 2)
+            loss = sequence_loss(flows, batch["mask"], batch["flow"], 0.8)
+            return loss, flows
+
+        (loss, flows), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = maybe_cast_grads(grads, None)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        epe = epe_train(flows[-1], batch["mask"], batch["flow"])
+        return params, opt_state, {"loss": loss, "epe": epe}
+
+    got = make_train_step(model, tx, 0.8, 2, telemetry=False)
+    want = jax.jit(step, donate_argnums=(0, 1))
+    s_got = _norm_addrs(str(jax.make_jaxpr(got)(params, opt_state, batch)))
+    s_want = _norm_addrs(str(jax.make_jaxpr(want)(params, opt_state, batch)))
+    assert s_got == s_want
+
+
+def test_train_step_telemetry_on_only_adds_leaves():
+    """Telemetry on: identical loss/epe values, extra monitor leaves."""
+    import optax
+
+    from pvraft_tpu.engine.steps import make_train_step
+    from pvraft_tpu.models import PVRaft
+
+    cfg = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8,
+                      use_pallas=False)
+    model = PVRaft(cfg)
+    tx = optax.adam(1e-3)
+    rng = np.random.default_rng(0)
+    batch = {
+        "pc1": jnp.asarray(rng.uniform(-1, 1, (1, 32, 3)).astype(np.float32)),
+        "pc2": jnp.asarray(rng.uniform(-1, 1, (1, 32, 3)).astype(np.float32)),
+        "mask": jnp.ones((1, 32), jnp.float32),
+    }
+    batch["flow"] = batch["pc2"] - batch["pc1"]
+    params = model.init(jax.random.key(0), batch["pc1"], batch["pc2"], 2)
+    opt_state = tx.init(params)
+
+    p_off, o_off, m_off = make_train_step(
+        model, tx, 0.8, 2, donate=False)(params, opt_state, batch)
+    p_on, o_on, m_on = make_train_step(
+        model, tx, 0.8, 2, donate=False, telemetry=True)(
+            params, opt_state, batch)
+    assert float(m_on["loss"]) == pytest.approx(float(m_off["loss"]))
+    assert float(m_on["epe"]) == pytest.approx(float(m_off["epe"]))
+    for a, b in zip(jax.tree_util.tree_leaves(p_on),
+                    jax.tree_util.tree_leaves(p_off)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    tel = m_on["telemetry"]
+    assert int(tel["nonfinite"]) == 0
+    assert float(tel["grad_norm"]) > 0
+    assert tel["delta_flow_norm"].shape == (2,)
+    assert "telemetry" not in m_off
+
+
+# --- forced-NaN injection -> snapshot -> run_doctor (acceptance) ------------
+
+
+@pytest.fixture(scope="module")
+def nan_run(tmp_path_factory, monkeypatch_module):
+    """ONE poisoned tiny training epoch shared by the assertions below
+    (the Trainer compile dominates; rerunning it per test would blow the
+    tier-1 budget)."""
+    from conftest import tiny_trainer_cfg
+
+    import pvraft_tpu.engine.trainer as trmod
+    from pvraft_tpu.parallel.mesh import make_mesh
+
+    tmp_path = tmp_path_factory.mktemp("nan_run")
+    cfg = tiny_trainer_cfg(tmp_path)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, telemetry=True))
+
+    real_build = trmod.build_datasets
+
+    def poisoned_build(c):
+        train, val, test = real_build(c)
+
+        class Poisoned:
+            def __getattr__(self, name):
+                return getattr(train, name)
+
+            def __len__(self):
+                return len(train)
+
+            def __getitem__(self, i):
+                s = dict(train[i])
+                if i == 2:  # one bad sample: NaN coordinates in pc1
+                    s["pc1"] = s["pc1"].copy()
+                    s["pc1"][0, :] = np.nan
+                return s
+
+        return Poisoned(), val, test
+
+    monkeypatch_module.setattr(trmod, "build_datasets", poisoned_build)
+    trainer = trmod.Trainer(cfg, mesh=make_mesh(n_data=1))
+    metrics = trainer.training(0)
+    snap_root = os.path.join(cfg.exp_path, "snapshots")
+    snaps = sorted(os.listdir(snap_root)) if os.path.isdir(snap_root) else []
+    trainer.close()
+    return cfg, metrics, snap_root, snaps, trainer.snapshots_taken
+
+
+@pytest.fixture(scope="module")
+def monkeypatch_module():
+    mp = pytest.MonkeyPatch()
+    yield mp
+    mp.undo()
+
+
+def test_nan_injection_dumps_snapshot_and_events(nan_run):
+    cfg, metrics, snap_root, snaps, taken = nan_run
+    assert not np.isfinite(metrics["loss"])
+    assert snaps and taken == len(snaps)
+    # The event stream recorded the divergence and validates.
+    events_path = os.path.join(cfg.exp_path, "train.events.jsonl")
+    assert validate_events_file(events_path) == []
+    records = [json.loads(l) for l in open(events_path)]
+    kinds = [r["type"] for r in records]
+    assert "divergence" in kinds and "snapshot" in kinds
+    div = next(r for r in records if r["type"] == "divergence")
+    assert div["reason"] == "nonfinite" and div["loss"] == "NaN"
+    # Step events carry the in-jit monitor leaves, sentinel included.
+    step_tel = [r["telemetry"] for r in records if r["type"] == "step"]
+    assert step_tel and any(t["nonfinite"] > 0 for t in step_tel)
+
+
+def test_halt_on_divergence_flushes_step_events(nan_run, tmp_path,
+                                                monkeypatch_module):
+    """--halt_on_divergence raises, but only AFTER the epoch's buffered
+    step events (the trajectory into the trip) reach the event log.
+    Rides nan_run's module monkeypatch + warm jit cache."""
+    from conftest import tiny_trainer_cfg
+
+    import pvraft_tpu.engine.trainer as trmod
+    from pvraft_tpu.obs.divergence import DivergenceHalt
+    from pvraft_tpu.parallel.mesh import make_mesh
+
+    cfg = tiny_trainer_cfg(tmp_path)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, telemetry=True,
+                                       halt_on_divergence=True))
+    trainer = trmod.Trainer(cfg, mesh=make_mesh(n_data=1))
+    with pytest.raises(DivergenceHalt, match="diverged"):
+        trainer.training(0)
+    trainer.close()
+    events_path = os.path.join(cfg.exp_path, "train.events.jsonl")
+    records = [json.loads(l) for l in open(events_path)]
+    kinds = [r["type"] for r in records]
+    assert "divergence" in kinds
+    assert "step" in kinds  # the flush happened before the raise
+    assert "epoch_summary" not in kinds  # halted epoch: no summary/ckpt
+
+
+def test_run_doctor_names_first_nonfinite_stage(nan_run):
+    cfg, _, snap_root, snaps, _ = nan_run
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "run_doctor", os.path.join(REPO, "scripts", "run_doctor.py"))
+    doctor = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(doctor)
+    report = doctor.diagnose(os.path.join(snap_root, snaps[0]))
+    # NaN was injected into pc1 itself: the batch is the first bad stage,
+    # and the corruption propagates through encoder(pc1) but NOT pc2.
+    assert report["first_nonfinite_stage"] == "batch"
+    by_stage = {r["stage"]: r for r in report["stages"]}
+    assert not by_stage["encoder(pc1)"]["finite"]
+    assert by_stage["encoder(pc2)"]["finite"]
+    assert not by_stage["loss"]["finite"]
+    # CLI main prints and exits 0 on a readable snapshot.
+    assert doctor.main([os.path.join(snap_root, snaps[0])]) == 0

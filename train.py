@@ -109,6 +109,22 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="force a jax platform (e.g. cpu for host debugging)")
     p.add_argument("--profile_dir", default="",
                    help="write a jax profiler trace of the first epoch here")
+    p.add_argument("--telemetry", action="store_true",
+                   help="run-health telemetry (pvraft_tpu/obs): in-jit "
+                        "numerics monitors in the train step, loss "
+                        "divergence detection, crash snapshots replayable "
+                        "by scripts/run_doctor.py")
+    p.add_argument("--divergence_zscore", type=float, default=6.0,
+                   help="with --telemetry: trip when loss exceeds this "
+                        "many trailing std devs over the window (0 "
+                        "disables; the NaN/Inf sentinel stays armed)")
+    p.add_argument("--divergence_window", type=int, default=64,
+                   help="with --telemetry: trailing window (healthy "
+                        "steps) of the loss z-score detector")
+    p.add_argument("--halt_on_divergence", action="store_true",
+                   help="with --telemetry: stop after the first "
+                        "divergence snapshot instead of training on "
+                        "with corrupt state")
     return p.parse_args(argv)
 
 
@@ -144,6 +160,10 @@ def config_from_args(a: argparse.Namespace) -> Config:
             ckpt_backend=a.ckpt_backend,
             seed=a.seed, lr_schedule=a.lr_schedule, profile_dir=a.profile_dir,
             grad_dtype=a.grad_dtype,
+            telemetry=a.telemetry,
+            divergence_zscore=a.divergence_zscore,
+            divergence_window=a.divergence_window,
+            halt_on_divergence=a.halt_on_divergence,
         ),
         parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel,
                                 packed_state=a.packed_state,
