@@ -1,9 +1,13 @@
-"""CLI: ``python -m pvraft_tpu.analysis {lint,trace} ...``.
+"""CLI: ``python -m pvraft_tpu.analysis {lint,trace,deepcheck} ...``.
 
-``lint`` is pure stdlib-AST and never initializes a jax backend.
+``lint`` is pure stdlib-AST and never initializes a jax backend
+(``--stats`` prints the suppression-debt report instead of findings).
 ``trace`` imports jax and abstractly traces every registered op with
 ``jax.eval_shape`` (zero FLOPs — shape propagation only), reporting any
 concretization / shape errors a TPU run would hit at compile time.
+``deepcheck`` traces the same registry to ClosedJaxprs and runs the
+GJ001+ semantic rules: collective consistency, donation efficacy,
+precision flow, retrace hazards.
 """
 
 from __future__ import annotations
@@ -24,6 +28,8 @@ def _cmd_lint(args) -> int:
         print("usage: python -m pvraft_tpu.analysis lint PATH [PATH ...]",
               file=sys.stderr)
         return 2
+    if args.stats:
+        return _lint_stats(args.paths)
     select = tuple(args.select.split(",")) if args.select else ()
     diags, nfiles = lint_paths(args.paths, rule_ids=select)
     for d in diags:
@@ -31,6 +37,51 @@ def _cmd_lint(args) -> int:
     summary = f"graftlint: {len(diags)} finding(s) in {nfiles} file(s)"
     print(summary, file=sys.stderr)
     return 1 if diags else 0
+
+
+def _lint_stats(paths) -> int:
+    """Suppression-debt report: what the gate is NOT checking, per rule.
+
+    Exit 1 on any reason-less suppression — a blind spot nobody can
+    audit is debt, not configuration."""
+    from pvraft_tpu.analysis.engine import (
+        collect_suppressions,
+        known_rule_ids,
+    )
+
+    pragmas = collect_suppressions(paths)
+    known = known_rule_ids()
+    per_rule: dict = {}
+    reasonless = []
+    unknown = []
+    for p in pragmas:
+        for rid in p.ids:
+            stats = per_rule.setdefault(
+                rid, {"line": 0, "next": 0, "file": 0, "reasonless": 0})
+            stats[p.kind] += 1
+            if not p.reason:
+                stats["reasonless"] += 1
+            if rid != "all" and rid not in known:
+                unknown.append((p, rid))
+        if not p.reason:
+            reasonless.append(p)
+    for rid in sorted(per_rule):
+        s = per_rule[rid]
+        total = s["line"] + s["next"] + s["file"]
+        print(f"{rid:<7} {total:>3} suppression(s)  "
+              f"(line={s['line']} next={s['next']} file={s['file']}, "
+              f"{s['reasonless']} without reason)")
+    for p, rid in unknown:
+        print(f"{p.path}:{p.line}: warning: suppression names unknown "
+              f"rule {rid}")
+    for p in reasonless:
+        print(f"{p.path}:{p.line}: reason-less suppression of "
+              f"{','.join(p.ids)} (append `-- why`)")
+    print(
+        f"graftlint --stats: {len(pragmas)} active pragma(s), "
+        f"{len(reasonless)} without reason", file=sys.stderr,
+    )
+    return 1 if reasonless else 0
 
 
 def _cmd_trace(args) -> int:
@@ -43,6 +94,29 @@ def _cmd_trace(args) -> int:
         "op(s) trace clean", file=sys.stderr,
     )
     return 1 if bad else 0
+
+
+def _cmd_deepcheck(args) -> int:
+    from pvraft_tpu.analysis.jaxpr import (
+        all_jaxpr_rules,
+        format_report,
+        run_deepcheck,
+        summary_line,
+    )
+
+    if args.list_rules:
+        for rule in all_jaxpr_rules():
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id}  {rule.title:<28} {doc}")
+        return 0
+    select = tuple(args.select.split(",")) if args.select else ()
+    report = run_deepcheck(select_rules=select,
+                           entry_filter=tuple(args.entries))
+    body = format_report(report, verbose=args.verbose)
+    if body:
+        print(body)
+    print(summary_line(report), file=sys.stderr)
+    return 0 if report.ok else 1
 
 
 def main(argv=None) -> int:
@@ -59,12 +133,33 @@ def main(argv=None) -> int:
                         help="print the rule table and exit")
     p_lint.add_argument("--select", default="",
                         help="comma-separated rule ids to run (default all)")
+    p_lint.add_argument("--stats", action="store_true",
+                        help="suppression-debt report (exit 1 on "
+                             "reason-less suppressions)")
     p_lint.set_defaults(fn=_cmd_lint)
 
     p_trace = sub.add_parser(
         "trace", help="eval_shape trace-compat audit of registered ops"
     )
     p_trace.set_defaults(fn=_cmd_trace)
+
+    p_deep = sub.add_parser(
+        "deepcheck",
+        help="jaxpr-level semantic analysis (GJ rules) over the audit "
+             "registry",
+    )
+    p_deep.add_argument("--list-rules", action="store_true",
+                        help="print the GJ rule table and exit")
+    p_deep.add_argument("--select", default="",
+                        help="comma-separated GJ rule ids (default all)")
+    p_deep.add_argument("--entries", action="append", default=[],
+                        metavar="SUBSTR",
+                        help="only entries whose name contains SUBSTR "
+                             "(repeatable)")
+    p_deep.add_argument("-v", "--verbose", action="store_true",
+                        help="per-entry program stats (eqn/collective "
+                             "counts, precision-flow map)")
+    p_deep.set_defaults(fn=_cmd_deepcheck)
 
     args = parser.parse_args(argv)
     return args.fn(args)

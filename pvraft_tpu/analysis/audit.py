@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import traceback
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 # Symbolic dims: distinct so axis mixups fail loudly.
 B, N, M, D, K = 2, 24, 40, 16, 8
@@ -35,17 +35,50 @@ class AuditResult:
     detail: str  # out shapes on success, error summary on failure
 
 
-_ENTRIES: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {}
+@dataclasses.dataclass(frozen=True)
+class AuditEntry:
+    """One registered op: the thunk plus the metadata deepcheck reads.
+
+    ``precision`` declares the entry's dtype intent for rule GJ006
+    (``"f32"``: no 16-bit floats anywhere; ``"bf16_grads"``: the
+    grad-cast lever must actually appear and not leak; ``"any"``: opt
+    out). ``spmd_group`` names a set of step variants whose collective
+    fingerprints must match (GJ003). ``path``/``line`` anchor
+    entry-level findings for suppression and reporting."""
+
+    name: str
+    thunk: Callable[[], Tuple[Callable, tuple]]
+    precision: str = "f32"
+    spmd_group: Optional[str] = None
+    path: str = ""
+    line: int = 0
 
 
-def audit_entry(name: str):
+_ENTRIES: Dict[str, AuditEntry] = {}
+
+
+def audit_entry(name: str, precision: str = "f32",
+                spmd_group: Optional[str] = None):
     def deco(thunk):
         if name in _ENTRIES:
             raise ValueError(f"duplicate audit entry {name}")
-        _ENTRIES[name] = thunk
+        code = getattr(thunk, "__code__", None)
+        _ENTRIES[name] = AuditEntry(
+            name=name,
+            thunk=thunk,
+            precision=precision,
+            spmd_group=spmd_group,
+            path=getattr(code, "co_filename", "") or "",
+            line=getattr(code, "co_firstlineno", 0) or 0,
+        )
         return thunk
 
     return deco
+
+
+def entries() -> Dict[str, AuditEntry]:
+    """The registry — deepcheck's corpus (copy; mutation-safe)."""
+    return dict(_ENTRIES)
 
 
 def _f32(*shape):
@@ -214,7 +247,16 @@ def _e_fused():
     )
 
 
-# --- parallel/ring (under shard_map on a 1-device mesh) -------------------
+# --- parallel/ring (under shard_map; 2 seq shards when the host has the
+# devices, so the traced programs CONTAIN the ring ppermutes and the
+# deepcheck collective rules check real communication, not a degenerate
+# p=1 loop — lint.sh forces an 8-device virtual CPU mesh for this) ------
+
+def _ring_seq() -> int:
+    import jax
+
+    return 2 if jax.device_count() >= 2 else 1
+
 
 @audit_entry("ring.ring_corr_init")
 def _e_ring():
@@ -225,7 +267,7 @@ def _e_ring():
     from pvraft_tpu.parallel.mesh import make_mesh
     from pvraft_tpu.parallel.ring import ring_corr_init
 
-    mesh = make_mesh(n_data=1, n_seq=1)
+    mesh = make_mesh(n_data=1, n_seq=_ring_seq())
 
     def fn(f1, f2, x2):
         return shard_map(
@@ -239,6 +281,28 @@ def _e_ring():
         )(f1, f2, x2)
 
     return fn, (_f32(B, N, D), _f32(B, M, D), _f32(B, M, 3))
+
+
+@audit_entry("ring.ring_knn_indices")
+def _e_ring_knn():
+    from jax.sharding import PartitionSpec as P
+
+    from pvraft_tpu.compat import shard_map
+    from pvraft_tpu.parallel.mesh import make_mesh
+    from pvraft_tpu.parallel.ring import ring_knn_indices
+
+    mesh = make_mesh(n_data=1, n_seq=_ring_seq())
+
+    def fn(query, db):
+        return shard_map(
+            lambda q, d: ring_knn_indices(q, d, K, "seq"),
+            mesh=mesh,
+            in_specs=(P(None, "seq", None), P(None, "seq", None)),
+            out_specs=P(None, "seq", None),
+            check_vma=False,
+        )(query, db)
+
+    return fn, (_f32(B, N, 3), _f32(B, M, 3))
 
 
 # --- models (full forward passes, abstract params included) ---------------
@@ -283,7 +347,7 @@ def _e_pvraft_opt():
 
 # --- engine (the jitted train step, end to end) ---------------------------
 
-@audit_entry("engine.train_step")
+@audit_entry("engine.train_step", spmd_group="train-step")
 def _e_train_step():
     import jax
     import optax
@@ -306,7 +370,8 @@ def _e_train_step():
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
-@audit_entry("engine.train_step[optimized_backward]")
+@audit_entry("engine.train_step[optimized_backward]",
+             precision="bf16_grads", spmd_group="train-step")
 def _e_train_step_opt():
     # Full optimized train step: scatter-free VJPs, dots remat policy,
     # bf16 gradient cast — the bench A/B configuration, traced end to end.
@@ -332,7 +397,7 @@ def _e_train_step_opt():
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
-@audit_entry("engine.train_step[telemetry]")
+@audit_entry("engine.train_step[telemetry]", spmd_group="train-step")
 def _e_train_step_telemetry():
     # The telemetry-armed step traces end to end: the in-jit monitors
     # (obs/monitors.py) ride back as an extra metrics leaf.
@@ -353,6 +418,74 @@ def _e_train_step_telemetry():
         step = make_train_step(model, tx, 0.8, 3, telemetry=True)
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
         return step(params, opt_state, batch)
+
+    return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
+
+
+@audit_entry("engine.refine_train_step")
+def _e_refine_train_step():
+    # Stage-2 step variant: frozen backbone, masked-L1 on the single
+    # refined flow. In the corpus so deepcheck's donation and precision
+    # walks cover the refine path, not just stage 1.
+    import jax
+    import optax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.steps import make_refine_train_step
+    from pvraft_tpu.models.raft import PVRaftRefine
+
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2)
+    model = PVRaftRefine(cfg)
+    tx = optax.sgd(1e-2)
+
+    def fn(pc1, pc2, mask, gt):
+        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        opt_state = tx.init(params)
+        step = make_refine_train_step(model, tx, 3)
+        batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
+        return step(params, opt_state, batch)
+
+    return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
+
+
+@audit_entry("engine.eval_step")
+def _e_eval_step():
+    # The jitted eval step (no donation by design: params are reused
+    # across every val batch) — deepcheck verifies exactly that.
+    import jax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.steps import make_eval_step
+    from pvraft_tpu.models.raft import PVRaft
+
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2)
+    model = PVRaft(cfg)
+
+    def fn(pc1, pc2, mask, gt):
+        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        step = make_eval_step(model, 3, 0.8)
+        batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
+        return step(params, batch)
+
+    return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
+
+
+@audit_entry("engine.eval_step[refine]")
+def _e_eval_step_refine():
+    import jax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.steps import make_eval_step
+    from pvraft_tpu.models.raft import PVRaftRefine
+
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2)
+    model = PVRaftRefine(cfg)
+
+    def fn(pc1, pc2, mask, gt):
+        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        step = make_eval_step(model, 3, 0.8, refine=True)
+        batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
+        return step(params, batch)
 
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
@@ -383,7 +516,7 @@ def _e_train_step_telemetry_off_jaxpr():
     opt_state = jax.eval_shape(tx.init, params)
     batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
 
-    def step(params, opt_state, batch):  # named `step`: pjit keeps the name
+    def train_step(params, opt_state, batch):  # name matches: pjit keeps it
         def loss_fn(p):
             flows, _ = model.apply(p, batch["pc1"], batch["pc2"], 3)
             loss = sequence_loss(flows, batch["mask"], batch["flow"], 0.8)
@@ -401,15 +534,15 @@ def _e_train_step_telemetry_off_jaxpr():
     # params), so the strings compare the step bodies alone. Embedded
     # object reprs (custom_jvp thunks) carry memory addresses; normalize
     # those — everything else must match byte for byte.
-    import re
+    from pvraft_tpu.analysis.jaxpr.rules import normalize_jaxpr_str
 
     def jaxpr_str(fn):
-        s = str(jax.make_jaxpr(fn)(params, opt_state, batch))
-        return re.sub(r"0x[0-9a-f]+", "0x0", s)
+        return normalize_jaxpr_str(
+            str(jax.make_jaxpr(fn)(params, opt_state, batch)))
 
     factory_step = make_train_step(model, tx, 0.8, 3, telemetry=False)
     got = jaxpr_str(factory_step)
-    want = jaxpr_str(jax.jit(step, donate_argnums=(0, 1)))
+    want = jaxpr_str(jax.jit(train_step, donate_argnums=(0, 1)))
     if got != want:
         raise AssertionError(
             "telemetry=False train-step jaxpr differs from the "
@@ -426,7 +559,7 @@ def run_audit(verbose: bool = False) -> List[AuditResult]:
     results: List[AuditResult] = []
     for name in sorted(_ENTRIES):
         try:
-            fn, args = _ENTRIES[name]()
+            fn, args = _ENTRIES[name].thunk()
             out = jax.eval_shape(fn, *args)
             shapes = jax.tree_util.tree_map(
                 lambda s: tuple(s.shape), out

@@ -32,7 +32,7 @@ import io
 import os
 import re
 import tokenize
-from typing import Iterable, Iterator, List, Tuple, Type
+from typing import Dict, Iterable, Iterator, List, Sequence, Set, Tuple, Type
 
 
 @dataclasses.dataclass(frozen=True)
@@ -113,9 +113,28 @@ def all_rules() -> Tuple[Type[Rule], ...]:
 
 # --- suppression comments -------------------------------------------------
 
-_LINE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,]+)")
-_NEXT_RE = re.compile(r"#\s*graftlint:\s*disable-next=([A-Za-z0-9_,]+)")
-_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,]+)")
+# ONE pragma grammar, shared by the suppression engine and the debt
+# report (`lint --stats`) — what is honored is exactly what is counted.
+# The reason parses from the tail; trailing text without the `-- `
+# marker still activates the suppression but does NOT count as a reason.
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<kind>-next|-file)?="
+    r"(?P<ids>[A-Za-z0-9_,]+)(?P<tail>[^\n]*)"
+)
+_REASON_RE = re.compile(r"^\s*--\s*(?P<reason>\S.*?)\s*$")
+
+
+def _parse_pragma(text: str):
+    """``(kind, ids, reason)`` of the suppression pragma in a comment,
+    or None. kind is "line" | "next" | "file"; reason is "" when the
+    pragma gives none."""
+    m = _PRAGMA_RE.search(text)
+    if not m:
+        return None
+    kind = {None: "line", "-next": "next", "-file": "file"}[m.group("kind")]
+    ids = tuple(i for i in m.group("ids").split(",") if i)
+    rm = _REASON_RE.match(m.group("tail") or "")
+    return kind, ids, rm.group("reason") if rm else ""
 
 
 def _comment_tokens(source: str) -> List[Tuple[int, str]]:
@@ -136,17 +155,16 @@ def _suppressions(source: str):
     per_line: dict = {}
     file_ids: set = set()
     for i, text in _comment_tokens(source):
-        m = _FILE_RE.search(text)
-        if m:
-            file_ids.update(m.group(1).split(","))
+        parsed = _parse_pragma(text)
+        if parsed is None:
             continue
-        m = _NEXT_RE.search(text)
-        if m:
-            per_line.setdefault(i + 1, set()).update(m.group(1).split(","))
-            continue
-        m = _LINE_RE.search(text)
-        if m:
-            per_line.setdefault(i, set()).update(m.group(1).split(","))
+        kind, ids, _reason = parsed
+        if kind == "file":
+            file_ids.update(ids)
+        elif kind == "next":
+            per_line.setdefault(i + 1, set()).update(ids)
+        else:
+            per_line.setdefault(i, set()).update(ids)
     return per_line, file_ids
 
 
@@ -157,12 +175,43 @@ def _suppressed(d: Diagnostic, per_line, file_ids) -> bool:
     return "all" in ids or d.rule_id in ids
 
 
+def _expand_decorated_regions(tree: ast.Module, per_line: dict) -> None:
+    """Make ``disable-next`` work on decorated definitions.
+
+    A diagnostic on a decorated def/class anchors at the ``def`` line,
+    but ``# graftlint: disable-next=...`` placed above the decorator
+    targets the decorator's line — so the suppression silently missed.
+    Treat the whole header (first decorator through the last signature
+    line) as one region: a suppression on any line of it covers all of
+    it."""
+    for node in ast.walk(tree):
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        if not node.decorator_list:
+            continue
+        start = min(d.lineno for d in node.decorator_list)
+        end = node.body[0].lineno - 1 if node.body else node.lineno
+        end = max(end, node.lineno)
+        ids: set = set()
+        for line in range(start, end + 1):
+            ids |= set(per_line.get(line, ()))
+        if ids:
+            for line in range(start, end + 1):
+                per_line.setdefault(line, set()).update(ids)
+
+
 # --- entry points ---------------------------------------------------------
 
 def lint_source(
     source: str, path: str = "<string>", rule_ids: Sequence[str] = ()
 ) -> List[Diagnostic]:
     """Lint one source string. ``rule_ids`` restricts to those rules."""
+    # A UTF-8 BOM is legal in a Python file but chokes ast.parse when the
+    # bytes were decoded as plain utf-8; tolerate it here so BOM'd files
+    # get linted instead of reported as syntax errors.
+    source = source.lstrip("\ufeff")
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -172,6 +221,7 @@ def lint_source(
         ]
     ctx = LintContext(path, source, tree)
     per_line, file_ids = _suppressions(source)
+    _expand_decorated_regions(tree, per_line)
     out: List[Diagnostic] = []
     for rule_cls in all_rules():
         if rule_ids and rule_cls.id not in rule_ids:
@@ -209,7 +259,93 @@ def lint_paths(
     n = 0
     for f in iter_py_files(paths):
         n += 1
-        with open(f, "r", encoding="utf-8") as fh:
+        # utf-8-sig: decode (and drop) a BOM if present; identical to
+        # utf-8 otherwise. Text mode gives universal newlines, so CRLF
+        # sources lint like LF ones.
+        with open(f, "r", encoding="utf-8-sig") as fh:
             out.extend(lint_source(fh.read(), path=f, rule_ids=rule_ids))
     out.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
     return out, n
+
+
+# --- external diagnostics (deepcheck) -------------------------------------
+
+def filter_file_suppressions(
+    diags: Sequence[Diagnostic],
+) -> Tuple[List[Diagnostic], int]:
+    """Apply in-file ``# graftlint: disable`` pragmas to externally
+    produced diagnostics — deepcheck findings anchored at real source
+    lines. Same semantics as the AST path, including the decorated-def
+    header regions (a GJ finding anchored at an ``@audit_entry`` line is
+    suppressible from anywhere in that header). Unreadable/virtual
+    anchor paths suppress nothing. Returns ``(kept, n_suppressed)``."""
+    cache: Dict[str, Tuple[dict, set]] = {}
+    kept: List[Diagnostic] = []
+    suppressed = 0
+    for d in diags:
+        if d.path not in cache:
+            try:
+                with open(d.path, "r", encoding="utf-8-sig") as fh:
+                    source = fh.read()
+            except OSError:
+                cache[d.path] = ({}, set())
+            else:
+                per_line, file_ids = _suppressions(source)
+                try:
+                    tree = ast.parse(source.lstrip("\ufeff"), filename=d.path)
+                except SyntaxError:
+                    pass  # pragmas still apply line-exact
+                else:
+                    _expand_decorated_regions(tree, per_line)
+                cache[d.path] = (per_line, file_ids)
+        per_line, file_ids = cache[d.path]
+        if _suppressed(d, per_line, file_ids):
+            suppressed += 1
+        else:
+            kept.append(d)
+    return kept, suppressed
+
+
+# --- suppression-debt report (`lint --stats`) -----------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Pragma:
+    """One active suppression comment found in a source file."""
+
+    path: str
+    line: int
+    kind: str           # "line" | "next" | "file"
+    ids: Tuple[str, ...]
+    reason: str         # "" when the pragma gives none
+
+
+def collect_suppressions(paths: Sequence[str]) -> List[Pragma]:
+    """Every active suppression pragma under ``paths`` — the gate's
+    enumerable blind spots. Real comment tokens only (the docstring
+    examples in this file don't count), same discipline as the
+    suppression engine itself."""
+    out: List[Pragma] = []
+    for f in iter_py_files(paths):
+        with open(f, "r", encoding="utf-8-sig") as fh:
+            source = fh.read()
+        for lineno, text in _comment_tokens(source):
+            parsed = _parse_pragma(text)
+            if parsed is None:
+                continue
+            kind, ids, reason = parsed
+            out.append(Pragma(path=f, line=lineno, kind=kind, ids=ids,
+                              reason=reason))
+    out.sort(key=lambda p: (p.path, p.line))
+    return out
+
+
+def known_rule_ids() -> Set[str]:
+    """Ids of every registered rule, AST (GL) and jaxpr (GJ) families."""
+    ids = {r.id for r in all_rules()}
+    try:
+        from pvraft_tpu.analysis.jaxpr.rules import all_jaxpr_rules
+
+        ids |= {r.id for r in all_jaxpr_rules()}
+    except ImportError:  # pragma: no cover - partial checkouts only
+        pass
+    return ids

@@ -1,0 +1,30 @@
+"""Jaxpr-level semantic analysis (deepcheck): the GJ rule family.
+
+Where graftlint (``pvraft_tpu.analysis.rules``) reads source text, this
+subpackage reads the traced program — every registered audit entry is
+traced to a ClosedJaxpr and walked for collective consistency, donation
+efficacy, precision flow and retrace hazards. Entry point:
+
+    python -m pvraft_tpu.analysis deepcheck
+"""
+
+from pvraft_tpu.analysis.jaxpr.deepcheck import (  # noqa: F401
+    DeepcheckReport,
+    EntryReport,
+    format_report,
+    run_deepcheck,
+    summary_line,
+)
+from pvraft_tpu.analysis.jaxpr.rules import (  # noqa: F401
+    EntryContext,
+    JaxprRule,
+    all_jaxpr_rules,
+    normalize_jaxpr_str,
+)
+from pvraft_tpu.analysis.jaxpr.walk import (  # noqa: F401
+    COLLECTIVE_PRIMITIVES,
+    Site,
+    collective_fingerprint,
+    dtype_conversions,
+    walk,
+)

@@ -1,0 +1,180 @@
+"""deepcheck driver: trace the audit corpus, run the GJ rules, report.
+
+``python -m pvraft_tpu.analysis deepcheck`` — the jaxpr-level sibling of
+``lint`` (AST rules) and ``trace`` (eval_shape audit). Every entry in
+the trace-compat audit registry (``pvraft_tpu/analysis/audit.py``) is
+traced to a ClosedJaxpr with ``jax.make_jaxpr`` and walked by the GJ001+
+rule family: collective consistency, donation efficacy, precision flow,
+retrace hazards. Zero FLOPs — tracing only, CPU-safe.
+
+Findings are ordinary :class:`Diagnostic`\\ s anchored at the source line
+that issued the primitive (or the audit-entry registration site), so the
+standard ``# graftlint: disable=GJxxx -- reason`` suppressions apply and
+``lint --stats`` accounts for the debt.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import traceback
+from typing import Dict, List, Sequence, Tuple
+
+from pvraft_tpu.analysis.engine import Diagnostic, filter_file_suppressions
+from pvraft_tpu.analysis.jaxpr.rules import (
+    EntryContext,
+    all_jaxpr_rules,
+)
+from pvraft_tpu.analysis.jaxpr.walk import (
+    COLLECTIVE_PRIMITIVES,
+    collective_fingerprint,
+    dtype_conversions,
+    walk,
+)
+
+
+@dataclasses.dataclass
+class EntryReport:
+    """Per-entry trace outcome and program statistics."""
+
+    name: str
+    ok: bool
+    detail: str = ""        # error summary when not ok
+    n_eqns: int = 0         # walked equations, all depths
+    n_collectives: int = 0
+    fingerprint: Tuple = ()
+    conversions: Dict[Tuple[str, str], int] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class DeepcheckReport:
+    diagnostics: List[Diagnostic]
+    suppressed: int
+    entries: List[EntryReport]
+
+    @property
+    def failures(self) -> List[EntryReport]:
+        return [e for e in self.entries if not e.ok]
+
+    @property
+    def ok(self) -> bool:
+        return not self.diagnostics and not self.failures
+
+
+def _relpath(path: str) -> str:
+    """Repo-root-relative display path — stable across checkouts and
+    invocation directories, which is what the golden report pins."""
+    import pvraft_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(
+        pvraft_tpu.__file__)))
+    try:
+        rel = os.path.relpath(path, root)
+    except ValueError:  # different drive (windows)
+        return path
+    return path if rel.startswith("..") else rel.replace(os.sep, "/")
+
+
+def run_deepcheck(
+    entries=None,
+    select_rules: Sequence[str] = (),
+    entry_filter: Sequence[str] = (),
+    retrace: bool = True,
+) -> DeepcheckReport:
+    """Trace every audit entry and run the GJ rules over the programs.
+
+    ``entries``: ``{name: AuditEntry}`` corpus (defaults to the full
+    audit registry). ``select_rules`` restricts to the named rule ids;
+    ``entry_filter`` keeps entries whose name contains any given
+    substring. ``retrace=False`` skips GJ007's rebuild probe (used by
+    tests that check structural rules in isolation). Never raises on a
+    broken entry: trace failures become ``EntryReport(ok=False)`` so one
+    bad op can't hide the rest — and fail the gate themselves.
+    """
+    import jax
+
+    if entries is None:
+        from pvraft_tpu.analysis.audit import entries as audit_entries
+
+        entries = audit_entries()
+
+    reports: List[EntryReport] = []
+    ectxs: List[EntryContext] = []
+    for name in sorted(entries):
+        if entry_filter and not any(s in name for s in entry_filter):
+            continue
+        meta = entries[name]
+        try:
+            fn, args = meta.thunk()
+            closed = jax.make_jaxpr(fn)(*args)
+            sites = walk(closed)
+        except Exception as e:  # noqa: BLE001 - report, don't crash
+            last = traceback.format_exception_only(type(e), e)[-1].strip()
+            reports.append(EntryReport(name, ok=False, detail=last[:500]))
+            continue
+        ectxs.append(EntryContext(
+            name=name,
+            precision=getattr(meta, "precision", "f32"),
+            spmd_group=getattr(meta, "spmd_group", None),
+            anchor_path=getattr(meta, "path", "") or "<registry>",
+            anchor_line=getattr(meta, "line", 0) or 1,
+            fn=fn,
+            args=args,
+            closed=closed,
+            sites=sites,
+            thunk=meta.thunk if retrace else None,
+        ))
+        reports.append(EntryReport(
+            name, ok=True,
+            n_eqns=len(sites),
+            n_collectives=sum(
+                1 for s in sites if s.primitive in COLLECTIVE_PRIMITIVES
+            ),
+            fingerprint=collective_fingerprint(sites),
+            conversions=dtype_conversions(sites),
+        ))
+
+    diags: List[Diagnostic] = []
+    for rule_cls in all_jaxpr_rules():
+        if select_rules and rule_cls.id not in select_rules:
+            continue
+        rule = rule_cls()
+        for ectx in ectxs:
+            diags.extend(rule.check(ectx))
+        diags.extend(rule_cls.check_corpus(ectxs))
+
+    kept, suppressed = filter_file_suppressions(diags)
+    kept = [dataclasses.replace(d, path=_relpath(d.path)) for d in kept]
+    kept.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id, d.message))
+    return DeepcheckReport(diagnostics=kept, suppressed=suppressed,
+                           entries=reports)
+
+
+def format_report(report: DeepcheckReport, verbose: bool = False) -> str:
+    """Findings (and, verbose, per-entry program stats) as stable text —
+    the shape the golden fixture pins down."""
+    lines: List[str] = []
+    for e in report.entries:
+        if not e.ok:
+            lines.append(f"[FAIL] {e.name}: {e.detail}")
+        elif verbose:
+            conv = ", ".join(
+                f"{a}->{b} x{n}" for (a, b), n in sorted(e.conversions.items())
+            ) or "none"
+            lines.append(
+                f"[ok] {e.name}: eqns={e.n_eqns} "
+                f"collectives={e.n_collectives} converts: {conv}"
+            )
+    for d in report.diagnostics:
+        lines.append(d.format())
+    return "\n".join(lines)
+
+
+def summary_line(report: DeepcheckReport) -> str:
+    return (
+        f"deepcheck: {len(report.diagnostics)} finding(s), "
+        f"{len(report.failures)} trace failure(s), "
+        f"{report.suppressed} suppressed, over "
+        f"{len(report.entries)} audit entr{'y' if len(report.entries) == 1 else 'ies'}"
+    )

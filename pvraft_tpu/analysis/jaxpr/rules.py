@@ -1,0 +1,491 @@
+"""deepcheck rules GJ001+ — semantic checks on the *traced* program.
+
+graftlint (GL001-GL009) reads source text; these rules read the
+ClosedJaxpr that jax actually hands to XLA, so they see through
+factories, closures, ``shard_map`` bodies and ``scan`` loops. Each
+rule's class docstring is its user-facing documentation (printed by
+``python -m pvraft_tpu.analysis deepcheck --list-rules``). Findings
+anchor to the source line that issued the offending primitive when jax
+can name one, so the ordinary ``# graftlint: disable=GJxxx -- reason``
+suppressions apply at that line; entry-level findings anchor to the
+audit-entry registration site in ``analysis/audit.py``.
+
+The corpus is the trace-compat audit registry: every public op and step
+variant already registers a ``(fn, args)`` thunk there, which is exactly
+the whole-program surface deepcheck needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Type
+
+from pvraft_tpu.analysis.engine import Diagnostic
+from pvraft_tpu.analysis.jaxpr.walk import (
+    COLLECTIVE_PRIMITIVES,
+    LOW_PRECISION,
+    Site,
+    collective_axes,
+    collective_fingerprint,
+    dtype_conversions,
+    low_precision_sites,
+)
+
+_HEX = re.compile(r"0x[0-9a-f]+")
+
+
+def normalize_jaxpr_str(s: str) -> str:
+    """Jaxpr strings embed object addresses (custom_jvp thunk reprs);
+    normalize them so two traces of the same program compare equal."""
+    return _HEX.sub("0x0", s)
+
+
+@dataclasses.dataclass
+class EntryContext:
+    """Everything the GJ rules can ask about one audit entry."""
+
+    name: str
+    precision: str                 # GJ006 intent: "f32" | "bf16_grads" | "any"
+    spmd_group: Optional[str]      # GJ003 fingerprint group, or None
+    anchor_path: str               # suppression anchor: registration site
+    anchor_line: int
+    fn: Callable
+    args: tuple
+    closed: Any                    # ClosedJaxpr of fn(*args)
+    sites: List[Site]
+    thunk: Optional[Callable]      # rebuilds (fn, args) — GJ007 retrace probe
+
+    def diag(self, rule_id: str, message: str,
+             site: Optional[Site] = None) -> Diagnostic:
+        path, line = self.anchor_path, self.anchor_line
+        if site is not None:
+            src = site.source()
+            if src is not None:
+                path, line = src
+        return Diagnostic(path=path, line=line, col=0, rule_id=rule_id,
+                          message=f"{message} [entry: {self.name}]")
+
+
+class JaxprRule:
+    """Base class: subclasses set ``id``/``title`` and implement
+    ``check`` (per entry). Rules needing the whole corpus at once
+    (fingerprint comparison) also implement ``check_corpus``."""
+
+    id: str = ""
+    title: str = ""
+
+    def check(self, ectx: EntryContext) -> Iterable[Diagnostic]:
+        return ()
+
+    @classmethod
+    def check_corpus(
+        cls, ectxs: List[EntryContext]
+    ) -> Iterable[Diagnostic]:
+        return ()
+
+
+_REGISTRY: List[Type[JaxprRule]] = []
+
+
+def register(cls: Type[JaxprRule]) -> Type[JaxprRule]:
+    if not cls.id or not cls.title:
+        raise ValueError(f"rule {cls.__name__} must set id and title")
+    if any(r.id == cls.id for r in _REGISTRY):
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY.append(cls)
+    return cls
+
+
+def all_jaxpr_rules() -> Tuple[Type[JaxprRule], ...]:
+    return tuple(sorted(_REGISTRY, key=lambda r: r.id))
+
+
+def _fmt_aval(aval) -> str:
+    dt = str(getattr(aval, "dtype", "?"))
+    shape = ",".join(str(d) for d in getattr(aval, "shape", ()))
+    return f"{dt}[{shape}]"
+
+
+# --- GJ001 ----------------------------------------------------------------
+
+@register
+class UnboundCollectiveAxis(JaxprRule):
+    """Collective over an axis name no enclosing binder provides.
+
+    A ``psum``/``ppermute``/``all_gather`` axis must be bound by an
+    enclosing ``shard_map`` (over a mesh axis it maps manually) or
+    ``pmap``. An unbound axis traces only under an ambient ``axis_env``
+    and fails the moment the function is jitted standalone — the
+    classic "works in the test harness, dies on the TPU pod" hazard.
+    """
+
+    id = "GJ001"
+    title = "unbound-collective-axis"
+
+    def check(self, ectx: EntryContext) -> Iterable[Diagnostic]:
+        for site in ectx.sites:
+            if site.primitive not in COLLECTIVE_PRIMITIVES:
+                continue
+            unbound = [
+                a for a in collective_axes(site.eqn)
+                if isinstance(a, str) and a not in site.bound_axes
+            ]
+            if unbound:
+                yield ectx.diag(
+                    self.id,
+                    f"`{site.primitive}` over axis "
+                    f"{'/'.join(map(repr, unbound))} with no enclosing "
+                    "shard_map/pmap binding it; the program cannot be "
+                    "jitted standalone",
+                    site,
+                )
+
+
+# --- GJ002 ----------------------------------------------------------------
+
+@register
+class DeadCollective(JaxprRule):
+    """Collective whose result is never consumed — wasted inter-chip
+    traffic.
+
+    Two shapes: (a) the result reaches no live output at all (pure dead
+    code that XLA may or may not strip, but the intent bug is real
+    either way); (b) the result only feeds a loop carry whose final
+    value is discarded after the loop — every iteration's send matters
+    except the last, so the ring issues one full hop of ICI traffic
+    nobody reads. Peel the final fold out of the loop.
+    """
+
+    id = "GJ002"
+    title = "dead-collective"
+
+    def check(self, ectx: EntryContext) -> Iterable[Diagnostic]:
+        for site in ectx.sites:
+            if site.primitive not in COLLECTIVE_PRIMITIVES:
+                continue
+            if not site.live:
+                yield ectx.diag(
+                    self.id,
+                    f"dead `{site.primitive}` (result unused): the "
+                    "collective moves bytes across the "
+                    f"{'/'.join(map(str, collective_axes(site.eqn)))} "
+                    "axis for nothing",
+                    site,
+                )
+            elif site.dead_final_carry:
+                yield ectx.diag(
+                    self.id,
+                    f"`{site.primitive}` feeds a loop carry whose final "
+                    "value is discarded: the last iteration's hop is "
+                    "wasted comm; peel the final fold out of the loop",
+                    site,
+                )
+
+
+# --- GJ003 ----------------------------------------------------------------
+
+@register
+class CollectiveFingerprintDrift(JaxprRule):
+    """Step variants in one SPMD group issue different collective
+    sequences.
+
+    Variants of the same step (default / optimized-backward / telemetry)
+    must stay SPMD-compatible: under multi-process execution every
+    process must issue the SAME ordered collective sequence or the mesh
+    deadlocks. Entries registered with a shared ``spmd_group`` in
+    ``analysis/audit.py`` are fingerprinted (ordered primitive, axes,
+    shape, dtype) and compared; a variant that grows a collective the
+    others lack fails here before it hangs a pod.
+    """
+
+    id = "GJ003"
+    title = "collective-fingerprint-drift"
+
+    @classmethod
+    def check_corpus(
+        cls, ectxs: List[EntryContext]
+    ) -> Iterable[Diagnostic]:
+        groups: Dict[str, List[EntryContext]] = {}
+        for e in ectxs:
+            if e.spmd_group:
+                groups.setdefault(e.spmd_group, []).append(e)
+        for gname in sorted(groups):
+            members = groups[gname]
+            if len(members) < 2:
+                continue
+            prints = {e.name: collective_fingerprint(e.sites)
+                      for e in members}
+            ref = members[0]
+            ref_fp = prints[ref.name]
+            for e in members[1:]:
+                if prints[e.name] != ref_fp:
+                    yield e.diag(
+                        cls.id,
+                        f"collective fingerprint differs from "
+                        f"`{ref.name}` within spmd_group "
+                        f"'{gname}': {prints[e.name]!r} vs {ref_fp!r}; "
+                        "SPMD-incompatible variants deadlock a "
+                        "multi-process mesh",
+                    )
+
+
+# --- GJ004 ----------------------------------------------------------------
+
+@register
+class UnaliasableDonation(JaxprRule):
+    """Donated buffer XLA cannot alias to any output — a silent copy.
+
+    ``donate_argnums`` only saves memory when the donated input's
+    (shape, dtype) matches an output buffer XLA can reuse. A donated
+    buffer with no matching output is quietly copied instead: the
+    params/opt_state still exist twice in HBM at peak, exactly the 2x
+    the donation was supposed to remove, with nothing but a lowering
+    warning nobody reads.
+    """
+
+    id = "GJ004"
+    title = "unaliasable-donation"
+
+    def check(self, ectx: EntryContext) -> Iterable[Diagnostic]:
+        for site in ectx.sites:
+            yield from self._check_pjit(ectx, site)
+
+    def _check_pjit(self, ectx, site) -> Iterable[Diagnostic]:
+        eqn = site.eqn
+        if site.primitive != "pjit":
+            return
+        donated = eqn.params.get("donated_invars") or ()
+        if not any(donated):
+            return
+        outs = [_fmt_aval(o.aval) for o in eqn.outvars]
+        remaining: Dict[str, int] = {}
+        for o in outs:
+            remaining[o] = remaining.get(o, 0) + 1
+        unmatched: List[Tuple[int, str]] = []
+        for i, (iv, d) in enumerate(zip(eqn.invars, donated)):
+            if not d:
+                continue
+            key = _fmt_aval(iv.aval)
+            if remaining.get(key, 0) > 0:
+                remaining[key] -= 1
+            else:
+                unmatched.append((i, key))
+        name = eqn.params.get("name", "<jit>")
+        for i, key in unmatched:
+            yield ectx.diag(
+                self.id,
+                f"donated arg {i} of jitted `{name}` ({key}) matches no "
+                "output buffer; XLA copies it silently — the donated "
+                "state still costs 2x HBM at peak",
+                site,
+            )
+
+
+# --- GJ005 ----------------------------------------------------------------
+
+@register
+class UndonatedStateBuffer(JaxprRule):
+    """Donation-opted-in step leaves a donatable input buffer undonated.
+
+    In a jitted program that already donates state (the author marked it
+    consume-on-call), an UNdonated input whose (shape, dtype) matches an
+    output buffer that no donated input claims is a missed alias: XLA
+    must allocate the output fresh while the input sits dead — peak HBM
+    one full buffer higher than necessary. Donate it or document why the
+    caller still needs it.
+    """
+
+    id = "GJ005"
+    title = "undonated-state-buffer"
+
+    def check(self, ectx: EntryContext) -> Iterable[Diagnostic]:
+        for site in ectx.sites:
+            yield from self._check_pjit(ectx, site)
+
+    def _check_pjit(self, ectx, site) -> Iterable[Diagnostic]:
+        eqn = site.eqn
+        if site.primitive != "pjit":
+            return
+        donated = eqn.params.get("donated_invars") or ()
+        if not any(donated):
+            # No donation opt-in: eval-style programs legitimately keep
+            # every input alive (params are reused across calls).
+            return
+        remaining: Dict[str, int] = {}
+        for o in eqn.outvars:
+            key = _fmt_aval(o.aval)
+            remaining[key] = remaining.get(key, 0) + 1
+        for iv, d in zip(eqn.invars, donated):
+            if d:
+                key = _fmt_aval(iv.aval)
+                if remaining.get(key, 0) > 0:
+                    remaining[key] -= 1
+        name = eqn.params.get("name", "<jit>")
+        for i, (iv, d) in enumerate(zip(eqn.invars, donated)):
+            if d:
+                continue
+            key = _fmt_aval(iv.aval)
+            if remaining.get(key, 0) > 0:
+                remaining[key] -= 1
+                yield ectx.diag(
+                    self.id,
+                    f"undonated arg {i} of jitted `{name}` ({key}) "
+                    "matches an unclaimed output buffer; donating it "
+                    "would let XLA alias instead of allocating fresh",
+                    site,
+                )
+
+
+# --- GJ006 ----------------------------------------------------------------
+
+@register
+class PrecisionDrift(JaxprRule):
+    """Traced precision disagrees with the entry's declared intent.
+
+    Every audit entry declares its precision intent (default ``f32``;
+    the bf16-gradient step declares ``bf16_grads``). The rule walks the
+    dtype flow of the whole traced program: an ``f32`` program must
+    contain no 16-bit float values anywhere (a stray cast deep in a
+    factory silently truncates gradients — the drift class the Gemma
+    TPU report blames for most regressions); a ``bf16_grads`` program
+    must actually contain the f32->bf16 truncation it advertises (an
+    inert lever is measurement fraud in every A/B that cites it) and
+    must not leak bf16 out of the step.
+    """
+
+    id = "GJ006"
+    title = "precision-drift"
+
+    def check(self, ectx: EntryContext) -> Iterable[Diagnostic]:
+        if ectx.precision == "any":
+            return
+        conv = dtype_conversions(ectx.sites)
+        if ectx.precision == "f32":
+            lp = low_precision_sites(ectx.sites)
+            if lp:
+                conv_map = {
+                    f"{a}->{b}": n for (a, b), n in sorted(conv.items())
+                    if a in LOW_PRECISION or b in LOW_PRECISION
+                }
+                yield ectx.diag(
+                    self.id,
+                    f"{len(lp)} equation(s) carry 16-bit float values in "
+                    "a float32-intent program (conversions: "
+                    f"{conv_map}); declare the intent in the audit "
+                    "entry or remove the cast",
+                    lp[0],
+                )
+            return
+        if ectx.precision == "bf16_grads":
+            down = conv.get(("float32", "bfloat16"), 0)
+            if down == 0:
+                yield ectx.diag(
+                    self.id,
+                    "entry declares bf16_grads but the trace contains "
+                    "no float32->bfloat16 truncation: the grad_dtype "
+                    "lever is inert in this configuration",
+                )
+            leaks = [
+                _fmt_aval(a) for a in getattr(ectx.closed, "out_avals", ())
+                if str(getattr(a, "dtype", "")) in LOW_PRECISION
+            ]
+            if leaks:
+                yield ectx.diag(
+                    self.id,
+                    f"bf16 leaks out of the step ({', '.join(leaks)}): "
+                    "grads must be restored to float32 before the "
+                    "optimizer state update",
+                )
+            return
+        yield ectx.diag(
+            self.id,
+            f"unknown precision intent {ectx.precision!r} on the audit "
+            "entry (expected 'f32', 'bf16_grads' or 'any')",
+        )
+
+
+# --- GJ007 ----------------------------------------------------------------
+
+@register
+class RetraceHazard(JaxprRule):
+    """Program structure changes between equivalent traces — silent
+    recompiles in production.
+
+    Two probes. (a) Determinism: rebuilding the entry and retracing must
+    reproduce the jaxpr byte-for-byte (addresses normalized); a trace
+    that embeds fresh state (counters, dict order, ``id()``-derived
+    names) misses the jit cache on every call and recompiles a
+    multi-second XLA program per step. (b) Weak types: scalar inputs
+    retraced as Python scalars (weak-typed, what callers actually pass)
+    must yield the same output dtypes; if they differ, the same call
+    site silently computes in two precisions depending on who called
+    first — the recompilation class the source linter cannot see.
+    """
+
+    id = "GJ007"
+    title = "retrace-hazard"
+
+    def check(self, ectx: EntryContext) -> Iterable[Diagnostic]:
+        import jax
+
+        if ectx.thunk is None:
+            return
+        # (a) trace determinism: rebuild from scratch, compare jaxprs.
+        try:
+            fn2, args2 = ectx.thunk()
+            second = jax.make_jaxpr(fn2)(*args2)
+        except Exception as e:  # noqa: BLE001 - report, don't crash
+            yield ectx.diag(
+                self.id,
+                f"entry could not be re-traced for the determinism "
+                f"probe: {type(e).__name__}: {e}",
+            )
+            return
+        first_s = normalize_jaxpr_str(str(ectx.closed))
+        second_s = normalize_jaxpr_str(str(second))
+        if first_s != second_s:
+            yield ectx.diag(
+                self.id,
+                "re-tracing the rebuilt entry produced a different "
+                "jaxpr: the trace embeds per-build state, so every jit "
+                "call misses the cache and recompiles",
+            )
+        # (b) weak-type probe on 0-d inputs.
+        yield from self._weak_probe(ectx)
+
+    def _weak_probe(self, ectx: EntryContext) -> Iterable[Diagnostic]:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(ectx.args)
+        scalar_idx = [
+            i for i, leaf in enumerate(leaves)
+            if isinstance(leaf, jax.ShapeDtypeStruct) and leaf.shape == ()
+            and leaf.dtype.kind in "fi"
+        ]
+        if not scalar_idx:
+            return
+        weak = list(leaves)
+        for i in scalar_idx:
+            weak[i] = 1.0 if leaves[i].dtype.kind == "f" else 1
+        weak_args = jax.tree_util.tree_unflatten(treedef, weak)
+        try:
+            strong_out = jax.eval_shape(ectx.fn, *ectx.args)
+            weak_out = jax.eval_shape(ectx.fn, *weak_args)
+        except Exception as e:  # noqa: BLE001 - report, don't crash
+            yield ectx.diag(
+                self.id,
+                f"weak-type probe failed to trace: "
+                f"{type(e).__name__}: {e}",
+            )
+            return
+        s_dts = [str(x.dtype) for x in jax.tree_util.tree_leaves(strong_out)]
+        w_dts = [str(x.dtype) for x in jax.tree_util.tree_leaves(weak_out)]
+        if s_dts != w_dts:
+            yield ectx.diag(
+                self.id,
+                "retracing with Python scalars in place of 0-d arrays "
+                f"changes output dtypes ({s_dts} -> {w_dts}): callers "
+                "passing plain scalars get a silently different (and "
+                "separately compiled) program",
+            )

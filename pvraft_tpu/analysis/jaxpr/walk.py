@@ -1,0 +1,327 @@
+"""ClosedJaxpr walking utilities shared by the GJ rule family.
+
+The deepcheck rules (``pvraft_tpu.analysis.jaxpr.rules``) don't read
+source text — they read the *traced program*. This module turns a
+``ClosedJaxpr`` into a flat list of :class:`Site` records, one per
+equation at every nesting depth, each annotated with everything the
+rules need and the jaxpr itself doesn't say locally:
+
+- ``bound_axes``: mesh axis names bound by the enclosing
+  ``shard_map``/``pmap`` binders (collectives over anything else are
+  broken SPMD programs — rule GJ001);
+- ``live``: whether the equation's results transitively reach a live
+  output (a dead collective is wasted inter-chip traffic — GJ002).
+  Liveness is computed per sub-jaxpr with the outer equation's used
+  outputs as the root set; ``scan`` carries run through a fixpoint so a
+  value that only feeds the *next* iteration still counts as live;
+- ``dead_final_carry``: set on a collective that produces a scan carry
+  whose final value is discarded after the loop — every iteration's
+  communication is needed except the last one, which is pure waste
+  (the ring-parallel pattern GJ002 exists to catch);
+- ``source``: the ``(file, line)`` that issued the primitive (via
+  ``compat.eqn_user_frame``) so findings anchor to real code and the
+  ``# graftlint: disable=...`` suppressions apply.
+
+Only duck-typing against the jaxpr data structures (``.eqns``,
+``.outvars``, ``params`` sub-jaxprs) — no private jax imports here, so
+the walker keeps working when internal modules move.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+# Primitives that move bytes between devices. ``axis_index`` and friends
+# are cheap metadata lookups, not traffic — deliberately excluded.
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather",
+})
+
+
+def _is_jaxpr(x: Any) -> bool:
+    return hasattr(x, "eqns") and hasattr(x, "outvars")
+
+
+def _as_jaxpr(x: Any):
+    """Unwrap ClosedJaxpr -> Jaxpr; pass a raw Jaxpr through."""
+    if _is_jaxpr(x):
+        return x
+    inner = getattr(x, "jaxpr", None)
+    return inner if _is_jaxpr(inner) else None
+
+
+def _is_var(v: Any) -> bool:
+    # Var/DropVar have .aval and no .val; Literal carries .val.
+    return hasattr(v, "aval") and not hasattr(v, "val")
+
+
+def _is_drop(v: Any) -> bool:
+    return type(v).__name__ == "DropVar"
+
+
+def collective_axes(eqn) -> Tuple[Any, ...]:
+    """The axis names a collective equation communicates over.
+
+    jax spells the parameter ``axes`` (psum/pmean/pmax/pmin) or
+    ``axis_name`` (ppermute/all_gather/...), either a single name or a
+    tuple; entries can be ints for positional (vmapped) axes."""
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(axes)
+
+
+@dataclasses.dataclass
+class Site:
+    """One equation in the walked program, with its analysis context."""
+
+    eqn: Any
+    depth: int
+    bound_axes: frozenset
+    live: bool
+    # Collective feeding a scan carry whose final value is discarded
+    # after the loop (the "last ring hop" pattern).
+    dead_final_carry: bool = False
+    # Enclosing call-primitive names, outermost first (e.g.
+    # ("pjit:train_step", "scan")) — for human-readable reports.
+    path: Tuple[str, ...] = ()
+
+    @property
+    def primitive(self) -> str:
+        return self.eqn.primitive.name
+
+    def source(self) -> Optional[Tuple[str, int]]:
+        from pvraft_tpu.compat import eqn_user_frame
+
+        si = getattr(self.eqn, "source_info", None)
+        return eqn_user_frame(si) if si is not None else None
+
+
+def _sub_jaxprs(params: Dict[str, Any]) -> Iterator[Any]:
+    """Every Jaxpr found in an equation's params (generic fallback for
+    call-like primitives the walker doesn't special-case)."""
+    for v in params.values():
+        if isinstance(v, (tuple, list)):
+            for item in v:
+                j = _as_jaxpr(item)
+                if j is not None:
+                    yield j
+        else:
+            j = _as_jaxpr(v)
+            if j is not None:
+                yield j
+
+
+def _real_effects(eqn) -> bool:
+    """True effects only: jax tags every collective with a
+    ``NamedAxisEffect`` (axis bookkeeping, not IO/ordering), which must
+    not shield a dead collective from liveness analysis."""
+    return any(
+        type(e).__name__ != "NamedAxisEffect"
+        for e in (getattr(eqn, "effects", None) or ())
+    )
+
+
+def _liveness(
+    jaxpr, live_out: Sequence[bool]
+) -> Tuple[List[bool], List[bool], set]:
+    """Backward pass: per-eqn liveness, per-invar liveness, and the set
+    of live variables (for per-OUTPUT liveness of call-like equations —
+    a jit call can be live through one output while another output, and
+    the collective feeding it, is dead).
+
+    An equation is live when any of its (non-drop) outputs transitively
+    reaches a live jaxpr output, or when it carries real effects (an
+    effectful equation must run regardless of dataflow)."""
+    live_vars = set()
+    for v, lv in zip(jaxpr.outvars, live_out):
+        if lv and _is_var(v) and not _is_drop(v):
+            live_vars.add(v)
+    eqn_live_rev: List[bool] = []
+    for eqn in reversed(jaxpr.eqns):
+        live = _real_effects(eqn) or any(
+            (not _is_drop(o)) and o in live_vars for o in eqn.outvars
+        )
+        eqn_live_rev.append(live)
+        if live:
+            for iv in eqn.invars:
+                if _is_var(iv):
+                    live_vars.add(iv)
+    invar_live = [v in live_vars for v in jaxpr.invars]
+    return list(reversed(eqn_live_rev)), invar_live, live_vars
+
+
+def _producer(jaxpr, var) -> Optional[Any]:
+    for eqn in jaxpr.eqns:
+        if any(o is var for o in eqn.outvars):
+            return eqn
+    return None
+
+
+def walk(closed) -> List[Site]:
+    """Flatten a ClosedJaxpr into analysis Sites, all depths included."""
+    sites: List[Site] = []
+    top = _as_jaxpr(closed)
+    _walk(top, [True] * len(top.outvars), frozenset(), 0, (), sites)
+    return sites
+
+
+def _eqn_label(eqn) -> str:
+    name = eqn.primitive.name
+    tag = eqn.params.get("name")
+    return f"{name}:{tag}" if isinstance(tag, str) else name
+
+
+def _walk(jaxpr, live_out, bound, depth, path, sites: List[Site]) -> None:
+    eqn_live, _, live_vars = _liveness(jaxpr, live_out)
+
+    def out_live(eqn, live):
+        # Per-OUTPUT liveness: an output is live iff something actually
+        # consumes it — not merely because a sibling output does.
+        return [
+            live and not _is_drop(o) and o in live_vars
+            for o in eqn.outvars
+        ]
+
+    for eqn, live in zip(jaxpr.eqns, eqn_live):
+        site = Site(eqn=eqn, depth=depth, bound_axes=bound, live=live,
+                    path=path)
+        sites.append(site)
+        name = eqn.primitive.name
+        inner_bound = bound
+        if name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            auto = frozenset(eqn.params.get("auto") or ())
+            names = frozenset(getattr(mesh, "axis_names", ()) or ())
+            inner_bound = bound | (names - auto)
+        elif name in ("xla_pmap", "pmap"):
+            axis = eqn.params.get("axis_name")
+            if axis is not None:
+                inner_bound = bound | {axis}
+        sub_path = path + (_eqn_label(eqn),)
+
+        if name in ("pjit", "shard_map", "closed_call", "core_call",
+                    "remat", "checkpoint", "custom_vjp_call_jaxpr"):
+            # Outputs map 1:1 onto the inner jaxpr's outputs.
+            key = "fun_jaxpr" if name == "custom_vjp_call_jaxpr" else "jaxpr"
+            inner = _as_jaxpr(eqn.params.get(key))
+            if inner is not None:
+                lo = out_live(eqn, live)
+                # remat/custom_vjp inner jaxprs may carry extra residual
+                # outputs beyond the eqn's outvars; pad as live.
+                lo += [live] * (len(inner.outvars) - len(lo))
+                _walk(inner, lo[: len(inner.outvars)], inner_bound,
+                      depth + 1, sub_path, sites)
+                continue
+        if name == "scan":
+            _walk_scan(eqn, out_live(eqn, live), inner_bound, depth + 1,
+                       sub_path, sites)
+            continue
+        if name == "cond":
+            branches = eqn.params.get("branches", ())
+            for br in branches:
+                inner = _as_jaxpr(br)
+                if inner is not None:
+                    lo = out_live(eqn, live)
+                    _walk(inner, lo[: len(inner.outvars)], inner_bound,
+                          depth + 1, sub_path, sites)
+            continue
+        # Generic fallback (while, custom_jvp, pallas_call grids, ...):
+        # conservative — treat every inner output as live so nothing is
+        # falsely reported dead.
+        for inner in _sub_jaxprs(eqn.params):
+            _walk(inner, [live] * len(inner.outvars), inner_bound,
+                  depth + 1, sub_path, sites)
+
+
+def _walk_scan(eqn, outer_live: List[bool], bound, depth, path,
+               sites: List[Site]) -> None:
+    body = _as_jaxpr(eqn.params["jaxpr"])
+    if body is None:  # defensive: unknown scan encoding
+        return
+    num_carry = eqn.params.get("num_carry", 0)
+    num_consts = eqn.params.get("num_consts", 0)
+    carry_live = list(outer_live[:num_carry])
+    ys_live = list(outer_live[num_carry:])
+    # Fixpoint: a carry whose final value is dropped is still live if it
+    # feeds, through the body, a carry/output that IS live — it matters
+    # to later iterations.
+    for _ in range(num_carry + 1):
+        _, invar_live, _ = _liveness(body, carry_live + ys_live)
+        new_carry = [
+            cl or invar_live[num_consts + i]
+            for i, cl in enumerate(carry_live)
+        ]
+        if new_carry == carry_live:
+            break
+        carry_live = new_carry
+    before = len(sites)
+    _walk(body, carry_live + ys_live, bound, depth, path, sites)
+    body_sites = sites[before:]
+    # The "last ring hop" pattern: a collective producing a carry whose
+    # final value is discarded. Every iteration's send is needed to feed
+    # the next fold — except the last one, whose result nobody reads.
+    for j in range(num_carry):
+        if outer_live[j]:
+            continue
+        out_v = body.outvars[j]
+        if not _is_var(out_v) or _is_drop(out_v):
+            continue
+        prod = _producer(body, out_v)
+        if prod is not None and prod.primitive.name in COLLECTIVE_PRIMITIVES:
+            for s in body_sites:
+                if s.eqn is prod:
+                    s.dead_final_carry = True
+                    break
+
+
+# --- derived views --------------------------------------------------------
+
+def collective_fingerprint(sites: Sequence[Site]) -> Tuple[Tuple, ...]:
+    """Deterministic summary of the program's communication schedule:
+    ordered (primitive, axes, operand shape, operand dtype) tuples. Two
+    step variants with equal fingerprints issue identical collective
+    sequences — the SPMD-compatibility contract GJ003 checks."""
+    out = []
+    for s in sites:
+        if s.primitive not in COLLECTIVE_PRIMITIVES:
+            continue
+        axes = tuple(str(a) for a in collective_axes(s.eqn))
+        opnd = next((v for v in s.eqn.invars if _is_var(v)), None)
+        aval = getattr(opnd, "aval", None)
+        shape = tuple(getattr(aval, "shape", ()))
+        dtype = str(getattr(aval, "dtype", "?"))
+        out.append((s.primitive, axes, shape, dtype))
+    return tuple(out)
+
+
+def dtype_conversions(sites: Sequence[Site]) -> Dict[Tuple[str, str], int]:
+    """Count of convert_element_type edges, keyed (src, dst) dtype names
+    — the program's precision-flow map (promotions and truncations)."""
+    out: Dict[Tuple[str, str], int] = {}
+    for s in sites:
+        if s.primitive != "convert_element_type":
+            continue
+        src = next((v for v in s.eqn.invars if _is_var(v)), None)
+        src_dt = str(getattr(getattr(src, "aval", None), "dtype", "?"))
+        dst_dt = str(s.eqn.params.get("new_dtype", "?"))
+        key = (src_dt, dst_dt)
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+LOW_PRECISION = frozenset({"bfloat16", "float16"})
+
+
+def low_precision_sites(sites: Sequence[Site]) -> List[Site]:
+    """Sites whose outputs carry a 16-bit float dtype."""
+    out = []
+    for s in sites:
+        for o in s.eqn.outvars:
+            dt = str(getattr(getattr(o, "aval", None), "dtype", ""))
+            if dt in LOW_PRECISION:
+                out.append(s)
+                break
+    return out

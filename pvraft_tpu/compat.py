@@ -129,3 +129,25 @@ def checkpoint_name(x, name: str):
     from jax.ad_checkpoint import checkpoint_name as fn
 
     return fn(x, name)
+
+
+def eqn_user_frame(source_info):
+    """``(file_name, line)`` of the first non-jax frame that issued a
+    jaxpr equation, or ``None``.
+
+    The deepcheck analyzer (``pvraft_tpu.analysis.jaxpr``) uses this to
+    anchor jaxpr-level findings to the source line that emitted the
+    primitive, so the standard ``# graftlint: disable=...`` suppressions
+    apply. ``source_info_util`` is a private jax module with no stable
+    home — routed here so an upgrade that moves it degrades anchoring
+    (findings fall back to the audit-entry site) instead of breaking the
+    analyzer."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(source_info)
+    except Exception:  # pragma: no cover - exercised only on future jax
+        return None
+    if frame is None:
+        return None
+    return frame.file_name, frame.start_line

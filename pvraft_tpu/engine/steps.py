@@ -57,7 +57,9 @@ def make_train_step(
     Python-level dead code and the jaxpr stays byte-identical
     (test-gated, ``tests/test_obs.py``)."""
 
-    def step(params, opt_state, batch):
+    # Named per variant so pjit compiles a distinguishable program:
+    # profiles, deepcheck donation findings and XLA dumps say WHICH step.
+    def train_step(params, opt_state, batch):
         def loss_fn(p):
             flows, _ = model.apply(p, batch["pc1"], batch["pc2"], num_iters)
             loss = sequence_loss(flows, batch["mask"], batch["flow"], gamma)
@@ -81,7 +83,7 @@ def make_train_step(
             metrics["telemetry"] = tel
         return params, opt_state, metrics
 
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
 
 
 def make_refine_train_step(
@@ -99,7 +101,7 @@ def make_refine_train_step(
     ``telemetry`` as in :func:`make_train_step`; the refine model returns
     one flow, so there is no per-iteration ``delta_flow_norm`` leaf."""
 
-    def step(params, opt_state, batch):
+    def refine_train_step(params, opt_state, batch):
         def loss_fn(p):
             flow = model.apply(p, batch["pc1"], batch["pc2"], num_iters)
             return compute_loss(flow, batch["mask"], batch["flow"]), flow
@@ -118,7 +120,7 @@ def make_refine_train_step(
             metrics["telemetry"] = tel
         return params, opt_state, metrics
 
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    return jax.jit(refine_train_step, donate_argnums=(0, 1) if donate else ())
 
 
 def make_packed_train_step(
@@ -171,7 +173,7 @@ def _packed_step_fn(model, tx, gamma, num_iters, params, opt_state, refine,
 
     flat0, unravel = ravel_pytree((params, opt_state))
 
-    def step(flat, batch):
+    def packed_train_step(flat, batch):
         params, opt_state = unravel(flat)
 
         def loss_fn(p):
@@ -201,7 +203,7 @@ def _packed_step_fn(model, tx, gamma, num_iters, params, opt_state, refine,
         new_flat, _ = ravel_pytree((params, opt_state))
         return new_flat, metrics
 
-    return step, flat0, unravel
+    return packed_train_step, flat0, unravel
 
 
 def make_multistep_train_step(
@@ -248,11 +250,11 @@ def make_multistep_train_step(
         telemetry,
     )
 
-    def step(flat, batches):
+    def multistep_train_step(flat, batches):
         return jax.lax.scan(inner, flat, batches)
 
     return (
-        jax.jit(step, donate_argnums=(0,) if donate else ()),
+        jax.jit(multistep_train_step, donate_argnums=(0,) if donate else ()),
         flat0,
         unravel,
     )
@@ -268,7 +270,7 @@ def make_eval_step(model, num_iters: int, gamma: float, refine: bool = False,
     bs=1 running means exact when the standalone eval batches scenes
     across the device mesh (``test.py:128-142`` semantics at any batch)."""
 
-    def step(params, batch):
+    def eval_step(params, batch):
         mask, gt = batch["mask"], batch["flow"]
         if refine:
             flow = model.apply(params, batch["pc1"], batch["pc2"], num_iters)
@@ -298,4 +300,4 @@ def make_eval_step(model, num_iters: int, gamma: float, refine: bool = False,
             out.update(flow_metrics(flow, mask, gt))
         return out, flow
 
-    return jax.jit(step)
+    return jax.jit(eval_step)

@@ -39,7 +39,13 @@ def pairwise_sqdist(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """
     a2 = jnp.sum(a * a, axis=-1, keepdims=True)            # (B, N, 1)
     b2 = jnp.sum(b * b, axis=-1, keepdims=True)            # (B, M, 1)
-    cross = jnp.einsum("bnc,bmc->bnm", a, b)
+    # f32 accumulation pinned (precision-flow discipline, deepcheck
+    # GJ006): neighbor SELECTION must not move with the compute_dtype
+    # lever — bf16-accumulated distances change which edges the graph
+    # aggregates. Same convention as corr.py / ring.py / scatter_free.py.
+    cross = jnp.einsum(
+        "bnc,bmc->bnm", a, b, preferred_element_type=jnp.float32
+    )
     return a2 + jnp.swapaxes(b2, -1, -2) - 2.0 * cross
 
 
@@ -97,7 +103,11 @@ def knn_indices(
         best_negd, best_idx = carry
         pts, off = xs                                        # (B, chunk, 3)
         p2 = jnp.sum(pts * pts, axis=-1)[:, None, :]         # (B, 1, chunk)
-        cross = jnp.einsum("bnc,bmc->bnm", query, pts)
+        # f32 accumulation pinned — same selection-precision discipline
+        # as the dense path above.
+        cross = jnp.einsum(
+            "bnc,bmc->bnm", query, pts, preferred_element_type=jnp.float32
+        )
         negd = -(q2 + p2 - 2.0 * cross)                      # (B, N, chunk)
         idx = jnp.broadcast_to(
             (jnp.arange(chunk, dtype=jnp.int32) + off)[None, None, :],
@@ -110,7 +120,9 @@ def knn_indices(
         return (new_v, new_i), None
 
     init = (
-        jnp.full((b, query.shape[1], k), -jnp.inf, query.dtype),
+        # f32 like the fold output (the pinned-accumulation einsum):
+        # a bf16 query must not give the scan a carry-dtype mismatch.
+        jnp.full((b, query.shape[1], k), -jnp.inf, jnp.float32),
         jnp.zeros((b, query.shape[1], k), jnp.int32),
     )
     (_, idx), _ = lax.scan(step, init, (points_c, offsets))

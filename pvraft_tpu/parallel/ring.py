@@ -6,7 +6,9 @@ points, 1 GB at 16,384). Here both point axes are sharded over the ``seq``
 mesh axis and the N2 chunks circulate around the ring with ``ppermute``
 (the ring-attention pattern applied to correlation): each device holds
 fmap1/N1-shard permanently, receives one fmap2/xyz2 chunk per ring step,
-folds it into a running top-k of size K, and forwards the chunk over ICI.
+folds it into a running top-k of size K, and forwards the chunk over ICI
+— P-1 hops total; the chunk held at the final fold is not sent onward
+(its receive would be dead, deepcheck rule GJ002).
 Peak memory per device: O(N1/P * (K + N2/P)) — the full volume is never
 materialized anywhere.
 
@@ -46,11 +48,15 @@ def ring_knn_indices(
     perm = [(i, (i + 1) % p) for i in range(p)]
     q2 = jnp.sum(query * query, axis=-1, keepdims=True)      # (B, Nq, 1)
 
-    def body(i, state):
-        best_v, best_i, db_c = state
+    def fold(i, best_v, best_i, db_c):
         src = (me - i) % p          # shard this chunk originated from
         p2 = jnp.sum(db_c * db_c, axis=-1)[:, None, :]       # (B, 1, chunk)
-        cross = jnp.einsum("bnc,bmc->bnm", query, db_c)
+        # f32 accumulation pinned: neighbor selection must match the
+        # dense path (ops/geometry.pairwise_sqdist) bit for bit under
+        # any compute_dtype — precision-flow discipline, deepcheck GJ006.
+        cross = jnp.einsum(
+            "bnc,bmc->bnm", query, db_c, preferred_element_type=jnp.float32
+        )
         negd = -(q2 + p2 - 2.0 * cross)                      # (B, Nq, chunk)
         gidx = jnp.broadcast_to(
             (src * chunk + jnp.arange(chunk, dtype=jnp.int32))[None, None, :],
@@ -60,15 +66,28 @@ def ring_knn_indices(
         cand_i = jnp.concatenate([best_i, gidx], axis=-1)
         new_v, sel = lax.top_k(cand_v, k)
         new_i = jnp.take_along_axis(cand_i, sel, axis=-1)
-        db_c = lax.ppermute(db_c, axis_name, perm)
-        return new_v, new_i, db_c
+        return new_v, new_i
 
-    init = (
-        jnp.full((b, nq, k), -jnp.inf, query.dtype),
+    def body(i, state):
+        best_v, best_i, db_c = state
+        best_v, best_i = fold(i, best_v, best_i, db_c)
+        db_c = lax.ppermute(db_c, axis_name, perm)
+        return best_v, best_i, db_c
+
+    state = (
+        # f32 like the fold output (pinned accumulation), matching
+        # ring_corr_init's init_v — a bf16 query must not give the loop
+        # a carry-dtype mismatch.
+        jnp.full((b, nq, k), -jnp.inf, jnp.float32),
         jnp.zeros((b, nq, k), jnp.int32),
         db,
     )
-    _, best_i, _ = lax.fori_loop(0, p, body, init)
+    # p-1 fold+forward iterations, then the final fold OUTSIDE the loop:
+    # the last chunk needs no onward send, so the ring issues p-1 hops,
+    # not p (the p-th permute's result was dead — deepcheck GJ002).
+    if p > 1:
+        state = lax.fori_loop(0, p - 1, body, state)
+    _, best_i = fold(p - 1, *state)
     return best_i
 
 
@@ -133,15 +152,18 @@ def ring_corr_init(
     def body(i, state):
         best_v, best_x, f2, x2 = state
         best_v, best_x = fold((best_v, best_x), f2, x2)
-        # Forward the chunk to the next ring neighbor over ICI; the last
-        # fold needs no send, but a uniform loop keeps the schedule static.
+        # Forward the chunk to the next ring neighbor over ICI for the
+        # NEXT fold; the final fold runs outside the loop so the last
+        # chunk is never sent onward (deepcheck GJ002: that permute's
+        # result was dead — one full hop of wasted ring traffic).
         f2 = lax.ppermute(f2, axis_name, perm)
         x2 = lax.ppermute(x2, axis_name, perm)
         return best_v, best_x, f2, x2
 
     init_v = jnp.full((b, n1, truncate_k), -jnp.inf, jnp.float32)
     init_x = jnp.zeros((b, n1, truncate_k, 3), xyz2.dtype)
-    best_v, best_x, _, _ = lax.fori_loop(
-        0, p, body, (init_v, init_x, fmap2, xyz2)
-    )
+    state = (init_v, init_x, fmap2, xyz2)
+    if p > 1:
+        state = lax.fori_loop(0, p - 1, body, state)
+    best_v, best_x = fold((state[0], state[1]), state[2], state[3])
     return CorrState(corr=best_v, xyz=best_x)

@@ -5,12 +5,34 @@
 set -e
 cd "$(dirname "$0")/.."
 
-echo "== graftlint: AST rules over pvraft_tpu/ + tests/"
-python -m pvraft_tpu.analysis lint pvraft_tpu/ tests/
+echo "== graftlint: AST rules over pvraft_tpu/ + tests/ + scripts/"
+# Same scope as the --stats pass below: what the debt report counts as a
+# blind spot must be a file the rules actually run on.
+python -m pvraft_tpu.analysis lint pvraft_tpu/ tests/ scripts/
+
+echo "== graftlint: suppression-debt report (reason-less pragmas fail)"
+# The gate's blind spots, enumerated: per-rule counts of active
+# `graftlint: disable` pragmas; any suppression without a `-- reason`
+# exits non-zero.
+python -m pvraft_tpu.analysis lint --stats pvraft_tpu/ tests/ scripts/
+
+# 8 virtual CPU devices (appended to any caller-set XLA_FLAGS) so the
+# ring audit entries trace with a REAL 2-shard seq axis — the programs
+# deepcheck walks then contain the ring ppermutes, not a degenerate p=1
+# loop with no collectives at all.
+_audit_flags="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
 echo "== graftlint: eval_shape trace-compat audit (zero-FLOP abstract traces)"
 # CPU pin: shape propagation needs no accelerator and must not grab one.
-JAX_PLATFORMS=cpu python -m pvraft_tpu.analysis trace
+JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
+  python -m pvraft_tpu.analysis trace
+
+echo "== deepcheck: jaxpr-level semantic analysis (GJ rules) over the audit corpus"
+# Traces every registered audit entry to a ClosedJaxpr and checks
+# collective consistency, donation efficacy, precision flow and retrace
+# hazards. Tracing only — zero FLOPs, CPU-safe.
+JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
+  python -m pvraft_tpu.analysis deepcheck
 
 echo "== pvraft_events/v1: committed event logs validate"
 # Any event log shipped as evidence (artifacts/) plus the golden test

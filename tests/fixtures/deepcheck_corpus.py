@@ -1,0 +1,152 @@
+"""Deliberately-broken traced programs: deepcheck's red-test corpus.
+
+Each thunk returns ``(fn, args)`` exactly like an audit-registry entry;
+``tests/test_deepcheck.py`` wraps them in ``AuditEntry`` records and
+runs ``run_deepcheck`` over them. The golden report fixture
+(``deepcheck_report.golden``) pins the exact findings, so KEEP LINE
+NUMBERS STABLE: append new cases at the end, never insert in the
+middle.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pvraft_tpu.compat import shard_map
+from pvraft_tpu.parallel.mesh import make_mesh
+
+SDS = jax.ShapeDtypeStruct
+
+
+def dead_psum():
+    """GJ002(a): collective whose result nothing consumes."""
+    mesh = make_mesh(n_data=1, n_seq=1)
+
+    def inner(x):
+        wasted = lax.psum(x, "seq")  # GOLDEN ANCHOR: corpus line 31
+        _ = wasted + 1.0
+        return x * 2.0
+
+    def fn(x):
+        return shard_map(inner, mesh=mesh, in_specs=P(None, "seq"),
+                         out_specs=P(None, "seq"), check_vma=False)(x)
+
+    return fn, (SDS((2, 4), "float32"),)
+
+
+def last_hop_ring():
+    """GJ002(b): ppermute feeds a carry whose final value is dropped —
+    the pre-fix ring pattern, miniaturized."""
+    mesh = make_mesh(n_data=1, n_seq=1)
+
+    def inner(x):
+        def body(i, st):
+            acc, c = st
+            acc = acc + c
+            c = lax.ppermute(c, "seq", [(0, 0)])  # GOLDEN ANCHOR: line 51
+            return acc, c
+
+        acc, _ = lax.fori_loop(0, 2, body, (jnp.zeros_like(x), x))
+        return acc
+
+    def fn(x):
+        return shard_map(inner, mesh=mesh, in_specs=P(None, "seq"),
+                         out_specs=P(None, "seq"), check_vma=False)(x)
+
+    return fn, (SDS((2, 4), "float32"),)
+
+
+def unaliasable_donation():
+    """GJ004: donated buffer with no same-aval output to alias."""
+    g = jax.jit(lambda x: (x * 2.0).sum(), donate_argnums=(0,))
+
+    def fn(x):
+        return g(x)  # GOLDEN ANCHOR: line 69
+
+    return fn, (SDS((8,), "float32"),)
+
+
+def undonated_state():
+    """GJ005: donation-opted-in program leaves a donatable input out."""
+    g = jax.jit(lambda x, y: (x + 1.0, y * 2.0), donate_argnums=(0,))
+
+    def fn(x, y):
+        return g(x, y)  # GOLDEN ANCHOR: line 79
+
+    return fn, (SDS((8,), "float32"), SDS((8,), "float32"))
+
+
+def stray_bf16():
+    """GJ006 (f32 intent): a 16-bit cast hiding in an f32 program."""
+
+    def fn(x):
+        return (x.astype(jnp.bfloat16) * 2).astype(jnp.float32)
+
+    return fn, (SDS((4,), "float32"),)
+
+
+def inert_bf16_lever():
+    """GJ006 (bf16_grads intent): no truncation anywhere — the declared
+    lever does nothing."""
+
+    def fn(x):
+        return x * 2.0
+
+    return fn, (SDS((4,), "float32"),)
+
+
+_counter = itertools.count()
+
+
+def nondeterministic_trace():
+    """GJ007(a): every rebuild embeds a fresh constant."""
+    c = float(next(_counter))
+
+    def fn(x):
+        return x + c
+
+    return fn, (SDS((4,), "float32"),)
+
+
+def weak_type_sensitive():
+    """GJ007(b): Python-scalar callers get different output dtypes."""
+
+    def fn(s):
+        return s * jnp.float16(1.0)
+
+    return fn, (SDS((), "float32"),)
+
+
+def fp_with_psum():
+    """GJ003 pair, member A: one psum."""
+    mesh = make_mesh(n_data=1, n_seq=1)
+
+    def fn(x):
+        return shard_map(lambda v: lax.psum(v, "seq"), mesh=mesh,
+                         in_specs=P(None, "seq"), out_specs=P(None, None),
+                         check_vma=False)(x)
+
+    return fn, (SDS((2, 4), "float32"),)
+
+
+def fp_without_collective():
+    """GJ003 pair, member B: no collective — fingerprint drifts from A."""
+
+    def fn(x):
+        return x * 2.0
+
+    return fn, (SDS((2, 4), "float32"),)
+
+
+def clean():
+    """Green control: no finding from any rule."""
+
+    def fn(x):
+        return (x * 2.0).sum()
+
+    return fn, (SDS((8,), "float32"),)

@@ -1,0 +1,402 @@
+"""deepcheck (GJ rules): red/green per rule, golden report, clean pass
+over the real audit corpus, and the suppression/ring regressions."""
+
+import os
+import sys
+
+import jax
+import pytest
+
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+if FIXDIR not in sys.path:
+    sys.path.insert(0, FIXDIR)
+
+import deepcheck_corpus as corpus  # noqa: E402
+
+from pvraft_tpu.analysis.audit import AuditEntry  # noqa: E402
+from pvraft_tpu.analysis.jaxpr import (  # noqa: E402
+    all_jaxpr_rules,
+    format_report,
+    run_deepcheck,
+    walk,
+)
+from pvraft_tpu.analysis.jaxpr.rules import (  # noqa: E402
+    EntryContext,
+    UnboundCollectiveAxis,
+)
+
+SDS = jax.ShapeDtypeStruct
+
+
+def make_entry(name, thunk, **kw):
+    code = thunk.__code__
+    return AuditEntry(name=name, thunk=thunk, path=code.co_filename,
+                      line=code.co_firstlineno, **kw)
+
+
+def run(*entries):
+    return run_deepcheck(entries={e.name: e for e in entries})
+
+
+def rule_ids(report):
+    return [d.rule_id for d in report.diagnostics]
+
+
+def red_corpus_entries():
+    """The full red corpus, in the shape the golden fixture pins."""
+    return [
+        make_entry("corpus.clean", corpus.clean),
+        make_entry("corpus.dead_psum", corpus.dead_psum),
+        make_entry("corpus.fp[nocoll]", corpus.fp_without_collective,
+                   spmd_group="fp-pair"),
+        make_entry("corpus.fp[psum]", corpus.fp_with_psum,
+                   spmd_group="fp-pair"),
+        make_entry("corpus.inert_bf16_lever", corpus.inert_bf16_lever,
+                   precision="bf16_grads"),
+        make_entry("corpus.last_hop_ring", corpus.last_hop_ring),
+        make_entry("corpus.nondeterministic_trace",
+                   corpus.nondeterministic_trace),
+        make_entry("corpus.stray_bf16", corpus.stray_bf16),
+        make_entry("corpus.unaliasable_donation",
+                   corpus.unaliasable_donation),
+        make_entry("corpus.undonated_state", corpus.undonated_state),
+        make_entry("corpus.weak_type_sensitive", corpus.weak_type_sensitive,
+                   precision="any"),
+    ]
+
+
+# --- rule table -----------------------------------------------------------
+
+def test_gj_rule_table_complete():
+    rules = all_jaxpr_rules()
+    assert [r.id for r in rules] == [f"GJ00{i}" for i in range(1, 8)]
+    for r in rules:
+        assert r.title, r.id
+        assert r.__doc__ and r.__doc__.strip(), r.id
+
+
+# --- GJ001: positive detection needs an ambient axis_env (a program
+# with a truly unbound collective cannot trace at all) ---------------------
+
+def _direct_ctx(fn, in_sds, axis_env=None, precision="any"):
+    closed = jax.make_jaxpr(fn, axis_env=axis_env)(*in_sds)
+    return EntryContext(
+        name="direct", precision=precision, spmd_group=None,
+        anchor_path="<direct>", anchor_line=1, fn=fn, args=in_sds,
+        closed=closed, sites=walk(closed), thunk=None,
+    )
+
+
+def test_gj001_red_ambient_axis():
+    from jax import lax
+
+    def fn(x):
+        return lax.psum(x, "ring")
+
+    ectx = _direct_ctx(fn, (SDS((4,), "float32"),),
+                       axis_env=[("ring", 2)])
+    diags = list(UnboundCollectiveAxis().check(ectx))
+    assert [d.rule_id for d in diags] == ["GJ001"]
+    assert "'ring'" in diags[0].message
+
+
+def test_gj001_green_shard_map_bound():
+    # Bound by shard_map: the same psum must NOT fire (corpus member).
+    rep = run(make_entry("c.fp", corpus.fp_with_psum))
+    assert "GJ001" not in rule_ids(rep)
+
+
+# --- GJ002 ----------------------------------------------------------------
+
+def test_gj002_red_dead_psum():
+    rep = run(make_entry("c.dead", corpus.dead_psum))
+    assert rule_ids(rep) == ["GJ002"]
+    assert "dead `psum`" in rep.diagnostics[0].message
+
+
+def test_gj002_red_last_hop_carry():
+    rep = run(make_entry("c.ring", corpus.last_hop_ring))
+    assert rule_ids(rep) == ["GJ002"]
+    assert "final value is discarded" in rep.diagnostics[0].message
+
+
+def test_gj002_green_live_collectives():
+    rep = run(make_entry("c.fp", corpus.fp_with_psum),
+              make_entry("c.clean", corpus.clean))
+    assert rep.diagnostics == [] and not rep.failures
+
+
+def test_gj002_green_ring_paths_two_devices():
+    """The fixed ring fns at a real 2-shard seq axis: every hop's result
+    is consumed (p-1 hops + peeled final fold), so GJ002 stays quiet."""
+    from jax.sharding import PartitionSpec as P
+
+    from pvraft_tpu.compat import shard_map
+    from pvraft_tpu.ops.corr import CorrState
+    from pvraft_tpu.parallel.mesh import make_mesh
+    from pvraft_tpu.parallel.ring import ring_corr_init, ring_knn_indices
+
+    mesh = make_mesh(n_data=1, n_seq=2)
+
+    def corr_thunk():
+        def fn(f1, f2, x2):
+            return shard_map(
+                lambda a, b, c: ring_corr_init(a, b, c, 4, "seq"),
+                mesh=mesh,
+                in_specs=(P(None, "seq", None),) * 3,
+                out_specs=CorrState(corr=P(None, "seq", None),
+                                    xyz=P(None, "seq", None, None)),
+                check_vma=False,
+            )(f1, f2, x2)
+
+        return fn, (SDS((1, 8, 6), "float32"), SDS((1, 8, 6), "float32"),
+                    SDS((1, 8, 3), "float32"))
+
+    def knn_thunk():
+        def fn(q, d):
+            return shard_map(
+                lambda a, b: ring_knn_indices(a, b, 4, "seq"),
+                mesh=mesh,
+                in_specs=(P(None, "seq", None),) * 2,
+                out_specs=P(None, "seq", None),
+                check_vma=False,
+            )(q, d)
+
+        return fn, (SDS((1, 8, 3), "float32"), SDS((1, 8, 3), "float32"))
+
+    rep = run(make_entry("t.ring_corr", corr_thunk),
+              make_entry("t.ring_knn", knn_thunk))
+    assert rep.diagnostics == [] and not rep.failures
+    # Both programs DO still communicate (p-1 = 1 hop per circulating
+    # array) — quiet because the traffic is consumed, not absent.
+    stats = {e.name: e.n_collectives for e in rep.entries}
+    assert stats["t.ring_corr"] >= 2 and stats["t.ring_knn"] >= 1
+
+
+# --- GJ003 ----------------------------------------------------------------
+
+def test_gj003_red_fingerprint_drift():
+    rep = run(make_entry("a", corpus.fp_with_psum, spmd_group="g"),
+              make_entry("b", corpus.fp_without_collective, spmd_group="g"))
+    assert rule_ids(rep) == ["GJ003"]
+
+
+def test_gj003_green_matching_fingerprints():
+    rep = run(make_entry("a", corpus.fp_with_psum, spmd_group="g"),
+              make_entry("b", corpus.fp_with_psum, spmd_group="g"))
+    assert rep.diagnostics == []
+
+
+# --- GJ004 / GJ005 --------------------------------------------------------
+
+def test_gj004_red_unaliasable_donation():
+    rep = run(make_entry("c.don", corpus.unaliasable_donation))
+    assert rule_ids(rep) == ["GJ004"]
+
+
+def test_gj005_red_undonated_state():
+    rep = run(make_entry("c.und", corpus.undonated_state))
+    assert rule_ids(rep) == ["GJ005"]
+
+
+def test_gj004_gj005_green_full_donation():
+    def thunk():
+        g = jax.jit(lambda x, y: (x + 1.0, y * 2.0), donate_argnums=(0, 1))
+
+        def fn(x, y):
+            return g(x, y)
+
+        return fn, (SDS((8,), "float32"), SDS((8,), "float32"))
+
+    rep = run(make_entry("t.ok", thunk))
+    assert rep.diagnostics == []
+
+
+# --- GJ006 ----------------------------------------------------------------
+
+def test_gj006_red_stray_bf16():
+    rep = run(make_entry("c.bf16", corpus.stray_bf16))
+    assert rule_ids(rep) == ["GJ006"]
+
+
+def test_gj006_red_inert_lever():
+    rep = run(make_entry("c.inert", corpus.inert_bf16_lever,
+                         precision="bf16_grads"))
+    assert rule_ids(rep) == ["GJ006"]
+    assert "inert" in rep.diagnostics[0].message
+
+
+def test_gj006_green_declared_bf16_grads():
+    import jax.numpy as jnp
+
+    def thunk():
+        # The maybe_cast_grads shape: truncate then restore, f32 out.
+        def fn(g):
+            return g.astype(jnp.bfloat16).astype(jnp.float32) * 2.0
+
+        return fn, (SDS((8,), "float32"),)
+
+    rep = run(make_entry("t.lever", thunk, precision="bf16_grads"))
+    assert rep.diagnostics == []
+
+
+# --- GJ007 ----------------------------------------------------------------
+
+def test_gj007_red_nondeterministic_trace():
+    rep = run(make_entry("c.nondet", corpus.nondeterministic_trace))
+    assert rule_ids(rep) == ["GJ007"]
+
+
+def test_gj007_red_weak_type_sensitive():
+    rep = run(make_entry("c.weak", corpus.weak_type_sensitive,
+                         precision="any"))
+    assert rule_ids(rep) == ["GJ007"]
+    assert "Python scalars" in rep.diagnostics[0].message
+
+
+def test_gj007_green_deterministic():
+    rep = run(make_entry("c.clean", corpus.clean))
+    assert rep.diagnostics == []
+
+
+# --- suppressions ---------------------------------------------------------
+
+def test_gj_suppression_at_issuing_line(tmp_path):
+    """A `# graftlint: disable=GJ002 -- reason` on the line that issued
+    the primitive suppresses the jaxpr-level finding, exactly like an
+    AST finding."""
+    mod = tmp_path / "suppressed_corpus.py"
+    mod.write_text(
+        "import jax\n"
+        "from jax import lax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from pvraft_tpu.compat import shard_map\n"
+        "from pvraft_tpu.parallel.mesh import make_mesh\n"
+        "def thunk():\n"
+        "    mesh = make_mesh(n_data=1, n_seq=1)\n"
+        "    def inner(x):\n"
+        "        _ = lax.psum(x, 'seq')  "
+        "# graftlint: disable=GJ002 -- deliberate, exercise comm path\n"
+        "        return x * 2.0\n"
+        "    def fn(x):\n"
+        "        return shard_map(inner, mesh=mesh, in_specs=P(None, 'seq'),"
+        " out_specs=P(None, 'seq'), check_vma=False)(x)\n"
+        "    return fn, (jax.ShapeDtypeStruct((2, 4), 'float32'),)\n"
+    )
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("suppressed_corpus", mod)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    rep = run(make_entry("t.supp", m.thunk))
+    assert rep.diagnostics == []
+    assert rep.suppressed == 1
+
+
+# --- golden report --------------------------------------------------------
+
+def test_golden_report_fixture():
+    rep = run_deepcheck(
+        entries={e.name: e for e in red_corpus_entries()})
+    got = format_report(rep) + "\n"
+    with open(os.path.join(FIXDIR, "deepcheck_report.golden")) as fh:
+        want = fh.read()
+    assert got == want
+    assert not rep.failures
+
+
+# --- the real corpus ------------------------------------------------------
+
+def test_real_ops_entries_clean():
+    """Cheap real entries (ops + ring + scatter_free) deepcheck clean."""
+    rep = run_deepcheck(entry_filter=(
+        "ring.", "corr.", "geometry.", "scatter_free.", "voxel."))
+    assert rep.diagnostics == []
+    assert not rep.failures
+    assert len(rep.entries) >= 12
+    # With the test harness's 8 virtual devices the ring entries shard
+    # seq over 2 devices, so the CORPUS programs really contain the ring
+    # ppermutes — the collective rules must not be vacuously green over
+    # the exact code they exist to guard (and lint.sh forces the same
+    # device count for the gate).
+    ring_coll = {e.name: e.n_collectives for e in rep.entries
+                 if e.name.startswith("ring.")}
+    assert jax.device_count() >= 2, "conftest must force 8 CPU devices"
+    assert all(n >= 1 for n in ring_coll.values()), ring_coll
+
+
+def test_real_optimized_train_step_clean():
+    """The full optimized train step (scatter-free VJPs + dots remat +
+    bf16 grads) traces clean: donation fully aliasable, the declared
+    bf16_grads truncation present and restored, no retrace hazard."""
+    rep = run_deepcheck(
+        entry_filter=("engine.train_step[optimized_backward]",))
+    assert rep.diagnostics == []
+    assert not rep.failures
+    [entry] = rep.entries
+    assert entry.conversions.get(("float32", "bfloat16"), 0) > 0
+
+
+@pytest.mark.slow
+def test_full_audit_corpus_clean():
+    """Every registered audit entry deepchecks clean — the lint.sh gate,
+    as a test."""
+    rep = run_deepcheck()
+    assert rep.diagnostics == []
+    assert not rep.failures
+
+
+def test_gj002_red_dead_collective_behind_call_boundary():
+    """A collective returned through a jit call but discarded by the
+    caller is still dead — per-output liveness must see through the
+    pjit boundary (a live sibling output must not shield it)."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from pvraft_tpu.compat import shard_map
+    from pvraft_tpu.parallel.mesh import make_mesh
+
+    def thunk():
+        mesh = make_mesh(n_data=1, n_seq=1)
+        pair = jax.jit(lambda x: (x * 2.0, lax.psum(x, "seq")))
+
+        def inner(x):
+            useful, _unused = pair(x)
+            return useful
+
+        def fn(x):
+            return shard_map(inner, mesh=mesh, in_specs=P(None, "seq"),
+                             out_specs=P(None, "seq"), check_vma=False)(x)
+
+        return fn, (SDS((2, 4), "float32"),)
+
+    rep = run(make_entry("t.boundary", thunk))
+    assert rule_ids(rep) == ["GJ002"]
+    assert "dead `psum`" in rep.diagnostics[0].message
+
+
+def test_gj_suppression_covers_decorated_anchor(tmp_path):
+    """Entry-level GJ findings anchor at the thunk's first decorator
+    line; a pragma anywhere in the decorated header (e.g. on the `def`
+    line) must cover it — same header-region semantics as AST findings."""
+    mod = tmp_path / "deco_corpus.py"
+    mod.write_text(
+        "import jax\n"
+        "def deco(f):\n"
+        "    return f\n"
+        "@deco\n"
+        "def thunk():  "
+        "# graftlint: disable=GJ006 -- lever exercised in the slow tier\n"
+        "    def fn(x):\n"
+        "        return x * 2.0\n"
+        "    return fn, (jax.ShapeDtypeStruct((4,), 'float32'),)\n"
+    )
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("deco_corpus", mod)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    # bf16_grads intent with no cast -> GJ006 anchored at the @deco line.
+    rep = run(make_entry("t.deco", m.thunk, precision="bf16_grads"))
+    assert rep.diagnostics == []
+    assert rep.suppressed == 1
