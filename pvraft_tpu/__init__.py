@@ -21,3 +21,13 @@ Design stance (TPU-first, not a port):
 """
 
 __version__ = "0.1.0"
+
+
+def parse_int_list(text: str):
+    """``"2048,4096,8192"`` -> ``(2048, 4096, 8192)``.
+
+    Lives at the package root (which imports nothing) so CLIs can parse
+    bucket/batch-size flags BEFORE importing anything jax-heavy — both
+    serve entry points must pin the platform before jax commits to a
+    backend."""
+    return tuple(int(tok) for tok in text.split(",") if tok)

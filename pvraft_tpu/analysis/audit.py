@@ -93,6 +93,12 @@ def _i32(*shape):
     return jax.ShapeDtypeStruct(shape, "int32")
 
 
+def _bool(*shape):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, "bool")
+
+
 # --- ops/geometry ---------------------------------------------------------
 
 @audit_entry("geometry.pairwise_sqdist")
@@ -488,6 +494,46 @@ def _e_eval_step_refine():
         return step(params, batch)
 
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
+
+
+# --- serve (the AOT-bucketed predict programs) -----------------------------
+
+def _serve_predict_entry(**model_kwargs):
+    """The serve program exactly as the engine compiles it: masked
+    forward (padding excluded from GroupNorm stats and the correlation
+    truncation), pc1 donated — the one input aliasing the flow output,
+    which GJ004/GJ005 verify is a real and sufficient donation."""
+    import jax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models.raft import PVRaft
+    from pvraft_tpu.serve.engine import build_predict_fn
+
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2,
+                      **model_kwargs)
+    model = PVRaft(cfg)
+    predict = jax.jit(build_predict_fn(model, 3), donate_argnums=(1,))
+
+    def fn(pc1, pc2, v1, v2):
+        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        return predict(params, pc1, pc2, v1, v2)
+
+    # pc1 and pc2 share one bucket (the serve layout), so both are
+    # (B, N, 3) here — unlike the training entries' distinct N/M.
+    return fn, (_f32(B, N, 3), _f32(B, N, 3), _bool(B, N), _bool(B, N))
+
+
+@audit_entry("serve.predict")
+def _e_serve_predict():
+    return _serve_predict_entry()
+
+
+@audit_entry("serve.predict[bf16]", precision="any")
+def _e_serve_predict_bf16():
+    # bf16 matmul compute is the serve fast path's POINT, not drift, and
+    # there is no gradient cast to declare (inference-only program) —
+    # "any" is the honest GJ006 intent.
+    return _serve_predict_entry(compute_dtype="bfloat16")
 
 
 @audit_entry("engine.train_step[telemetry_off_jaxpr]")

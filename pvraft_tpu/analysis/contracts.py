@@ -24,6 +24,8 @@ Spec grammar (one space-separated token per axis)::
                  in the same call (inputs AND outputs);
   * ``_``      — wildcard: any size;
   * spec ``None`` — skip that argument (non-array / unconstrained);
+  * an argument whose parameter defaults to ``None`` is only checked
+    when a non-None value arrives (optional array args, e.g. masks);
   * ``out=``   — a spec for the return value, or a tuple of specs zipped
                  against a tuple return (``None`` entries skipped).
 
@@ -200,10 +202,20 @@ def wrap_with_spec(fn, spec: ContractSpec):
             except TypeError:
                 bound = None  # fn will raise its own, better error
             if bound is not None:
-                return [
-                    (name in bound.arguments, bound.arguments.get(name))
-                    for name in param_names[: len(spec.arg_specs)]
-                ]
+                values = []
+                for name in param_names[: len(spec.arg_specs)]:
+                    present = name in bound.arguments
+                    value = bound.arguments.get(name)
+                    # An optional-None parameter (default None) passed an
+                    # explicit None is ABSENT, not a violated contract —
+                    # optional mask args (e.g. corr_init's valid2) forward
+                    # None through call chains. Required params passing
+                    # None still fail: their default is not None.
+                    if (present and value is None
+                            and sig.parameters[name].default is None):
+                        present = False
+                    values.append((present, value))
+                return values
         # No usable signature: positional-only fallback.
         return [
             (i < len(args), args[i] if i < len(args) else None)

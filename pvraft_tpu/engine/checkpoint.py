@@ -472,6 +472,23 @@ def load_payload(path: str) -> Dict[str, Any]:
         return serialization.msgpack_restore(f.read())
 
 
+def load_params(path: str) -> Tuple[Dict[str, Any], int]:
+    """Parameters-only load for tooling that holds no optimizer: returns
+    ``(variables, epoch)`` where ``variables`` is the flax variables dict
+    (``{"params": tree}``) ready for ``model.apply``, whichever backend
+    wrote the checkpoint. Accepts both payload shapes in the wild: the
+    trainer saves the full variables dict; converters may hold the bare
+    inner tree. A payload without an ``epoch`` key yields ``-1`` — an
+    explicit "unknown" sentinel, deliberately NOT the pre-refactor fake
+    epoch ``0`` (indistinguishable from a real first epoch). Used by the
+    serve engine and ``scripts/export_checkpoint.py``."""
+    payload = load_payload(path)
+    tree = payload["params"]
+    if set(tree.keys()) != {"params"}:
+        tree = {"params": tree}
+    return tree, int(payload.get("epoch", -1))
+
+
 def find_checkpoint(ckpt_dir: str, name: str) -> Optional[str]:
     """Path of checkpoint ``name`` (e.g. ``best_checkpoint``) under either
     backend's naming, newest first if both exist. Settles pending async

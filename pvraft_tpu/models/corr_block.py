@@ -9,6 +9,8 @@ point branch — then projected to 64 channels and summed
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -19,12 +21,23 @@ from pvraft_tpu.ops.voxel import voxel_bin_means
 
 
 class CorrLookup(nn.Module):
+    """``mask`` (B, N) excludes padding pc1 rows from the head GroupNorm
+    statistics (serve padded buckets). The lookup itself needs no mask:
+    with a masked ``corr_init`` every truncated candidate of a real point
+    is a real pc2 point, and both branches reduce only over the candidate
+    axis — per-point, padding-invariant."""
+
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, state: CorrState, coords: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, state: CorrState, coords: jnp.ndarray,
+                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
         dtype = compute_dtype(cfg)
+        m3 = m4 = None
+        if mask is not None:
+            m3 = mask[:, :, None]
+            m4 = mask[:, :, None, None]
 
         if resolve_use_pallas(cfg):
             # Fused kernel: one VMEM pass produces both branches; the
@@ -46,14 +59,14 @@ class CorrLookup(nn.Module):
 
         # Voxel head (corr.py:15-20).
         v = nn.Dense(128, dtype=dtype, name="out_conv1")(vox)
-        v = group_norm(v, "out_gn")
+        v = group_norm(v, "out_gn", mask=m3)
         v = PReLU(name="out_prelu")(v)
         v = nn.Dense(64, dtype=dtype, name="out_conv2")(v)
 
         # kNN head (corr.py:23-29).
         kf = jnp.concatenate([knn_corr[..., None], rel_xyz], axis=-1)
         kf = nn.Dense(64, dtype=dtype, name="knn_conv")(kf)   # (B, N, k, 64)
-        kf = group_norm(kf, "knn_gn")
+        kf = group_norm(kf, "knn_gn", mask=m4)
         kf = PReLU(name="knn_prelu")(kf)
         kf = jnp.max(kf, axis=2)
         kf = nn.Dense(64, dtype=dtype, name="knn_out")(kf)

@@ -32,12 +32,18 @@ class PointEncoder(nn.Module):
 
     @nn.compact
     def __call__(
-        self, pc: jnp.ndarray, graph: Optional[Graph] = None
+        self, pc: jnp.ndarray, graph: Optional[Graph] = None,
+        mask: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, Graph]:
         """``graph`` short-circuits the kNN build — callers encoding the
         same cloud twice (feature + context extractors on pc1,
         ``RAFTSceneFlow.py:25,31``) share one graph instead of relying on
-        XLA CSE to deduplicate the two identical builds."""
+        XLA CSE to deduplicate the two identical builds.
+
+        ``mask`` (B, N) excludes padding rows from the SetConv GroupNorm
+        statistics (serve padded buckets); the kNN build itself is left
+        unmasked — the serve engine places padding geometrically far so
+        real points' neighbor sets are exactly the unpadded ones."""
         if graph is None:
             if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
                 from pvraft_tpu.parallel.ring import seq_sharded_graph
@@ -48,9 +54,9 @@ class PointEncoder(nn.Module):
                                     approx=self.graph_approx,
                                     dense_vjp=self.dense_vjp)
         x = SetConv(self.width, dtype=self.dtype,
-                    dense_vjp=self.dense_vjp, name="conv1")(pc, graph)
+                    dense_vjp=self.dense_vjp, name="conv1")(pc, graph, mask)
         x = SetConv(2 * self.width, dtype=self.dtype,
-                    dense_vjp=self.dense_vjp, name="conv2")(x, graph)
+                    dense_vjp=self.dense_vjp, name="conv2")(x, graph, mask)
         x = SetConv(4 * self.width, dtype=self.dtype,
-                    dense_vjp=self.dense_vjp, name="conv3")(x, graph)
+                    dense_vjp=self.dense_vjp, name="conv3")(x, graph, mask)
         return x, graph

@@ -31,9 +31,22 @@ class PReLU(nn.Module):
         return jnp.where(x >= 0, x, alpha * x)
 
 
-def group_norm(x: jnp.ndarray, name: str) -> jnp.ndarray:
-    """GroupNorm(8) matching torch defaults (eps 1e-5, affine)."""
-    return nn.GroupNorm(num_groups=8, epsilon=1e-5, name=name)(x)
+def group_norm(
+    x: jnp.ndarray, name: str, mask: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """GroupNorm(8) matching torch defaults (eps 1e-5, affine).
+
+    ``mask`` (broadcastable to ``x``, True = valid) excludes padding
+    positions from the mean/variance — the serve path's padded buckets
+    must not shift real points' statistics (GroupNorm reduces over the
+    point axis, so unmasked padding would). ``mask=None`` calls the
+    module exactly as before: the default jaxpr is untouched."""
+    gn = nn.GroupNorm(num_groups=8, epsilon=1e-5, name=name)
+    if mask is None:
+        return gn(x)
+    # flax reshapes the mask's channel axis into (groups, C/g): it must
+    # arrive at full rank/width, so broadcast the (B, N, 1...) mask up.
+    return gn(x, mask=jnp.broadcast_to(mask, x.shape))
 
 
 class SetConv(nn.Module):
@@ -52,6 +65,12 @@ class SetConv(nn.Module):
     neighbor gather's scatter-add backward and the k-pool max backward for
     the scatter-free formulations in ``ops/scatter_free.py``; the forward
     values and the default-path jaxpr are unchanged.
+
+    ``mask`` (B, N), True = valid point: excludes padding rows from the
+    GroupNorm statistics (serve bucket padding). Real points' values are
+    otherwise untouched — their neighbor gathers only ever reach real
+    points when the caller pads geometrically far away. ``mask=None``
+    (default) leaves the jaxpr byte-identical to the unmasked layer.
     """
 
     out_ch: int
@@ -59,8 +78,15 @@ class SetConv(nn.Module):
     dense_vjp: bool = False
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, graph: Graph) -> jnp.ndarray:
+    def __call__(
+        self, x: jnp.ndarray, graph: Graph,
+        mask: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
         b, n, c = x.shape
+        m3 = m4 = None
+        if mask is not None:
+            m4 = mask[:, :, None, None]                  # over (B, N, k, C)
+            m3 = mask[:, :, None]                        # over (B, N, C)
         # Width rule of gconv.py:21-24.
         mid = (self.out_ch + c) // 2 if c % 2 == 0 else self.out_ch // 2
 
@@ -70,7 +96,7 @@ class SetConv(nn.Module):
         h = jnp.concatenate([edge, graph.rel_pos.astype(x.dtype)], axis=-1)
 
         h = nn.Dense(mid, use_bias=False, dtype=self.dtype, name="fc1")(h)
-        h = group_norm(h, "gn1")
+        h = group_norm(h, "gn1", mask=m4)
         h = jax.nn.leaky_relu(h, 0.1)
         if self.dense_vjp:
             from pvraft_tpu.ops.scatter_free import max_pool_argmax
@@ -80,10 +106,10 @@ class SetConv(nn.Module):
             h = jnp.max(h, axis=2)                           # pool over k
 
         h = nn.Dense(self.out_ch, use_bias=False, dtype=self.dtype, name="fc2")(h)
-        h = group_norm(h, "gn2")
+        h = group_norm(h, "gn2", mask=m3)
         h = jax.nn.leaky_relu(h, 0.1)
 
         h = nn.Dense(self.out_ch, use_bias=False, dtype=self.dtype, name="fc3")(h)
-        h = group_norm(h, "gn3")
+        h = group_norm(h, "gn3", mask=m3)
         h = jax.nn.leaky_relu(h, 0.1)
         return h

@@ -60,10 +60,11 @@ class UpdateIter(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, carry, state: CorrState, inp, graph: Graph):
+    def __call__(self, carry, state: CorrState, inp, graph: Graph,
+                 mask: Optional[jnp.ndarray] = None):
         net, coords2, coords1 = carry
         coords2 = lax.stop_gradient(coords2)
-        corr = CorrLookup(self.cfg, name="corr_lookup")(state, coords2)
+        corr = CorrLookup(self.cfg, name="corr_lookup")(state, coords2, mask)
         if self.cfg.remat_policy == "save_corr":
             # Tagged only when the policy consumes the tag, so the default
             # jaxpr stays byte-identical with the flag off.
@@ -74,7 +75,7 @@ class UpdateIter(nn.Module):
         net, delta = UpdateBlock(
             self.cfg.hidden_dim, dtype=compute_dtype(self.cfg),
             dense_vjp=self.cfg.scatter_free_vjp, name="update_block"
-        )(net, inp, corr, flow, graph)
+        )(net, inp, corr, flow, graph, mask)
         coords2 = coords2 + delta
         return (net, coords2, coords1), coords2 - coords1
 
@@ -97,14 +98,20 @@ class PVRaft(nn.Module):
     cfg: ModelConfig
     mesh: Optional[jax.sharding.Mesh] = None
 
-    def _corr_init(self, fmap1, fmap2, xyz2):
+    def _corr_init(self, fmap1, fmap2, xyz2, valid2=None):
         cfg = self.cfg
         mesh = self.mesh
         seq = mesh.shape.get("seq", 1) if mesh is not None else 1
         if not (cfg.seq_shard and seq > 1):
             return corr_init(
                 fmap1, fmap2, xyz2, cfg.truncate_k, cfg.corr_chunk,
-                approx=cfg.approx_topk,
+                approx=cfg.approx_topk, valid2=valid2,
+            )
+        if valid2 is not None:
+            raise ValueError(
+                "valid2 masking is not supported with seq_shard: the ring "
+                "correlation assembles exact top-k across shards without a "
+                "padding mask; serve on the unsharded correlation path"
             )
         from jax.sharding import PartitionSpec as P
 
@@ -133,11 +140,20 @@ class PVRaft(nn.Module):
         )
         return ring(fmap1, fmap2, xyz2)
 
-    @shapecheck("B N 3", "B M 3", out=("T B N 3", None))
+    @shapecheck("B N 3", "B M 3", None, "B N", "B M", out=("T B N 3", None))
     @nn.compact
     def __call__(
-        self, xyz1: jnp.ndarray, xyz2: jnp.ndarray, num_iters: int = 8
+        self, xyz1: jnp.ndarray, xyz2: jnp.ndarray, num_iters: int = 8,
+        valid1: Optional[jnp.ndarray] = None,
+        valid2: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, Graph]:
+        """``valid1``/``valid2`` (B, N) / (B, M) bool masks, True = real
+        point — the serve path's padded-bucket inference. They exclude
+        padding from every GroupNorm statistic and from the correlation
+        truncation; combined with geometrically-far padding (the serve
+        engine's job: padding must never enter a real point's kNN set)
+        real points' flows match unpadded inference to float-reassociation
+        precision. ``None`` (default) leaves the jaxpr byte-identical."""
         cfg = self.cfg
         dtype = compute_dtype(cfg)
         enc_mesh = self.mesh if cfg.seq_shard else None
@@ -147,10 +163,10 @@ class PVRaft(nn.Module):
             dense_vjp=cfg.scatter_free_vjp,
             mesh=enc_mesh, name="feature_extractor"
         )
-        fmap1, graph1 = feat(xyz1)
-        fmap2, _ = feat(xyz2)
+        fmap1, graph1 = feat(xyz1, mask=valid1)
+        fmap2, _ = feat(xyz2, mask=valid2)
 
-        state = self._corr_init(fmap1, fmap2, xyz2)
+        state = self._corr_init(fmap1, fmap2, xyz2, valid2)
 
         # The reference context encoder rebuilds pc1's 32-NN graph
         # (extractor.py:18 via RAFTSceneFlow.py:31); the graph is a pure
@@ -160,7 +176,7 @@ class PVRaft(nn.Module):
             graph_chunk=cfg.graph_chunk, graph_approx=cfg.approx_knn,
             dense_vjp=cfg.scatter_free_vjp,
             mesh=enc_mesh, name="context_extractor"
-        )(xyz1, graph=graph1)
+        )(xyz1, graph=graph1, mask=valid1)
         net, inp = jnp.split(fct, [cfg.hidden_dim], axis=-1)
         net = jnp.tanh(net)
         inp = jax.nn.relu(inp)
@@ -173,17 +189,24 @@ class PVRaft(nn.Module):
             # remat=True jaxpr is untouched.
             remat_kwargs = {} if policy is None else {"policy": policy}
             step_cls = nn.remat(UpdateIter, prevent_cse=False, **remat_kwargs)
+        # The mask joins the scan as one more broadcast input only when
+        # present, so the default scan signature (and jaxpr) is untouched.
+        scan_in = (nn.broadcast, nn.broadcast, nn.broadcast)
+        scan_args = (state, inp, graph_ctx)
+        if valid1 is not None:
+            scan_in += (nn.broadcast,)
+            scan_args += (valid1,)
         scan = nn.scan(
             step_cls,
             variable_broadcast="params",
             split_rngs={"params": False},
-            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            in_axes=scan_in,
             out_axes=0,
             length=num_iters,
             unroll=min(cfg.scan_unroll, num_iters),
         )
         carry = (net, xyz1, xyz1)
-        _, flows = scan(cfg, name="update_iter")(carry, state, inp, graph_ctx)
+        _, flows = scan(cfg, name="update_iter")(carry, *scan_args)
         return flows, graph1
 
 
@@ -196,13 +219,15 @@ class PVRaftRefine(nn.Module):
     cfg: ModelConfig
     mesh: Optional[jax.sharding.Mesh] = None
 
-    @shapecheck("B N 3", "B M 3", out="B N 3")
+    @shapecheck("B N 3", "B M 3", None, "B N", "B M", out="B N 3")
     @nn.compact
     def __call__(
-        self, xyz1: jnp.ndarray, xyz2: jnp.ndarray, num_iters: int = 32
+        self, xyz1: jnp.ndarray, xyz2: jnp.ndarray, num_iters: int = 32,
+        valid1: Optional[jnp.ndarray] = None,
+        valid2: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
         flows, graph1 = PVRaft(self.cfg, mesh=self.mesh, name="backbone")(
-            xyz1, xyz2, num_iters
+            xyz1, xyz2, num_iters, valid1, valid2
         )
         flow = lax.stop_gradient(flows[-1])
         graph1 = Graph(graph1.neighbors, lax.stop_gradient(graph1.rel_pos))
@@ -211,10 +236,10 @@ class PVRaftRefine(nn.Module):
         dtype = compute_dtype(self.cfg)
         dense = self.cfg.scatter_free_vjp
         x = SetConv(n, dtype=dtype, dense_vjp=dense,
-                    name="ref_conv1")(flow, graph1)
+                    name="ref_conv1")(flow, graph1, valid1)
         x = SetConv(2 * n, dtype=dtype, dense_vjp=dense,
-                    name="ref_conv2")(x, graph1)
+                    name="ref_conv2")(x, graph1, valid1)
         x = SetConv(4 * n, dtype=dtype, dense_vjp=dense,
-                    name="ref_conv3")(x, graph1)
+                    name="ref_conv3")(x, graph1, valid1)
         delta = nn.Dense(3, dtype=jnp.float32, name="fc")(x)
         return flow + delta

@@ -60,10 +60,11 @@ class FlowHead(nn.Module):
     dense_vjp: bool = False
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, graph: Graph) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, graph: Graph,
+                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         out = nn.Dense(64, dtype=self.dtype, name="conv1")(x)
         out_set = SetConv(64, dtype=self.dtype, dense_vjp=self.dense_vjp,
-                          name="setconv")(x, graph)
+                          name="setconv")(x, graph, mask)
         h = jnp.concatenate([out_set.astype(out.dtype), out], axis=-1)
         h = jax.nn.relu(nn.Dense(64, dtype=self.dtype, name="out_conv1")(h))
         return nn.Dense(3, dtype=jnp.float32, name="out_conv2")(h)
@@ -84,10 +85,11 @@ class UpdateBlock(nn.Module):
         corr: jnp.ndarray,
         flow: jnp.ndarray,
         graph: Graph,
+        mask: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         motion = MotionEncoder(self.hidden, dtype=self.dtype, name="motion_encoder")(flow, corr)
         x = jnp.concatenate([inp.astype(motion.dtype), motion], axis=-1)
         net = ConvGRU(self.hidden, dtype=self.dtype, name="gru")(net, x)
         delta = FlowHead(dtype=self.dtype, dense_vjp=self.dense_vjp,
-                         name="flow_head")(net, graph)
+                         name="flow_head")(net, graph, mask)
         return net, delta

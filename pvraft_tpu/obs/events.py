@@ -26,6 +26,16 @@ Event types and their required fields:
                 [+ zscore, snapshot]
     snapshot    epoch, step, path, reason
 
+Serving events (``pvraft_tpu/serve``) share the stream — ONE validator
+covers training and serving telemetry:
+
+    serve_compile  bucket, batch, lower_s, compile_s  [+ memory]
+    serve_batch    bucket, batch, n, fill, latency_ms [+ queue_depth]
+    serve_reject   reason ("queue_full"|"too_large"|"too_small"|
+                   "bad_request"|"shutdown"|"timeout"|"internal")
+                                                      [+ bucket, queue_depth]
+    serve_shutdown served, rejected, drained
+
 Non-finite floats are encoded as the strings ``"NaN"``/``"Infinity"``/
 ``"-Infinity"`` (JSON has no spelling for them; a diverging run's whole
 point is to record them faithfully). ``validate_events`` accepts those
@@ -62,7 +72,20 @@ EVENT_TYPES: Dict[str, tuple] = {
     "divergence": (("epoch", "step", "reason", "loss"),
                    ("zscore", "snapshot")),
     "snapshot": (("epoch", "step", "path", "reason"), ()),
+    "serve_compile": (("bucket", "batch", "lower_s", "compile_s"),
+                      ("memory",)),
+    "serve_batch": (("bucket", "batch", "n", "fill", "latency_ms"),
+                    ("queue_depth",)),
+    "serve_reject": (("reason",), ("bucket", "queue_depth")),
+    "serve_shutdown": (("served", "rejected", "drained"), ()),
 }
+
+# serve_reject.reason vocabulary (validated like divergence.reason).
+# "timeout"/"internal" are accepted-then-failed outcomes (504/500): the
+# request passed submit but never produced a response.
+SERVE_REJECT_REASONS = (
+    "queue_full", "too_large", "too_small", "bad_request", "shutdown",
+    "timeout", "internal")
 
 _BASE_FIELDS = ("schema", "type", "time", "seq")
 
@@ -75,6 +98,11 @@ _NUMERIC_FIELDS = {
     "trace_window": ("epoch",),
     "divergence": ("epoch", "step", "loss"),
     "snapshot": ("epoch", "step"),
+    "serve_compile": ("bucket", "batch", "lower_s", "compile_s"),
+    "serve_batch": ("bucket", "batch", "n", "fill", "latency_ms",
+                    "queue_depth"),
+    "serve_reject": ("bucket", "queue_depth"),
+    "serve_shutdown": ("served", "rejected", "drained"),
 }
 
 _NONFINITE_STRINGS = ("NaN", "Infinity", "-Infinity")
@@ -152,6 +180,11 @@ def validate_event(record: Any, seq: Optional[int] = None) -> List[str]:
         problems.append(
             f"trace_window: action {record.get('action')!r} must be "
             "'start' or 'stop'")
+    if etype == "serve_reject" and record.get("reason") not in (
+            SERVE_REJECT_REASONS):
+        problems.append(
+            f"serve_reject: reason {record.get('reason')!r} must be one "
+            f"of {SERVE_REJECT_REASONS}")
     return problems
 
 

@@ -58,7 +58,8 @@ def merge_topk_xyz(best_v, best_x, part_v, part_x, truncate_k: int):
     return new_v, new_x
 
 
-@shapecheck("B N D", "B M D", "B M 3", out=("B N K", "B N K 3"))
+@shapecheck("B N D", "B M D", "B M 3", None, None, None, "B M",
+            out=("B N K", "B N K 3"))
 def corr_init(
     fmap1: jnp.ndarray,
     fmap2: jnp.ndarray,
@@ -66,6 +67,7 @@ def corr_init(
     truncate_k: int,
     chunk: Optional[int] = None,
     approx: bool = False,
+    valid2: Optional[jnp.ndarray] = None,
 ) -> CorrState:
     """Build the truncated correlation cache (``model/corr.py:31-42``).
 
@@ -75,11 +77,24 @@ def corr_init(
     one ``lax.top_k``. With an integer ``chunk`` the M axis is processed in
     slices under ``lax.scan`` while a running top-k of size K is maintained —
     peak memory O(N * (K + chunk)) instead of O(N * M).
+
+    ``valid2`` (B, M) bool, True = real pc2 point: padding candidates are
+    forced below every real correlation value before the truncation, so
+    the selected top-k (values AND gathered xyz) is exactly the unpadded
+    one whenever each scene has >= ``truncate_k`` real points (the serve
+    engine enforces that). ``None`` (default) leaves the jaxpr untouched.
     """
     if truncate_k > fmap2.shape[1]:
         raise ValueError(
             f"truncate_k ({truncate_k}) must be <= the number of candidate "
             f"points N2 ({fmap2.shape[1]})"
+        )
+    if valid2 is not None and approx:
+        raise ValueError(
+            "valid2 masking is not supported with approx_topk: approx_max_k "
+            "recall is ~0.95, so finfo.min padding candidates can leak into "
+            "the selected top-k and break the padding-exactness guarantee "
+            "the serve path is built on (use the exact top_k with masks)"
         )
     if approx and chunk is not None:
         # Checked before the size-based fallback so the config error does
@@ -90,8 +105,26 @@ def corr_init(
         )
     if chunk is not None and chunk >= fmap2.shape[1]:
         chunk = None   # one chunk would cover everything: use the dense path
+    if valid2 is not None and chunk is not None:
+        # Checked AFTER the size fallback (unlike the approx+chunk config
+        # error above): a training config's corr_chunk tuned for 16k+
+        # points routinely exceeds a serve bucket, and the dense path it
+        # degenerates to is exactly the one the serve masks support — a
+        # masked predict must not fail to build over a chunk value that
+        # would have been discarded anyway.
+        raise ValueError(
+            "valid2 masking is not supported with corr_chunk: the serve "
+            "path uses the dense truncation (chunking exists for training "
+            "at 16k+ points, beyond the serve buckets)"
+        )
     if chunk is None:
         corr = corr_volume(fmap1, fmap2)
+        if valid2 is not None:
+            # finfo.min, not -inf: strictly below any real correlation (so
+            # never selected while truncate_k <= n_real) without minting
+            # non-finite values that could poison downstream arithmetic.
+            corr = jnp.where(
+                valid2[:, None, :], corr, jnp.finfo(corr.dtype).min)
         if approx:
             # TPU-native approximate top-k (recall ~0.95): substantially
             # cheaper than the sort-based exact path at N=8192, K=512.

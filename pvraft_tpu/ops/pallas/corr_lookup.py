@@ -67,7 +67,15 @@ def _fused_kernel(
 
     # --- kNN branch -------------------------------------------------------
     dist = relx * relx + rely * rely + relz * relz     # (TILE, K)
-    iota = lax.broadcasted_iota(jnp.int32, dist.shape, 1)
+    # Float-VALUED iota, generated as i32 then cast: Mosaic has no
+    # integer min-reduction lowering (the all-int variant FAILs to
+    # compile on current libtpu) and only supports 32-bit integer iota
+    # generation — and f32 represents candidate indices exactly up to
+    # 2^24 >> any K here, so the first-of-ties argmin semantics are
+    # unchanged.
+    iota = lax.broadcasted_iota(
+        jnp.int32, dist.shape, 1).astype(jnp.float32)
+    cap = jnp.asarray(float(k_cand), jnp.float32)
     big = jnp.asarray(jnp.inf, dist.dtype)
     # Collect the knn columns and store each output once, contiguously
     # (per-lane stores in the loop lower poorly on TPU).
@@ -76,7 +84,7 @@ def _fused_kernel(
         m = jnp.min(dist, axis=-1, keepdims=True)             # (TILE, 1)
         eq = dist == m
         first = iota == jnp.min(
-            jnp.where(eq, iota, k_cand), axis=-1, keepdims=True
+            jnp.where(eq, iota, cap), axis=-1, keepdims=True
         )
         sel = first.astype(corr.dtype)
         c_corr.append(jnp.sum(corr * sel, axis=-1))

@@ -1,0 +1,39 @@
+"""Low-latency scene-flow inference service.
+
+A trained checkpoint becomes an HTTP service through four layers:
+
+  * :mod:`pvraft_tpu.serve.engine` — AOT-bucketed
+    :class:`InferenceEngine`: pads variable-N requests into a fixed
+    bucket set, compiles one donated predict program per (bucket, batch
+    size) at startup, and guarantees padded predictions match unpadded
+    inference (masked GroupNorm/correlation + far padding);
+  * :mod:`pvraft_tpu.serve.batcher` — :class:`MicroBatcher`: bounded
+    per-bucket queues, straggler-bounded grouping, explicit
+    backpressure (raise, never block), graceful drain;
+  * :mod:`pvraft_tpu.serve.server` — :class:`ServeHTTPServer`: stdlib
+    JSON/msgpack HTTP API (``/predict``, ``/healthz``, ``/metrics``);
+  * :mod:`pvraft_tpu.serve.events` — :class:`ServeTelemetry`: serve
+    lifecycle on the ``pvraft_events/v1`` stream (one validator for
+    training AND serving).
+
+CLI: ``python -m pvraft_tpu.serve serve --ckpt ...`` runs the service;
+``scripts/serve_loadgen.py`` measures it.
+"""
+
+from pvraft_tpu.serve.batcher import (          # noqa: F401
+    BatcherConfig,
+    MicroBatcher,
+    QueueFullError,
+    ShutdownError,
+)
+from pvraft_tpu.serve.engine import (           # noqa: F401
+    InferenceEngine,
+    RequestError,
+    ServeConfig,
+)
+from pvraft_tpu.serve.events import ServeTelemetry          # noqa: F401
+from pvraft_tpu.serve.metrics import ServeMetrics           # noqa: F401
+from pvraft_tpu.serve.server import (                       # noqa: F401
+    ServeHTTPServer,
+    build_service,
+)

@@ -1,0 +1,135 @@
+"""CLI: run the scene-flow service / validate load artifacts.
+
+    # serve a checkpoint (msgpack file or orbax directory)
+    python -m pvraft_tpu.serve serve --ckpt experiments/exp/checkpoints/\
+best_checkpoint.msgpack --port 8000 --buckets 2048,4096,8192
+
+    # validate a pvraft_serve_load/v1 artifact (wired into scripts/lint.sh)
+    python -m pvraft_tpu.serve validate-load artifacts/serve_cpu_synthetic.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pvraft_tpu import parse_int_list as _parse_ints
+
+
+def _cmd_serve(args) -> int:
+    # Pin the platform before any jax import commits to a backend (the
+    # config API, not the env var: jax may already be imported).
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.serve import (
+        InferenceEngine,
+        ServeConfig,
+        ServeTelemetry,
+        build_service,
+    )
+
+    model = ModelConfig(
+        truncate_k=args.truncate_k,
+        corr_knn=args.corr_knn,
+        graph_k=args.graph_k,
+        compute_dtype="bfloat16" if args.bf16 else "float32",
+    )
+    cfg = ServeConfig(
+        model=model,
+        buckets=_parse_ints(args.buckets),
+        batch_sizes=_parse_ints(args.batch_sizes),
+        num_iters=args.iters,
+        refine=args.refine,
+    )
+    telemetry = (ServeTelemetry(args.events, cfg=cfg)
+                 if args.events else None)
+    print(f"[serve] compiling {len(cfg.buckets) * len(cfg.batch_sizes)} "
+          f"predict programs (buckets={cfg.buckets}, "
+          f"batch_sizes={cfg.batch_sizes})...", flush=True)
+    engine = InferenceEngine.from_checkpoint(args.ckpt, cfg,
+                                             telemetry=telemetry)
+    for rec in engine.compile_report():
+        print(f"[serve]   {rec['name']}: lower {rec['lower_s']}s "
+              f"compile {rec['compile_s']}s", flush=True)
+    server = build_service(engine, max_wait_ms=args.max_wait_ms,
+                           queue_depth=args.queue_depth, host=args.host,
+                           port=args.port, telemetry=telemetry,
+                           quiet=not args.verbose)
+    server.start()
+    print(f"[serve] listening on http://{server.host}:{server.port} "
+          f"(/predict /healthz /metrics)", flush=True)
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("[serve] draining...", flush=True)
+        server.shutdown(drain=True)
+        if telemetry is not None:
+            telemetry.close()
+    return 0
+
+
+def _cmd_validate_load(args) -> int:
+    from pvraft_tpu.serve.loadgen import validate_load_artifact_file
+
+    failed = 0
+    for path in args.paths:
+        problems = validate_load_artifact_file(path)
+        if problems:
+            failed += 1
+            for p in problems:
+                print(p, file=sys.stderr)
+        else:
+            print(f"{path}: OK")
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("python -m pvraft_tpu.serve")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    srv = sub.add_parser("serve", help="run the inference service")
+    srv.add_argument("--ckpt", required=True,
+                     help="checkpoint (.msgpack file or .orbax directory)")
+    srv.add_argument("--host", default="127.0.0.1")
+    srv.add_argument("--port", type=int, default=8000)
+    srv.add_argument("--buckets", default="2048,4096,8192",
+                     help="comma-separated point-count buckets (ascending)")
+    srv.add_argument("--batch_sizes", default="1,4",
+                     help="comma-separated compiled batch sizes (ascending)")
+    srv.add_argument("--iters", type=int, default=8,
+                     help="GRU refinement iterations per predict")
+    srv.add_argument("--truncate_k", type=int, default=512)
+    srv.add_argument("--corr_knn", type=int, default=32)
+    srv.add_argument("--graph_k", type=int, default=32)
+    srv.add_argument("--refine", action="store_true",
+                     help="serve a stage-2 (PVRaftRefine) checkpoint")
+    srv.add_argument("--bf16", action="store_true",
+                     help="bfloat16 matmul compute (params stay float32)")
+    srv.add_argument("--max_wait_ms", type=float, default=5.0)
+    srv.add_argument("--queue_depth", type=int, default=64)
+    srv.add_argument("--events", default="",
+                     help="pvraft_events/v1 JSONL path for serve telemetry")
+    srv.add_argument("--platform", default="",
+                     help="force a jax platform (e.g. cpu)")
+    srv.add_argument("--verbose", action="store_true",
+                     help="log every HTTP request")
+    srv.set_defaults(fn=_cmd_serve)
+
+    val = sub.add_parser("validate-load",
+                         help="validate pvraft_serve_load/v1 artifacts")
+    val.add_argument("paths", nargs="+")
+    val.set_defaults(fn=_cmd_validate_load)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

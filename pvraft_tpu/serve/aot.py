@@ -1,0 +1,102 @@
+"""Ahead-of-time compile + memory-analysis machinery.
+
+One `lower -> compile -> memory_analysis` path shared by the serve
+engine (which AOT-compiles every (bucket, batch) predict program at
+startup, before the first request can hit a compile stall) and
+``scripts/aot_readiness.py`` (which certifies the same programs for the
+v5e topology before a TPU claim). Keeping them on one code path means
+claim-day readiness and the live service report compile cost and HBM
+fit the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class AotProgram:
+    """One compiled program plus its startup-cost evidence."""
+
+    name: str
+    compiled: Any                      # jax.stages.Compiled
+    lower_s: float
+    compile_s: float
+    memory: Optional[Dict[str, Any]]   # memory_analysis() output
+
+    def __call__(self, *args):
+        return self.compiled(*args)
+
+    def report(self) -> Dict[str, Any]:
+        """JSON-safe record (serve_compile events, /healthz, artifacts)."""
+        return {
+            "name": self.name,
+            "lower_s": round(self.lower_s, 3),
+            "compile_s": round(self.compile_s, 3),
+            "memory": self.memory,
+        }
+
+
+def memory_analysis(compiled,
+                    hbm_limit_bytes: Optional[int] = None
+                    ) -> Optional[Dict[str, Any]]:
+    """XLA memory analysis of a compiled executable as a plain dict:
+    argument/output/temp/generated-code/alias bytes, a live-bytes
+    estimate, and (when ``hbm_limit_bytes`` is given) whether that
+    estimate fits. Returns an ``{"error": ...}`` dict on builds that
+    cannot analyze (some topology executables), never raises."""
+    try:
+        m = compiled.memory_analysis()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if m is None:
+        return None
+    out: Dict[str, Any] = {}
+    for k in ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "generated_code_size_in_bytes",
+              "alias_size_in_bytes"):
+        v = getattr(m, k, None)
+        if v is not None:
+            out[k] = int(v)
+    total = (out.get("argument_size_in_bytes", 0)
+             + out.get("output_size_in_bytes", 0)
+             + out.get("temp_size_in_bytes", 0)
+             - out.get("alias_size_in_bytes", 0))
+    out["live_bytes_estimate"] = total
+    if hbm_limit_bytes is not None:
+        out["fits_hbm"] = total < hbm_limit_bytes
+    return out
+
+
+def aot_compile(
+    name: str,
+    fn: Callable,
+    args: Tuple,
+    donate_argnums: Tuple[int, ...] = (),
+    in_shardings=None,
+    hbm_limit_bytes: Optional[int] = None,
+) -> AotProgram:
+    """``jit(fn).lower(*args).compile()`` with per-stage timing and the
+    memory analysis attached. ``args`` are ``jax.ShapeDtypeStruct``s (or
+    concrete arrays; only shapes/dtypes are read)."""
+    import jax
+
+    kwargs: Dict[str, Any] = {"donate_argnums": donate_argnums}
+    if in_shardings is not None:
+        kwargs["in_shardings"] = in_shardings
+    jitted = jax.jit(fn, **kwargs)
+    t0 = time.monotonic()
+    lowered = jitted.lower(*args)
+    lower_s = time.monotonic() - t0
+    t1 = time.monotonic()
+    compiled = lowered.compile()
+    compile_s = time.monotonic() - t1
+    return AotProgram(
+        name=name,
+        compiled=compiled,
+        lower_s=lower_s,
+        compile_s=compile_s,
+        memory=memory_analysis(compiled, hbm_limit_bytes=hbm_limit_bytes),
+    )

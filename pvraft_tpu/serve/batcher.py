@@ -1,0 +1,347 @@
+"""Bounded-queue micro-batcher with explicit backpressure.
+
+One worker thread per bucket pulls requests off that bucket's bounded
+queue, groups them up to ``max_batch`` (waiting at most ``max_wait_ms``
+for stragglers once the first request is in hand), and dispatches the
+group through the engine's AOT program for that (bucket, batch size).
+
+Backpressure is explicit, never implicit blocking: a full queue raises
+:class:`QueueFullError` at ``submit`` time (the HTTP layer maps it to
+503) instead of stalling the caller — under sustained overload the
+client sees load-shedding immediately, and queue depth (not client
+sockets) bounds the in-flight work.
+
+Shutdown drains: ``shutdown(drain=True)`` stops intake, lets every
+queued request finish, then joins the workers; ``drain=False`` fails
+queued requests with :class:`ShutdownError` instead. Both are
+test-gated under real thread concurrency (``tests/test_serve.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pvraft_tpu.serve.engine import InferenceEngine, RequestError
+
+
+class QueueFullError(RuntimeError):
+    """The bucket's queue is at capacity — shed load (HTTP 503)."""
+
+
+class ShutdownError(RuntimeError):
+    """The batcher is no longer accepting requests (HTTP 503)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherConfig:
+    max_batch: int = 4        # largest group per dispatch
+    max_wait_ms: float = 5.0  # straggler wait once a group has a member
+    queue_depth: int = 64     # per-bucket bounded queue capacity
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        if self.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+
+
+class _Request:
+    __slots__ = ("pc1", "pc2", "result", "error", "done", "t_enqueue",
+                 "abandoned")
+
+    def __init__(self, pc1: np.ndarray, pc2: np.ndarray):
+        self.pc1 = pc1
+        self.pc2 = pc2
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+        self.abandoned = False
+
+    def resolve(self, result: np.ndarray) -> None:
+        self.result = result
+        self.done.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self.done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            # The waiter is gone (HTTP 504 already sent): mark the
+            # request so a worker that later pulls it off the queue
+            # skips the dispatch instead of computing an answer nobody
+            # reads. Benign race: a concurrent resolve just wastes the
+            # one result.
+            self.abandoned = True
+            raise TimeoutError("predict did not complete in time")
+        if self.error is not None:
+            raise self.error
+        assert self.result is not None
+        return self.result
+
+
+class MicroBatcher:
+    """Per-bucket bounded queues + worker threads over an engine."""
+
+    def __init__(self, engine: InferenceEngine, cfg: BatcherConfig,
+                 telemetry=None, metrics=None):
+        largest = max(engine.cfg.batch_sizes)
+        if cfg.max_batch > largest:
+            raise ValueError(
+                f"max_batch={cfg.max_batch} exceeds the largest compiled "
+                f"batch size {largest}: the engine has no AOT program for a "
+                f"bigger group and _dispatch never splits, so every "
+                f"oversized group would fail wholesale")
+        self.engine = engine
+        self.cfg = cfg
+        self.telemetry = telemetry
+        self.metrics = metrics
+        self._queues: Dict[int, "queue.Queue[_Request]"] = {
+            b: queue.Queue(maxsize=cfg.queue_depth)
+            for b in engine.cfg.buckets}
+        self._stopping = threading.Event()
+        # Serializes the submit-side {stopping check -> enqueue} against
+        # shutdown setting the flag: without it a submit could pass the
+        # check, lose the CPU while shutdown joins the workers AND runs
+        # its sweep, then enqueue into a queue nobody will ever read —
+        # stranding an accepted request (504/hang instead of 503).
+        self._intake_lock = threading.Lock()
+        self._drain = True
+        self._served = 0
+        self._rejected = 0
+        self._drained = 0
+        self._count_lock = threading.Lock()
+        self._workers = [
+            threading.Thread(target=self._worker, args=(b,),
+                             name=f"pvraft-serve-b{b}", daemon=True)
+            for b in engine.cfg.buckets
+        ]
+        for w in self._workers:
+            w.start()
+
+    # ------------------------------------------------------------- intake --
+
+    def submit(self, pc1: np.ndarray, pc2: np.ndarray) -> _Request:
+        """Validate and enqueue one request; returns a handle whose
+        ``wait()`` yields the un-padded (n1, 3) flow. Raises
+        :class:`RequestError` (contract), :class:`QueueFullError`
+        (backpressure) or :class:`ShutdownError` (draining)."""
+        try:
+            bucket = self.engine.validate_request(pc1, pc2)
+        except RequestError as e:
+            self._reject(e.reason)
+            raise
+        req = _Request(np.asarray(pc1, np.float32),
+                       np.asarray(pc2, np.float32))
+        req.t_enqueue = time.monotonic()
+        # Check-and-enqueue is atomic w.r.t. shutdown (see _intake_lock):
+        # an enqueue here happens-before the stop flag is set, so the
+        # workers (or the drain sweep) are guaranteed to see it. The lock
+        # covers ONLY that pair — reject accounting does telemetry file
+        # I/O and must not serialize intake across buckets under the
+        # exact overload that makes rejects frequent.
+        reject = None
+        with self._intake_lock:
+            if self._stopping.is_set():
+                reject = "shutdown"
+            elif self._queues[bucket].full():
+                # Submitters are serialized by _intake_lock and workers
+                # only remove, so a not-full queue here cannot fill
+                # before the put below — the full() check IS the
+                # admission decision.
+                reject = "queue_full"
+            else:
+                # Count the submit BEFORE the enqueue becomes visible to
+                # a worker: otherwise a dispatched response could reach
+                # record_batch first and a concurrent /metrics snapshot
+                # would see responses_total > requests_total. Counter
+                # increments only — no telemetry I/O under the lock.
+                if self.metrics is not None:
+                    self.metrics.record_submit(bucket)
+                self._queues[bucket].put_nowait(req)
+        if reject == "shutdown":
+            self._reject("shutdown")
+            raise ShutdownError("server is shutting down")
+        if reject == "queue_full":
+            self._reject("queue_full", bucket=bucket,
+                         queue_depth=self.cfg.queue_depth)
+            raise QueueFullError(
+                f"bucket {bucket} queue is full "
+                f"({self.cfg.queue_depth} pending)") from None
+        return req
+
+    def record_reject(self, reason: str) -> None:
+        """Count a reject that never reached ``submit`` (e.g. the HTTP
+        layer's body decode / body-size failures) so ``/metrics`` and
+        the ``serve_reject`` event stream agree with what clients saw."""
+        self._reject(reason)
+
+    def record_failure(self, reason: str) -> None:
+        """Count an ACCEPTED request that never produced a response
+        (504 predict timeout, 500 engine failure): already counted at
+        submit, so only the outcome is recorded — otherwise /metrics
+        totals never reconcile under sustained slowness and the
+        load-gen artifact's client counts contradict server_metrics."""
+        with self._count_lock:
+            self._rejected += 1
+        if self.metrics is not None:
+            self.metrics.record_failure(reason)
+        if self.telemetry is not None:
+            self.telemetry.emit_reject(reason)
+
+    def _reject(self, reason: str, bucket: Optional[int] = None,
+                queue_depth: Optional[int] = None) -> None:
+        with self._count_lock:
+            self._rejected += 1
+        if self.metrics is not None:
+            self.metrics.record_reject(reason)
+        if self.telemetry is not None:
+            self.telemetry.emit_reject(reason, bucket=bucket,
+                                       queue_depth=queue_depth)
+
+    def queue_depths(self) -> Dict[int, int]:
+        return {b: q.qsize() for b, q in self._queues.items()}
+
+    # ------------------------------------------------------------- worker --
+
+    def _collect(self, q: "queue.Queue[_Request]") -> List[_Request]:
+        """One group: block briefly for a first request (so the stop flag
+        is polled), then gather up to max_batch until max_wait_ms."""
+        try:
+            first = q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        group = [first]
+        deadline = time.monotonic() + self.cfg.max_wait_ms / 1000.0
+        while len(group) < self.cfg.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                group.append(q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return group
+
+    def _worker(self, bucket: int) -> None:
+        q = self._queues[bucket]
+        while True:
+            group = self._collect(q)
+            if not group:
+                if self._stopping.is_set():
+                    if not self._drain:
+                        break
+                    if q.empty():
+                        break
+                continue
+            if self._stopping.is_set() and not self._drain:
+                for req in group:
+                    self.record_failure("shutdown")
+                    req.fail(ShutdownError("server stopped without drain"))
+                continue
+            self._dispatch(bucket, group)
+
+    def _dispatch(self, bucket: int, group: List[_Request]) -> None:
+        # Drop requests whose waiter already timed out (504 sent): the
+        # engine time would buy an answer nobody reads, and counting
+        # them as served would report success for client-visible
+        # failures.
+        group = [r for r in group if not r.abandoned]
+        if not group:
+            return
+        t0 = time.monotonic()
+        try:
+            flows = self.engine.predict_batch(
+                [(r.pc1, r.pc2) for r in group], bucket)
+        except BaseException as e:  # noqa: BLE001 — fail the group, not the worker
+            for req in group:
+                req.fail(e)
+            return
+        now = time.monotonic()
+        # Re-check abandonment AFTER the engine call: a waiter can 504
+        # while predict runs (seconds), and its request must not be
+        # counted as served or have its (by-definition over-deadline)
+        # latency skew the histogram. The remaining race — a timeout
+        # between this check and the waiter reading the result — is the
+        # benign one noted in _Request.wait.
+        live = [(r, f) for r, f in zip(group, flows) if not r.abandoned]
+        latencies = [(now - r.t_enqueue) * 1000.0 for r, _ in live]
+        # Account BEFORE resolving: resolve() unblocks the HTTP replies,
+        # and a client that immediately polls /metrics must see counts
+        # covering every response it has already received.
+        with self._count_lock:
+            self._served += len(live)
+            if self._stopping.is_set():
+                self._drained += len(live)
+        # Fill reflects the dispatch itself (how full the AOT program's
+        # slots were), so it stays keyed on the dispatched group size.
+        bs = self.engine.batch_size_for(len(group))
+        fill = len(group) / bs
+        if self.metrics is not None:
+            self.metrics.record_batch(len(live), fill, latencies)
+        if self.telemetry is not None:
+            self.telemetry.emit_batch(
+                bucket=bucket, batch=bs, n=len(live),
+                fill=round(fill, 4),
+                latency_ms=round((now - t0) * 1000.0, 3),
+                queue_depth=self._queues[bucket].qsize())
+        for req, flow in live:
+            req.resolve(flow)
+
+    # ----------------------------------------------------------- shutdown --
+
+    def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop intake; ``drain=True`` finishes queued work first. Safe
+        to call twice. Emits the ``serve_shutdown`` summary event."""
+        with self._intake_lock:
+            already = self._stopping.is_set()
+            self._drain = drain
+            self._stopping.set()
+        for w in self._workers:
+            w.join(timeout)
+        if drain:
+            # Defense-in-depth: _intake_lock guarantees every accepted
+            # enqueue happens-before the stop flag, and a worker only
+            # exits on (stopping AND empty), so nothing should be left.
+            # Serve any stragglers inline anyway so a drained shutdown
+            # can never strand an accepted request.
+            for bucket, q in self._queues.items():
+                while True:
+                    group: List[_Request] = []
+                    while len(group) < self.cfg.max_batch:
+                        try:
+                            group.append(q.get_nowait())
+                        except queue.Empty:
+                            break
+                    if not group:
+                        break
+                    self._dispatch(bucket, group)
+        if not drain:
+            # Fail anything the workers didn't pick up.
+            for q in self._queues.values():
+                while True:
+                    try:
+                        req = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    self.record_failure("shutdown")
+                    req.fail(ShutdownError("server stopped without drain"))
+        if self.telemetry is not None and not already:
+            with self._count_lock:
+                self.telemetry.emit_shutdown(
+                    served=self._served, rejected=self._rejected,
+                    drained=self._drained)
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        with self._count_lock:
+            return {"served": self._served, "rejected": self._rejected,
+                    "drained": self._drained}

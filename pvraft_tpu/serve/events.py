@@ -1,0 +1,74 @@
+"""Serve telemetry: lifecycle + per-batch events on the obs stream.
+
+The serving subsystem emits ``pvraft_events/v1`` records through the
+SAME :class:`pvraft_tpu.obs.events.EventLog` the trainer uses — one
+schema, one validator (``python -m pvraft_tpu.obs validate``), one gate
+stage in ``scripts/lint.sh`` covering training and serving telemetry
+alike. Event types: ``serve_compile`` (one per AOT program at startup),
+``serve_batch`` (one per dispatched micro-batch), ``serve_reject``
+(backpressure/contract rejections), ``serve_shutdown`` (drain summary).
+
+Unlike the trainer (one writer process, one thread), serve events are
+emitted from HTTP handler threads and batcher workers concurrently, so
+every emit is serialized behind one lock — ``EventLog.seq`` must stay
+strictly sequential or the file fails its own validator.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from pvraft_tpu.obs.events import EventLog, run_metadata
+
+
+class ServeTelemetry:
+    """Thread-safe ``pvraft_events/v1`` writer for the serve lifecycle."""
+
+    def __init__(self, events_path: str, cfg=None,
+                 enabled: Optional[bool] = None):
+        self._lock = threading.Lock()
+        self.events = EventLog(events_path, enabled=enabled)
+        self.events.emit("run_header", **run_metadata(cfg, mode="serve"))
+
+    def emit_compile(self, bucket: int, batch: int, lower_s: float,
+                     compile_s: float,
+                     memory: Optional[Dict[str, Any]] = None) -> None:
+        fields: Dict[str, Any] = {
+            "bucket": bucket, "batch": batch,
+            "lower_s": lower_s, "compile_s": compile_s}
+        if memory is not None:
+            fields["memory"] = memory
+        with self._lock:
+            self.events.emit("serve_compile", **fields)
+
+    def emit_batch(self, bucket: int, batch: int, n: int, fill: float,
+                   latency_ms: float,
+                   queue_depth: Optional[int] = None) -> None:
+        fields: Dict[str, Any] = {
+            "bucket": bucket, "batch": batch, "n": n,
+            "fill": fill, "latency_ms": latency_ms}
+        if queue_depth is not None:
+            fields["queue_depth"] = queue_depth
+        with self._lock:
+            self.events.emit("serve_batch", **fields)
+
+    def emit_reject(self, reason: str, bucket: Optional[int] = None,
+                    queue_depth: Optional[int] = None) -> None:
+        fields: Dict[str, Any] = {"reason": reason}
+        if bucket is not None:
+            fields["bucket"] = bucket
+        if queue_depth is not None:
+            fields["queue_depth"] = queue_depth
+        with self._lock:
+            self.events.emit("serve_reject", **fields)
+
+    def emit_shutdown(self, served: int, rejected: int,
+                      drained: int) -> None:
+        with self._lock:
+            self.events.emit("serve_shutdown", served=served,
+                             rejected=rejected, drained=drained)
+
+    def close(self) -> None:
+        with self._lock:
+            self.events.close()

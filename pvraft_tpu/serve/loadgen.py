@@ -1,0 +1,201 @@
+"""In-process load generator + the ``pvraft_serve_load/v1`` artifact.
+
+Drives a real :class:`ServeHTTPServer` (ephemeral port, actual HTTP
+round-trips through the stdlib client) with concurrent workers issuing
+requests whose point counts spread across the configured buckets, then
+writes a latency/throughput artifact:
+
+    {"schema": "pvraft_serve_load/v1",
+     "config": {...}, "compile": [...per-program...],
+     "requests": {"total", "ok", "rejected", "errors"},
+     "latency_ms": {"p50", "p95", "p99", "mean", "max"},
+     "throughput_rps": float, "duration_s": float,
+     "server_metrics": {...the /metrics snapshot...}}
+
+Client-side latency quantiles are computed from the raw per-request
+samples (exact, unlike the server histogram's bucketed upper bounds).
+``validate_load_artifact`` is the schema gate for the committed
+artifact (wired into ``scripts/lint.sh``)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+SCHEMA_VERSION = "pvraft_serve_load/v1"
+
+_REQUIRED = ("schema", "config", "compile", "requests", "latency_ms",
+             "throughput_rps", "duration_s", "server_metrics")
+_LAT_KEYS = ("p50", "p95", "p99", "mean", "max")
+
+
+def validate_load_artifact(doc: Any,
+                           path: str = "<artifact>") -> List[str]:
+    """Schema problems of a load artifact ([] = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"{path}: artifact is {type(doc).__name__}, not an object"]
+    if doc.get("schema") != SCHEMA_VERSION:
+        problems.append(
+            f"{path}: schema {doc.get('schema')!r} != {SCHEMA_VERSION!r}")
+    for key in _REQUIRED:
+        if key not in doc:
+            problems.append(f"{path}: missing field {key!r}")
+    reqs = doc.get("requests")
+    if isinstance(reqs, dict):
+        for key in ("total", "ok", "rejected", "errors"):
+            if not isinstance(reqs.get(key), int):
+                problems.append(
+                    f"{path}: requests.{key} must be an int, "
+                    f"got {reqs.get(key)!r}")
+        if all(isinstance(reqs.get(k), int)
+               for k in ("total", "ok", "rejected", "errors")):
+            if reqs["ok"] + reqs["rejected"] + reqs["errors"] != reqs["total"]:
+                problems.append(
+                    f"{path}: requests ok+rejected+errors != total "
+                    f"({reqs})")
+    elif "requests" in doc:
+        problems.append(f"{path}: requests must be an object")
+    lat = doc.get("latency_ms")
+    if isinstance(lat, dict):
+        for key in _LAT_KEYS:
+            v = lat.get(key)
+            if v is not None and not isinstance(v, (int, float)):
+                problems.append(
+                    f"{path}: latency_ms.{key} must be a number or null, "
+                    f"got {v!r}")
+        order = [lat.get(k) for k in ("p50", "p95", "p99")]
+        if all(isinstance(v, (int, float)) for v in order):
+            if not (order[0] <= order[1] <= order[2]):
+                problems.append(
+                    f"{path}: latency quantiles must be non-decreasing, "
+                    f"got p50={order[0]} p95={order[1]} p99={order[2]}")
+    elif "latency_ms" in doc:
+        problems.append(f"{path}: latency_ms must be an object")
+    if not isinstance(doc.get("compile"), list):
+        if "compile" in doc:
+            problems.append(f"{path}: compile must be a list")
+    for key in ("throughput_rps", "duration_s"):
+        if key in doc and not isinstance(doc[key], (int, float)):
+            problems.append(f"{path}: {key} must be a number")
+    return problems
+
+
+def validate_load_artifact_file(path: str) -> List[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable: {e}"]
+    return validate_load_artifact(doc, path=path)
+
+
+def _post_json(host: str, port: int, path: str, doc: Dict[str, Any],
+               timeout: float = 120.0) -> Dict[str, Any]:
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(doc).encode("utf-8"),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        return {"status": resp.status, "body": json.loads(body)}
+    finally:
+        conn.close()
+
+
+def _get_json(host: str, port: int, path: str,
+              timeout: float = 30.0) -> Dict[str, Any]:
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def run_load(
+    server,                       # a started ServeHTTPServer
+    n_requests: int,
+    concurrency: int,
+    point_counts: List[int],
+    seed: int = 0,
+    coord_scale: float = 1.0,
+) -> Dict[str, Any]:
+    """Issue ``n_requests`` over ``concurrency`` client threads against a
+    running server; returns the raw measurement dict (no schema fields).
+    Point counts cycle through ``point_counts`` so every bucket is hit."""
+    rng = np.random.default_rng(seed)
+    # Pre-generate the request payloads so client threads measure the
+    # server, not numpy.
+    payloads = []
+    for i in range(n_requests):
+        n = point_counts[i % len(point_counts)]
+        pc1 = rng.uniform(-coord_scale, coord_scale, (n, 3)).astype(np.float32)
+        flow = rng.normal(0, 0.05 * coord_scale, (n, 3)).astype(np.float32)
+        payloads.append({"pc1": pc1.tolist(), "pc2": (pc1 + flow).tolist()})
+
+    results: List[Dict[str, Any]] = [None] * n_requests  # type: ignore
+    cursor = {"i": 0}
+    cursor_lock = threading.Lock()
+
+    def client():
+        while True:
+            with cursor_lock:
+                i = cursor["i"]
+                if i >= n_requests:
+                    return
+                cursor["i"] = i + 1
+            t0 = time.monotonic()
+            try:
+                r = _post_json(server.host, server.port, "/predict",
+                               payloads[i])
+                ms = (time.monotonic() - t0) * 1000.0
+                results[i] = {"status": r["status"], "ms": ms}
+            except Exception as e:  # noqa: BLE001 — a client error is data
+                results[i] = {"status": -1, "ms": None,
+                              "error": f"{type(e).__name__}: {e}"}
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(concurrency)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    duration = time.monotonic() - t_start
+
+    ok = [r for r in results if r["status"] == 200]
+    rejected = [r for r in results if r["status"] in (400, 413, 503, 504)]
+    # Everything else (transport failures recorded as -1, but also any
+    # unexpected status such as a 500) counts as an error so the
+    # ok+rejected+errors == total schema invariant holds by construction.
+    errors = [r for r in results
+              if r["status"] not in (200, 400, 413, 503, 504)]
+    lat = sorted(r["ms"] for r in ok)
+
+    def pct(q: float) -> Optional[float]:
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1, int(q * len(lat)))], 3)
+
+    return {
+        "requests": {"total": n_requests, "ok": len(ok),
+                     "rejected": len(rejected), "errors": len(errors)},
+        "latency_ms": {
+            "p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
+            "mean": round(float(np.mean(lat)), 3) if lat else None,
+            "max": round(lat[-1], 3) if lat else None,
+        },
+        "throughput_rps": round(len(ok) / duration, 3) if duration else 0.0,
+        "duration_s": round(duration, 3),
+        "server_metrics": _get_json(server.host, server.port, "/metrics"),
+    }

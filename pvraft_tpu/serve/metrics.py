@@ -1,0 +1,131 @@
+"""Thread-safe serve metrics: the ``/metrics`` endpoint's backing store.
+
+Counters are updated from HTTP handler threads and the batcher workers
+concurrently; one lock keeps the snapshot consistent. The latency
+histogram uses fixed log-spaced bucket edges (ms) so the snapshot is
+bounded-size no matter how long the server runs; quantiles reported from
+it are upper-bound estimates (the edge of the bucket the quantile falls
+in) — honest for SLO checks, not sub-bucket precise.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+# Fixed histogram edges (ms): latency falls in the first bucket whose
+# edge is >= the sample; the final bucket is unbounded.
+LATENCY_EDGES_MS = (
+    1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+    1000.0, 2000.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+class LatencyHistogram:
+    """Fixed-edge histogram with count/sum/max (no lock: the owner
+    serializes access)."""
+
+    def __init__(self):
+        self.counts = [0] * (len(LATENCY_EDGES_MS) + 1)
+        self.count = 0
+        self.sum_ms = 0.0
+        self.max_ms = 0.0
+
+    def observe(self, ms: float) -> None:
+        i = 0
+        while i < len(LATENCY_EDGES_MS) and ms > LATENCY_EDGES_MS[i]:
+            i += 1
+        self.counts[i] += 1
+        self.count += 1
+        self.sum_ms += ms
+        self.max_ms = max(self.max_ms, ms)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper-bound estimate: the edge of the bucket holding the
+        q-quantile (None when empty; max_ms for the unbounded bucket)."""
+        if not self.count:
+            return None
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target and c:
+                if i < len(LATENCY_EDGES_MS):
+                    return LATENCY_EDGES_MS[i]
+                return self.max_ms
+        return self.max_ms
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "mean_ms": (self.sum_ms / self.count) if self.count else None,
+            "max_ms": self.max_ms if self.count else None,
+            "p50_ms": self.quantile(0.50),
+            "p95_ms": self.quantile(0.95),
+            "p99_ms": self.quantile(0.99),
+            "bucket_edges_ms": list(LATENCY_EDGES_MS),
+            "bucket_counts": list(self.counts),
+        }
+
+
+class ServeMetrics:
+    """All serve counters behind one lock."""
+
+    def __init__(self, buckets):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.responses_total = 0
+        self.rejected: Dict[str, int] = {}
+        self.batches_total = 0
+        self.batch_fill_sum = 0.0
+        self.per_bucket_requests: Dict[int, int] = {int(b): 0
+                                                    for b in buckets}
+        self.latency = LatencyHistogram()
+
+    def record_submit(self, bucket: int) -> None:
+        with self._lock:
+            self.requests_total += 1
+            self.per_bucket_requests[int(bucket)] = (
+                self.per_bucket_requests.get(int(bucket), 0) + 1)
+
+    def record_reject(self, reason: str) -> None:
+        with self._lock:
+            self.requests_total += 1
+            self.rejected[reason] = self.rejected.get(reason, 0) + 1
+
+    def record_failure(self, reason: str) -> None:
+        """An ACCEPTED request (already in ``requests_total`` via
+        ``record_submit``) that never produced a response — 504 predict
+        timeout, 500 engine failure, shutdown-without-drain. Keeps the
+        reconciliation identity ``requests_total == responses_total +
+        sum(rejected) + in_flight`` without double-counting the request."""
+        with self._lock:
+            self.rejected[reason] = self.rejected.get(reason, 0) + 1
+
+    def record_batch(self, n: int, fill: float,
+                     latencies_ms: List[float]) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.batch_fill_sum += fill
+            self.responses_total += n
+            for ms in latencies_ms:
+                self.latency.observe(ms)
+
+    def snapshot(self, queue_depths: Optional[Dict[int, int]] = None
+                 ) -> Dict[str, Any]:
+        with self._lock:
+            snap: Dict[str, Any] = {
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "rejected": dict(self.rejected),
+                "batches_total": self.batches_total,
+                "batch_fill_mean": (
+                    self.batch_fill_sum / self.batches_total
+                    if self.batches_total else None),
+                "per_bucket_requests": {
+                    str(k): v for k, v in self.per_bucket_requests.items()},
+                "latency": self.latency.snapshot(),
+            }
+        if queue_depths is not None:
+            snap["queue_depth"] = {str(k): v for k, v in queue_depths.items()}
+        return snap

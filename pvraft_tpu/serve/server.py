@@ -1,0 +1,287 @@
+"""Stdlib HTTP front-end: JSON/msgpack ``/predict`` + health + metrics.
+
+A ``ThreadingHTTPServer`` (one thread per connection) over the
+micro-batcher — no web framework, nothing to install. Handler threads
+block on their request's completion event while the batcher workers do
+the actual dispatch, so concurrency is bounded by queue depth, not by
+the HTTP layer.
+
+Endpoints:
+
+  ``POST /predict``
+      JSON body ``{"pc1": [[x,y,z],...], "pc2": [[x,y,z],...]}`` ->
+      ``{"flow": [[x,y,z],...], "n": n}``. With ``Content-Type:
+      application/msgpack`` the body is a msgpack map whose ``pc1``/
+      ``pc2`` values are raw little-endian float32 bytes (n*3 each);
+      the response mirrors that (``flow`` as raw f32 bytes) — the
+      fast path, no float->decimal round-trips.
+      Errors: 400 contract violations, 413 too large for every bucket,
+      503 queue full / shutting down (explicit backpressure),
+      504 predict timeout.
+  ``GET /healthz``
+      ``{"status": "ok", buckets, batch_sizes, programs: [...compile
+      report...]}`` — serving readiness including the AOT evidence.
+  ``GET /metrics``
+      JSON counters: request/response/reject counts, per-bucket queue
+      depth, batch-fill ratio, latency histogram (serve/metrics.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from pvraft_tpu.serve.batcher import (
+    BatcherConfig,
+    MicroBatcher,
+    QueueFullError,
+    ShutdownError,
+)
+from pvraft_tpu.serve.engine import RequestError
+from pvraft_tpu.serve.metrics import ServeMetrics
+
+MSGPACK_CT = "application/msgpack"
+JSON_CT = "application/json"
+
+
+def _decode_json(body: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    try:
+        doc = json.loads(body)
+    except ValueError as e:
+        raise RequestError("bad_request", f"invalid JSON: {e}") from None
+    if not isinstance(doc, dict) or "pc1" not in doc or "pc2" not in doc:
+        raise RequestError("bad_request", "body must carry 'pc1' and 'pc2'")
+    try:
+        pc1 = np.asarray(doc["pc1"], np.float32)
+        pc2 = np.asarray(doc["pc2"], np.float32)
+    except (TypeError, ValueError) as e:
+        raise RequestError("bad_request", f"non-numeric cloud: {e}") from None
+    return pc1, pc2
+
+
+def _decode_msgpack(body: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    import msgpack
+
+    try:
+        doc = msgpack.unpackb(body, raw=False)
+    except Exception as e:
+        raise RequestError("bad_request", f"invalid msgpack: {e}") from None
+    if not isinstance(doc, dict) or "pc1" not in doc or "pc2" not in doc:
+        raise RequestError("bad_request", "body must carry 'pc1' and 'pc2'")
+    out = []
+    for name in ("pc1", "pc2"):
+        raw = doc[name]
+        if not isinstance(raw, (bytes, bytearray)) or len(raw) % 12:
+            raise RequestError(
+                "bad_request",
+                f"{name} must be raw float32 bytes, length divisible by 12")
+        out.append(np.frombuffer(bytes(raw), np.float32).reshape(-1, 3))
+    return out[0], out[1]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set by ServeHTTPServer below.
+    batcher: MicroBatcher = None  # type: ignore[assignment]
+    metrics = None
+    predict_timeout_s: float = 60.0
+    max_body_bytes: int = 1 << 24
+    quiet: bool = True
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # stdlib default prints every hit
+        if not self.quiet:
+            super().log_message(fmt, *args)
+
+    # ------------------------------------------------------------ replies --
+
+    def _reply(self, code: int, payload: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        if self.close_connection:
+            # The stdlib honors the flag by closing the socket but never
+            # advertises it; under HTTP/1.1 a pooled client would reuse
+            # the connection and hit ECONNRESET without this header.
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _reply_json(self, code: int, doc: Dict[str, Any]) -> None:
+        self._reply(code, json.dumps(doc).encode("utf-8"), JSON_CT)
+
+    def _reply_error(self, code: int, error: str, detail: str = "") -> None:
+        self._reply_json(code, {"error": error, "detail": detail})
+
+    # ------------------------------------------------------------- routes --
+
+    def do_GET(self):  # noqa: N802 — stdlib handler naming
+        if self.path == "/healthz":
+            self._reply_json(200, {
+                "status": "ok",
+                "buckets": list(self.batcher.engine.cfg.buckets),
+                "batch_sizes": list(self.batcher.engine.cfg.batch_sizes),
+                "min_points": self.batcher.engine.cfg.min_points,
+                "programs": self.batcher.engine.compile_report(),
+            })
+            return
+        if self.path == "/metrics":
+            snap = (self.metrics.snapshot(self.batcher.queue_depths())
+                    if self.metrics is not None else {})
+            self._reply_json(200, snap)
+            return
+        self._reply_error(404, "not_found", self.path)
+
+    def do_POST(self):  # noqa: N802 — stdlib handler naming
+        if self.path != "/predict":
+            # The body is left unread: a reused keep-alive connection
+            # would parse it as the next request line, so close.
+            self.close_connection = True
+            self._reply_error(404, "not_found", self.path)
+            return
+        raw_length = self.headers.get("Content-Length")
+        if raw_length is None:
+            # Absent header (e.g. Transfer-Encoding: chunked): the body
+            # length is unknown, so it would stay unread and desync a
+            # reused keep-alive connection — reject and close.
+            self.close_connection = True
+            self.batcher.record_reject("bad_request")
+            self._reply_error(400, "bad_request", "missing Content-Length")
+            return
+        try:
+            length = int(raw_length)
+        except ValueError:
+            length = -1
+        if length < 0:
+            # Non-numeric or negative: rfile.read(-1) would block until
+            # EOF (a handler thread pinned per request — trivial DoS).
+            self.close_connection = True
+            self.batcher.record_reject("bad_request")
+            self._reply_error(400, "bad_request", "invalid Content-Length")
+            return
+        if length > self.max_body_bytes:
+            # Bound memory BEFORE buffering: the engine's too_large check
+            # only runs after a full read + parse. The body was not
+            # consumed, so the keep-alive stream is unusable — close it.
+            self.close_connection = True
+            self.batcher.record_reject("too_large")
+            self._reply_error(
+                413, "too_large",
+                f"body {length} B exceeds the {self.max_body_bytes} B cap")
+            return
+        body = self.rfile.read(length)
+        ctype = (self.headers.get("Content-Type") or JSON_CT).split(";")[0]
+        use_msgpack = ctype.strip().lower() == MSGPACK_CT
+        try:
+            pc1, pc2 = (_decode_msgpack(body) if use_msgpack
+                        else _decode_json(body))
+        except RequestError as e:
+            # Decode failures never reach submit's reject ledger — record
+            # them here so /metrics and serve_reject events match the
+            # client-observed totals.
+            self.batcher.record_reject(e.reason)
+            self._reply_error(400, e.reason, str(e))
+            return
+        try:
+            req = self.batcher.submit(pc1, pc2)
+            flow = req.wait(self.predict_timeout_s)
+        except RequestError as e:
+            code = 413 if e.reason == "too_large" else 400
+            self._reply_error(code, e.reason, str(e))
+            return
+        except QueueFullError as e:
+            self._reply_error(503, "queue_full", str(e))
+            return
+        except ShutdownError as e:
+            self._reply_error(503, "shutting_down", str(e))
+            return
+        except TimeoutError as e:
+            # Accepted-then-failed: counted at submit, so record the
+            # outcome (not a fresh request) to keep /metrics reconciled.
+            self.batcher.record_failure("timeout")
+            self._reply_error(504, "timeout", str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — a handler must answer, not die
+            self.batcher.record_failure("internal")
+            self._reply_error(500, "internal", f"{type(e).__name__}: {e}")
+            return
+        if use_msgpack:
+            import msgpack
+
+            payload = msgpack.packb({
+                "flow": np.ascontiguousarray(flow, np.float32).tobytes(),
+                "n": int(flow.shape[0]),
+            })
+            self._reply(200, payload, MSGPACK_CT)
+        else:
+            self._reply_json(200, {"flow": flow.tolist(),
+                                   "n": int(flow.shape[0])})
+
+
+class ServeHTTPServer:
+    """The assembled service: engine + batcher behind HTTP.
+
+    ``port=0`` binds an ephemeral port (tests, load generator); the
+    bound port is ``self.port`` after construction. ``start()`` serves
+    on a background thread; ``shutdown()`` stops intake, drains the
+    batcher, then stops the HTTP loop."""
+
+    def __init__(self, batcher: MicroBatcher, host: str = "127.0.0.1",
+                 port: int = 8000, metrics=None,
+                 predict_timeout_s: float = 60.0, quiet: bool = True):
+        self.batcher = batcher
+        # 64 B/coordinate bounds any JSON float spelling (msgpack raw f32
+        # is 4 B); anything past this cannot fit the largest bucket and
+        # would only be buffered to be 413'd after parsing.
+        largest = max(batcher.engine.cfg.buckets)
+        max_body = 2 * largest * 3 * 64 + 65536
+        handler = type("BoundHandler", (_Handler,), {
+            "batcher": batcher,
+            "metrics": metrics,
+            "predict_timeout_s": predict_timeout_s,
+            "max_body_bytes": max_body,
+            "quiet": quiet,
+        })
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="pvraft-serve-http",
+            daemon=True)
+        self._thread.start()
+
+    def shutdown(self, drain: bool = True) -> None:
+        self.batcher.shutdown(drain=drain)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(10.0)
+
+
+def build_service(engine, *, max_wait_ms: float = 5.0,
+                  queue_depth: int = 64, host: str = "127.0.0.1",
+                  port: int = 0, telemetry=None,
+                  predict_timeout_s: float = 60.0,
+                  quiet: bool = True) -> ServeHTTPServer:
+    """The one canonical engine -> metrics -> batcher -> HTTP assembly,
+    shared by ``python -m pvraft_tpu.serve`` and the load generator so
+    the two serving surfaces cannot drift: ``max_batch`` is always the
+    largest compiled batch size, and one :class:`ServeMetrics` reaches
+    both the batcher and the HTTP layer. Returns an unstarted server
+    (``.start()`` / ``.shutdown()``)."""
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine,
+        BatcherConfig(max_batch=max(engine.cfg.batch_sizes),
+                      max_wait_ms=max_wait_ms, queue_depth=queue_depth),
+        telemetry=telemetry, metrics=metrics)
+    return ServeHTTPServer(batcher, host=host, port=port, metrics=metrics,
+                           predict_timeout_s=predict_timeout_s, quiet=quiet)

@@ -39,13 +39,14 @@ def main() -> int:
 
     from pvraft_tpu.engine.checkpoint import (
         export_torch_state_dict,
-        load_payload,
+        load_params,
     )
 
-    payload = load_payload(args.src)  # msgpack file or .orbax directory
-    tree = payload["params"]
-    if set(tree.keys()) == {"params"}:  # flax variables dict -> inner tree
-        tree = tree["params"]
+    # msgpack file or .orbax directory; the payload-shape normalization
+    # (full variables dict vs bare tree) lives in load_params, shared
+    # with the serve engine.
+    variables, epoch = load_params(args.src)
+    tree = variables["params"]
     # The two layouts are self-identifying: PVRaftRefine nests the stage-1
     # modules under "backbone" (engine/checkpoint.py:107-109).
     refine = args.refine or "backbone" in tree
@@ -55,10 +56,8 @@ def main() -> int:
     sd = export_torch_state_dict(tree, refine=refine)
     state_dict = {k: torch.from_numpy(v.copy()) for k, v in sd.items()}
     os.makedirs(os.path.dirname(args.dst) or ".", exist_ok=True)
-    torch.save({"epoch": int(payload.get("epoch", 0)),
-                "state_dict": state_dict}, args.dst)
-    print(f"wrote {args.dst} ({len(state_dict)} tensors, "
-          f"epoch {int(payload.get('epoch', 0))})")
+    torch.save({"epoch": epoch, "state_dict": state_dict}, args.dst)
+    print(f"wrote {args.dst} ({len(state_dict)} tensors, epoch {epoch})")
     return 0
 
 

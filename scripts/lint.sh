@@ -45,3 +45,14 @@ if [ -n "$event_logs" ]; then
 else
   echo "(no committed event logs)"
 fi
+
+echo "== pvraft_serve_load/v1: committed load-gen artifacts validate"
+# The serve latency/throughput evidence (scripts/serve_loadgen.py) must
+# parse against its schema, same discipline as the event logs.
+serve_artifacts=$(ls artifacts/serve_*.json 2>/dev/null || true)
+if [ -n "$serve_artifacts" ]; then
+  # shellcheck disable=SC2086 -- word splitting over the file list is intended
+  python -m pvraft_tpu.serve validate-load $serve_artifacts
+else
+  echo "(no committed serve artifacts)"
+fi

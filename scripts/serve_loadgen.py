@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Load-generate the serve stack in-process -> latency/throughput artifact.
+
+Stands up the full service (engine AOT compiles, micro-batcher, stdlib
+HTTP server on an ephemeral port), drives it with concurrent clients
+over real HTTP, and commits the evidence:
+
+    artifacts/serve_cpu_synthetic.json          pvraft_serve_load/v1
+    artifacts/serve_cpu_synthetic.events.jsonl  pvraft_events/v1 (serve)
+
+Both are validated by ``scripts/lint.sh`` (the JSON by ``python -m
+pvraft_tpu.serve validate-load``, the events by the shared obs
+validator), so a writer/schema drift fails the standing gate before a
+TPU run produces unreadable serve telemetry.
+
+Default geometry is the CPU-synthetic smoke tier (small model, small
+buckets) — the honest labels: this measures the serving machinery
+(batching, padding, queueing, HTTP) on this host, not TPU model
+latency. ``--ckpt`` serves a real checkpoint instead of random-init
+weights; ``--buckets/--batch_sizes/--truncate_k`` scale up.
+
+    python scripts/serve_loadgen.py --out artifacts/serve_cpu_synthetic.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pvraft_tpu import parse_int_list as _parse_ints  # noqa: E402 — needs the path hack
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/serve_cpu_synthetic.json")
+    ap.add_argument("--events", default="",
+                    help="events path (default: <out stem>.events.jsonl)")
+    ap.add_argument("--ckpt", default="",
+                    help="checkpoint to serve (default: random init)")
+    ap.add_argument("--buckets", default="128,256")
+    ap.add_argument("--batch_sizes", default="1,4")
+    ap.add_argument("--truncate_k", type=int, default=32)
+    ap.add_argument("--graph_k", type=int, default=8)
+    ap.add_argument("--corr_knn", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--max_wait_ms", type=float, default=10.0)
+    ap.add_argument("--queue_depth", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    # CPU pin before the backend commits (tooling must not grab a TPU).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.serve import (
+        InferenceEngine,
+        ServeConfig,
+        ServeTelemetry,
+        build_service,
+    )
+    from pvraft_tpu.serve.loadgen import (
+        SCHEMA_VERSION,
+        run_load,
+        validate_load_artifact,
+    )
+
+    model = ModelConfig(truncate_k=args.truncate_k, graph_k=args.graph_k,
+                        corr_knn=args.corr_knn)
+    cfg = ServeConfig(model=model, buckets=_parse_ints(args.buckets),
+                      batch_sizes=_parse_ints(args.batch_sizes),
+                      num_iters=args.iters)
+    events_path = args.events or (
+        os.path.splitext(args.out)[0] + ".events.jsonl")
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    # Fresh event file per run: the artifact documents ONE run, and a
+    # stale tail from a previous geometry would lie about this one.
+    if os.path.exists(events_path):
+        os.unlink(events_path)
+    telemetry = ServeTelemetry(events_path, cfg=cfg)
+
+    if args.ckpt:
+        engine = InferenceEngine.from_checkpoint(args.ckpt, cfg,
+                                                 telemetry=telemetry)
+    else:
+        from pvraft_tpu.models.raft import PVRaft, PVRaftRefine
+
+        m = (PVRaftRefine if cfg.refine else PVRaft)(model)
+        rng = np.random.default_rng(args.seed)
+        n0 = cfg.buckets[0]
+        pc = jax.numpy.asarray(
+            rng.uniform(-1, 1, (1, n0, 3)).astype(np.float32))
+        params = m.init(jax.random.key(args.seed), pc, pc, 2)
+        engine = InferenceEngine(params, cfg, telemetry=telemetry)
+    print(f"[loadgen] engine ready: "
+          f"{[r['name'] for r in engine.compile_report()]}", flush=True)
+
+    server = build_service(engine, max_wait_ms=args.max_wait_ms,
+                           queue_depth=args.queue_depth,
+                           telemetry=telemetry)
+    server.start()
+    print(f"[loadgen] serving on port {server.port}; "
+          f"{args.requests} requests x {args.concurrency} clients",
+          flush=True)
+
+    # Point counts spread across the buckets: ~75% and ~95% of each
+    # bucket's capacity (capped below by the model minimum), so both the
+    # padding machinery and the bucket router are exercised.
+    counts = []
+    lo = engine.cfg.min_points
+    prev_bucket = 0
+    for b in cfg.buckets:
+        span = b - prev_bucket
+        counts.append(max(lo, prev_bucket + int(0.75 * span)))
+        counts.append(max(lo, prev_bucket + int(0.95 * span)))
+        prev_bucket = b
+
+    measurement = run_load(server, n_requests=args.requests,
+                           concurrency=args.concurrency,
+                           point_counts=counts, seed=args.seed)
+    server.shutdown(drain=True)
+    telemetry.close()
+
+    artifact = {
+        "schema": SCHEMA_VERSION,
+        "config": {
+            "buckets": list(cfg.buckets),
+            "batch_sizes": list(cfg.batch_sizes),
+            "num_iters": cfg.num_iters,
+            "truncate_k": model.truncate_k,
+            "graph_k": model.graph_k,
+            "corr_knn": model.corr_knn,
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "max_wait_ms": args.max_wait_ms,
+            "queue_depth": args.queue_depth,
+            "point_counts": counts,
+            "weights": args.ckpt or "random_init",
+            "platform": jax.devices()[0].platform,
+        },
+        "compile": engine.compile_report(),
+        **measurement,
+    }
+    problems = validate_load_artifact(artifact, path=args.out)
+    if problems:
+        for p in problems:
+            print(f"[loadgen] SCHEMA PROBLEM: {p}", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"[loadgen] wrote {args.out} and {events_path}")
+    print(json.dumps({
+        "ok": artifact["requests"]["ok"],
+        "rejected": artifact["requests"]["rejected"],
+        "p50_ms": artifact["latency_ms"]["p50"],
+        "p99_ms": artifact["latency_ms"]["p99"],
+        "throughput_rps": artifact["throughput_rps"],
+        "batch_fill_mean": artifact["server_metrics"].get("batch_fill_mean"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

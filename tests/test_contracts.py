@@ -122,6 +122,23 @@ def test_none_spec_skips_argument():
     assert g({"any": "thing"}, jnp.zeros((1, 2, 3))).shape == (1, 2, 3)
 
 
+def test_optional_none_default_arg_skipped_when_none():
+    """A spec'd parameter whose default is None (optional mask args, e.g.
+    corr_init's valid2) is only checked when a non-None value arrives —
+    forwarding an explicit None through a call chain is 'absent', not a
+    violated contract. A required param passing None still fails."""
+    g = _wrapped(lambda a, mask=None: a, "B N", "B N")
+    g(jnp.zeros((2, 3)))                       # absent
+    g(jnp.zeros((2, 3)), mask=None)            # explicit None: absent
+    g(jnp.zeros((2, 3)), None)                 # positional None: absent
+    g(jnp.zeros((2, 3)), jnp.ones((2, 3)))     # real mask: checked
+    with pytest.raises(ShapeError, match="argument 1"):
+        g(jnp.zeros((2, 3)), jnp.ones((2, 9)))
+    h = _wrapped(lambda a, b: a, "B N", "B N")
+    with pytest.raises(ShapeError, match="argument 1 expected an array"):
+        h(jnp.zeros((2, 3)), None)             # required param: still fails
+
+
 def test_wildcard_dim():
     g = _wrapped(lambda a: a, "B _ 3")
     g(jnp.zeros((2, 99, 3)))  # any middle dim passes

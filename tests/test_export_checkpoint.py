@@ -1,0 +1,118 @@
+"""Round-trip gate for ``scripts/export_checkpoint.py``: a trained
+msgpack checkpoint -> torch ``.params`` file -> ``load_torch_checkpoint``
+reimport must reproduce the original parameter tree exactly. The
+converter pair was previously only tested in-memory
+(``test_reference_parity``); this drives the actual CLI file path,
+including the payload-shape normalization (``load_params``) and the
+epoch field."""
+
+import runpy
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # torch + real model init (~1 min)
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.config import ModelConfig
+from pvraft_tpu.engine.checkpoint import (
+    load_torch_checkpoint,
+    save_checkpoint,
+)
+
+CFG = ModelConfig(truncate_k=16, corr_knn=8, graph_k=4)
+
+
+def _init_params(model, rng_seed=0):
+    rng = np.random.default_rng(rng_seed)
+    pc = jnp.asarray(rng.uniform(-1, 1, (1, 24, 3)).astype(np.float32))
+    return model.init(jax.random.key(rng_seed), pc, pc, 2)
+
+
+def _run_export(argv):
+    old = sys.argv
+    sys.argv = ["export_checkpoint.py"] + argv
+    try:
+        with pytest.raises(SystemExit) as e:
+            runpy.run_path("scripts/export_checkpoint.py",
+                           run_name="__main__")
+        assert e.value.code in (0, None)
+    finally:
+        sys.argv = old
+
+
+def _assert_tree_equal(got, want, path=""):
+    assert set(got.keys()) == set(want.keys()), (
+        f"{path}: {sorted(got)} != {sorted(want)}")
+    for k in want:
+        g, w = got[k], want[k]
+        if isinstance(w, dict):
+            _assert_tree_equal(g, w, f"{path}/{k}")
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(g), np.asarray(w), err_msg=f"{path}/{k}")
+
+
+@pytest.mark.parametrize("refine", [False, True])
+def test_export_roundtrip(tmp_path, refine):
+    from pvraft_tpu.models.raft import PVRaft, PVRaftRefine
+
+    model = (PVRaftRefine if refine else PVRaft)(CFG)
+    params = _init_params(model)
+    ckpt_dir = str(tmp_path / "ckpts")
+    save_checkpoint(ckpt_dir, params, opt_state={}, epoch=7,
+                    checkpoint_interval=0)
+    src = str(tmp_path / "ckpts" / "last_checkpoint.msgpack")
+    dst = str(tmp_path / "exported.params")
+    _run_export([src, dst] + (["--refine"] if refine else []))
+
+    tree, epoch = load_torch_checkpoint(dst, refine=refine)
+    assert epoch == 7
+    _assert_tree_equal(tree, params["params"])
+
+
+def test_epochless_payload_yields_sentinel(tmp_path):
+    """A payload with no 'epoch' key loads as epoch -1 — the explicit
+    'unknown' sentinel — pinned so the pre-refactor default (a fake
+    epoch 0, indistinguishable from a real first epoch) doesn't silently
+    come back. Covers both payload shapes load_params normalizes."""
+    from flax import serialization
+
+    from pvraft_tpu.engine.checkpoint import load_params
+
+    inner = {"dense": {"kernel": np.zeros((2, 2), np.float32)}}
+    for payload in ({"params": {"params": inner}},   # full variables dict
+                    {"params": inner}):              # bare inner tree
+        src = tmp_path / "bare.msgpack"
+        src.write_bytes(serialization.msgpack_serialize(payload))
+        variables, epoch = load_params(str(src))
+        assert epoch == -1
+        assert set(variables.keys()) == {"params"}
+        np.testing.assert_array_equal(
+            np.asarray(variables["params"]["dense"]["kernel"]),
+            inner["dense"]["kernel"])
+
+
+def test_export_refine_flag_rejects_stage1(tmp_path):
+    """--refine on a stage-1 checkpoint fails fast (no silent export of
+    a mislabeled tree)."""
+    from pvraft_tpu.models.raft import PVRaft
+
+    params = _init_params(PVRaft(CFG))
+    ckpt_dir = str(tmp_path / "ckpts")
+    save_checkpoint(ckpt_dir, params, opt_state={}, epoch=0,
+                    checkpoint_interval=0)
+    src = str(tmp_path / "ckpts" / "last_checkpoint.msgpack")
+    old = sys.argv
+    sys.argv = ["export_checkpoint.py", src,
+                str(tmp_path / "out.params"), "--refine"]
+    try:
+        with pytest.raises(SystemExit) as e:
+            runpy.run_path("scripts/export_checkpoint.py",
+                           run_name="__main__")
+        assert e.value.code not in (0, None)
+    finally:
+        sys.argv = old

@@ -51,9 +51,17 @@ def test_packed_matches_pytree_step(setup):
 
     np.testing.assert_allclose(packed_losses, ref_losses, rtol=1e-5)
     p_packed, o_packed = unravel(flat)
+    # Param tolerance is absolute-dominated by design: the packed step is
+    # the same math but a different XLA program (ravel/unravel + different
+    # fusion), so three chained adam steps reassociate. Measured at HEAD
+    # on this host: losses bit-identical, worst param abs diff 6.0e-5 —
+    # concentrated on near-zero params where rtol=1e-5/atol=1e-6 was
+    # borderline and flaked (CHANGES.md PR 4). atol=2e-4 is the
+    # seed-stable ceiling with ~3x margin; rtol still pins the large
+    # params.
     for a, b in zip(jax.tree.leaves(p_packed), jax.tree.leaves(p)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=1e-5, atol=2e-4)
     # The optax step count must survive the dtype round-trip exactly.
     counts = [x for x in jax.tree.leaves(o_packed)
               if np.asarray(x).dtype == np.int32]

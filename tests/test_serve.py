@@ -1,0 +1,533 @@
+"""Serve subsystem gate: padding invariance, batcher correctness under
+real thread concurrency, HTTP smoke (the CI serve smoke test), serve
+telemetry schema, and the default-path jaxpr guarantee of the mask
+plumbing.
+
+The engine fixture AOT-compiles 2 tiny programs (2 buckets x one
+batch size) once per module; every test that needs a real model shares
+it (compile cost paid once, conftest.py discipline). batch_sizes=(2,)
+keeps the program count at the tier-1 budget's mercy: single predicts
+route through the bs-2 program with a filled slot — which is itself the
+exactness property test_batch_slot_fill_exact gates — and the bs-1
+program family still compiles in test_serve_compile_events."""
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.config import ModelConfig
+from pvraft_tpu.models import PVRaft
+from pvraft_tpu.serve import (
+    BatcherConfig,
+    InferenceEngine,
+    MicroBatcher,
+    QueueFullError,
+    RequestError,
+    ServeConfig,
+    ServeHTTPServer,
+    ServeMetrics,
+    ServeTelemetry,
+    ShutdownError,
+)
+
+TINY_MODEL = ModelConfig(truncate_k=16, corr_knn=8, graph_k=4)
+TINY_SERVE = ServeConfig(model=TINY_MODEL, buckets=(32, 64),
+                         batch_sizes=(2,), num_iters=2)
+ITERS = TINY_SERVE.num_iters
+
+
+def _cloud(rng, n):
+    return rng.uniform(-1, 1, (n, 3)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def served():
+    """(engine, params, model): one AOT engine for the whole module."""
+    rng = np.random.default_rng(0)
+    model = PVRaft(TINY_MODEL)
+    pc = jnp.asarray(_cloud(rng, 24)[None])
+    params = model.init(jax.random.key(0), pc, pc, ITERS)
+    engine = InferenceEngine(params, TINY_SERVE)
+    return engine, params, model
+
+
+# ------------------------------------------------------------ invariance --
+
+
+def test_padding_invariance(served):
+    """Padded-bucket predictions match unpadded single-example inference.
+
+    The bound is float reassociation only (masked GroupNorm reductions
+    sum extra zeros): measured max abs diff ~2e-6 on this geometry; 1e-5
+    is the seed-stable ceiling."""
+    engine, params, model = served
+    rng = np.random.default_rng(1)
+    # Three shapes cover the contract's corners: the min_points boundary,
+    # cross-bucket n1 != n2, and an exact largest-bucket fit (each
+    # distinct unpadded shape is a fresh reference compile — keep few).
+    for n1, n2 in ((16, 16), (33, 40), (64, 64)):
+        pc1, pc2 = _cloud(rng, n1), _cloud(rng, n2)
+        got = engine.predict(pc1, pc2)
+        want = np.asarray(
+            model.apply(params, pc1[None], pc2[None], ITERS)[0][-1][0])
+        assert got.shape == (n1, 3)
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=0)
+
+
+def test_batch_slot_fill_exact(served):
+    """Unused batch slots (repeat of request 0) cannot perturb real
+    slots: a 2-request group equals each request served alone."""
+    engine, _, _ = served
+    rng = np.random.default_rng(2)
+    reqs = [(_cloud(rng, 20), _cloud(rng, 20)),
+            (_cloud(rng, 28), _cloud(rng, 30))]
+    together = engine.predict_batch(reqs, 32)
+    for (pc1, pc2), flow in zip(reqs, together):
+        alone = engine.predict(pc1, pc2)
+        np.testing.assert_array_equal(flow, alone)
+
+
+# ------------------------------------------------------------- contract --
+
+
+def test_request_validation(served):
+    engine, _, _ = served
+    rng = np.random.default_rng(3)
+    ok = _cloud(rng, 20)
+    with pytest.raises(RequestError) as e:
+        engine.validate_request(_cloud(rng, 8), ok)   # < min_points (16)
+    assert e.value.reason == "too_small"
+    with pytest.raises(RequestError) as e:
+        engine.validate_request(_cloud(rng, 100), _cloud(rng, 100))
+    assert e.value.reason == "too_large"
+    bad = ok.copy()
+    bad[0, 0] = 1e6                                   # beyond coord_limit
+    with pytest.raises(RequestError) as e:
+        engine.validate_request(bad, ok)
+    assert e.value.reason == "bad_request"
+    nan = ok.copy()
+    nan[0, 0] = np.nan
+    with pytest.raises(RequestError) as e:
+        engine.validate_request(nan, ok)
+    assert e.value.reason == "bad_request"
+    assert engine.validate_request(ok, _cloud(rng, 60)) == 64
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError):
+        ServeConfig(model=TINY_MODEL, buckets=(64, 32))     # not ascending
+    with pytest.raises(ValueError):
+        ServeConfig(model=TINY_MODEL, buckets=(8,))         # < min_points
+    with pytest.raises(ValueError):
+        ServeConfig(model=TINY_MODEL, buckets=(32,), batch_sizes=())
+    cfg = ServeConfig(model=TINY_MODEL, buckets=(32, 64))
+    assert cfg.min_points == 16
+
+
+# ---------------------------------------------- batcher (threaded, real) --
+
+
+def test_batcher_buckets_and_exact_flow(served):
+    """Concurrent requests across point counts land in the right buckets
+    and come back as the exact un-padded flow of the single path."""
+    engine, _, _ = served
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=2, max_wait_ms=20, queue_depth=16),
+        metrics=metrics)
+    rng = np.random.default_rng(4)
+    sizes = [20, 28, 40, 64, 17, 50]
+    reqs = [(_cloud(rng, n), _cloud(rng, n)) for n in sizes]
+    want = [engine.predict(pc1, pc2) for pc1, pc2 in reqs]
+
+    handles = [None] * len(reqs)
+
+    def client(i):
+        handles[i] = batcher.submit(*reqs[i])
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, h in enumerate(handles):
+        got = h.wait(60)
+        assert got.shape == (sizes[i], 3)
+        # Same compiled program, same padded inputs -> the batched
+        # dispatch reproduces the single path (only the sibling slot's
+        # contents differ, and batch-parallel ops make that irrelevant —
+        # the slot-fill exactness test gates it).
+        np.testing.assert_allclose(got, want[i], atol=1e-5, rtol=0)
+    batcher.shutdown(drain=True)
+    snap = metrics.snapshot(batcher.queue_depths())
+    assert snap["responses_total"] == len(reqs)
+    assert snap["per_bucket_requests"]["32"] == 3   # n in {20, 28, 17}
+    assert snap["per_bucket_requests"]["64"] == 3   # n in {40, 64, 50}
+    assert snap["queue_depth"] == {"32": 0, "64": 0}
+
+
+class _FakeEngine:
+    """Batcher-logic double: real routing/validation shape, no XLA. A
+    gate event makes dispatch block on demand, so queue-full and drain
+    states are reachable deterministically."""
+
+    def __init__(self, buckets=(32, 64), batch_sizes=(1, 2)):
+        self.cfg = SimpleNamespace(
+            buckets=buckets, batch_sizes=batch_sizes, min_points=4,
+            coord_limit=100.0)
+        self.gate = threading.Event()
+        self.gate.set()
+        self.dispatched = []
+
+    def validate_request(self, pc1, pc2):
+        n = max(pc1.shape[0], pc2.shape[0])
+        for b in self.cfg.buckets:
+            if n <= b:
+                return b
+        raise RequestError("too_large", "too large")
+
+    def batch_size_for(self, n):
+        for bs in self.cfg.batch_sizes:
+            if n <= bs:
+                return bs
+        return self.cfg.batch_sizes[-1]
+
+    def predict_batch(self, requests, bucket):
+        self.gate.wait(30)
+        self.dispatched.append((bucket, len(requests)))
+        return [np.asarray(pc2[: pc1.shape[0]] - pc1, np.float32)
+                for pc1, pc2 in requests]
+
+
+def _pc(n, seed=0):
+    return np.random.default_rng(seed).uniform(
+        -1, 1, (n, 3)).astype(np.float32)
+
+
+def test_backpressure_full_queue_raises_not_blocks():
+    engine = _FakeEngine()
+    engine.gate.clear()                    # dispatcher hangs mid-flight
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=2))
+    first = batcher.submit(_pc(20), _pc(20))
+    time.sleep(0.2)                        # worker picks it up, blocks
+    batcher.submit(_pc(20, 1), _pc(20, 1))
+    batcher.submit(_pc(20, 2), _pc(20, 2))
+    t0 = time.monotonic()
+    with pytest.raises(QueueFullError):
+        batcher.submit(_pc(20, 3), _pc(20, 3))
+    # The whole point of explicit backpressure: the reject is immediate,
+    # not a blocked put under the queue lock.
+    assert time.monotonic() - t0 < 1.0
+    assert batcher.counts["rejected"] == 1
+    engine.gate.set()
+    assert first.wait(30).shape == (20, 3)
+    batcher.shutdown(drain=True)
+    assert batcher.counts["served"] == 3
+
+
+def test_shutdown_drains_in_flight():
+    engine = _FakeEngine()
+    engine.gate.clear()
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=2, max_wait_ms=1, queue_depth=16))
+    handles = [batcher.submit(_pc(20, i), _pc(20, i)) for i in range(6)]
+    done = threading.Event()
+
+    def stopper():
+        batcher.shutdown(drain=True)
+        done.set()
+
+    t = threading.Thread(target=stopper)
+    t.start()
+    time.sleep(0.2)
+    with pytest.raises(ShutdownError):     # intake closed immediately
+        batcher.submit(_pc(20, 99), _pc(20, 99))
+    assert not done.is_set()               # drain waits for the gate
+    engine.gate.set()
+    t.join(30)
+    assert done.is_set()
+    for h in handles:                      # every accepted request served
+        assert h.wait(1).shape == (20, 3)
+    assert batcher.counts["served"] == 6
+
+
+def test_shutdown_without_drain_fails_queued():
+    engine = _FakeEngine()
+    engine.gate.clear()                    # worker blocks on request 0
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=16))
+    handles = [batcher.submit(_pc(20, i), _pc(20, i)) for i in range(4)]
+    time.sleep(0.2)
+    # Stop WITHOUT drain while requests 1-3 are still queued; release the
+    # gate only after the stop flag is set, so the queued ones cannot be
+    # served in the window (the in-flight request 0 may still finish).
+    stopper = threading.Thread(
+        target=lambda: batcher.shutdown(drain=False))
+    stopper.start()
+    time.sleep(0.2)
+    engine.gate.set()
+    stopper.join(30)
+    assert not stopper.is_alive()
+    outcomes = []
+    for h in handles:
+        try:
+            h.wait(5)
+            outcomes.append("ok")
+        except ShutdownError:
+            outcomes.append("shutdown")
+    assert outcomes.count("shutdown") >= 3  # queued work failed, not served
+    assert outcomes.count("ok") <= 1        # at most the in-flight request
+    # Accepted-then-failed requests are accounted: every shutdown-failed
+    # handle shows up in the reject ledger, so served + rejected still
+    # covers all four accepted submits.
+    assert batcher.counts["rejected"] == outcomes.count("shutdown")
+    assert batcher.counts["served"] == outcomes.count("ok")
+
+
+def test_metrics_failure_accounting_reconciles():
+    """record_failure keeps the reconciliation identity for accepted
+    requests that never produce a response (504/500): requests_total ==
+    responses_total + sum(rejected) once nothing is in flight."""
+    m = ServeMetrics(buckets=(32,))
+    m.record_submit(32)                      # -> 200
+    m.record_submit(32)                      # -> 504
+    m.record_reject("bad_request")           # never accepted
+    m.record_batch(1, 0.5, [3.0])
+    m.record_failure("timeout")
+    snap = m.snapshot()
+    assert snap["requests_total"] == 3
+    assert snap["responses_total"] + sum(snap["rejected"].values()) == 3
+    assert snap["rejected"] == {"bad_request": 1, "timeout": 1}
+
+
+# ------------------------------------------------- HTTP smoke (CI gate) --
+
+
+def _http(method, host, port, path, body=None, ctype="application/json"):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    try:
+        headers = {"Content-Type": ctype} if body is not None else {}
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_http_smoke_one_request_per_bucket(served, tmp_path):
+    """The CI serve smoke: start on an ephemeral port, one padded
+    request per bucket, health + metrics, clean drain shutdown."""
+    engine, params, model = served
+    telemetry = ServeTelemetry(str(tmp_path / "serve.events.jsonl"),
+                               cfg=TINY_SERVE)
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=2, max_wait_ms=5, queue_depth=16),
+        telemetry=telemetry, metrics=metrics)
+    server = ServeHTTPServer(batcher, port=0, metrics=metrics)
+    server.start()
+    rng = np.random.default_rng(5)
+    try:
+        for n in (20, 48):                 # one per bucket (32, 64)
+            pc1, pc2 = _cloud(rng, n), _cloud(rng, n)
+            status, body = _http(
+                "POST", server.host, server.port, "/predict",
+                json.dumps({"pc1": pc1.tolist(), "pc2": pc2.tolist()}))
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["n"] == n
+            np.testing.assert_allclose(
+                np.asarray(doc["flow"], np.float32),
+                engine.predict(pc1, pc2), atol=1e-5, rtol=0)
+
+        # msgpack fast path mirrors the JSON answer.
+        import msgpack
+
+        pc1, pc2 = _cloud(rng, 24), _cloud(rng, 24)
+        status, body = _http(
+            "POST", server.host, server.port, "/predict",
+            msgpack.packb({"pc1": pc1.tobytes(), "pc2": pc2.tobytes()}),
+            ctype="application/msgpack")
+        assert status == 200
+        doc = msgpack.unpackb(body, raw=False)
+        flow = np.frombuffer(doc["flow"], np.float32).reshape(-1, 3)
+        np.testing.assert_allclose(flow, engine.predict(pc1, pc2),
+                                   atol=1e-5, rtol=0)
+
+        status, body = _http("GET", server.host, server.port, "/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["status"] == "ok"
+        assert health["buckets"] == [32, 64]
+        assert len(health["programs"]) == 2      # 2 buckets x 1 batch size
+        assert all(p["compile_s"] >= 0 for p in health["programs"])
+
+        status, body = _http("GET", server.host, server.port, "/metrics")
+        snap = json.loads(body)
+        assert status == 200
+        assert snap["responses_total"] == 3
+        assert snap["latency"]["count"] == 3
+
+        # Contract errors surface as status codes, not 500s.
+        status, _ = _http(
+            "POST", server.host, server.port, "/predict",
+            json.dumps({"pc1": [[0, 0, 0]] * 8, "pc2": [[0, 0, 0]] * 8}))
+        assert status == 400                     # too_small
+        status, _ = _http(
+            "POST", server.host, server.port, "/predict",
+            json.dumps({"pc1": [[0, 0, 0]] * 100, "pc2": [[0, 0, 0]] * 100}))
+        assert status == 413                     # too_large
+        status, _ = _http(
+            "POST", server.host, server.port, "/predict", "not json")
+        assert status == 400
+    finally:
+        server.shutdown(drain=True)
+        telemetry.close()
+
+    # The serve event log is schema-valid and complete: header, one
+    # compile-free run (engine was prebuilt), batches, rejects, shutdown.
+    from pvraft_tpu.obs.events import validate_events_file
+
+    path = str(tmp_path / "serve.events.jsonl")
+    assert validate_events_file(path) == []
+    types = [json.loads(line)["type"]
+             for line in open(path, encoding="utf-8")]
+    assert types[0] == "run_header"
+    assert "serve_batch" in types
+    assert "serve_reject" in types
+    assert types[-1] == "serve_shutdown"
+
+
+# ----------------------------------------------------- telemetry schema --
+
+
+def test_serve_compile_events(served, tmp_path):
+    """A telemetry-attached engine records every AOT program before the
+    first request (startup cost is in the ledger, not folklore). One
+    (bucket, batch) keeps this a single extra compile — the emission
+    path is the same for N."""
+    _, params, _ = served
+    path = str(tmp_path / "compile.events.jsonl")
+    one = ServeConfig(model=TINY_MODEL, buckets=(32,), batch_sizes=(1,),
+                      num_iters=ITERS)
+    telemetry = ServeTelemetry(path, cfg=one)
+    InferenceEngine(params, one, telemetry=telemetry)
+    telemetry.close()
+    from pvraft_tpu.obs.events import validate_events_file
+
+    assert validate_events_file(path) == []
+    recs = [json.loads(line) for line in open(path, encoding="utf-8")]
+    compiles = [r for r in recs if r["type"] == "serve_compile"]
+    assert {(r["bucket"], r["batch"]) for r in compiles} == {(32, 1)}
+    assert all(r["compile_s"] >= 0 for r in compiles)
+
+
+# ------------------------------------------------- load artifact schema --
+
+
+def _minimal_artifact():
+    return {
+        "schema": "pvraft_serve_load/v1",
+        "config": {},
+        "compile": [],
+        "requests": {"total": 4, "ok": 3, "rejected": 1, "errors": 0},
+        "latency_ms": {"p50": 10.0, "p95": 20.0, "p99": 30.0,
+                       "mean": 12.0, "max": 31.0},
+        "throughput_rps": 5.0,
+        "duration_s": 1.0,
+        "server_metrics": {},
+    }
+
+
+def test_load_artifact_validator():
+    from pvraft_tpu.serve.loadgen import validate_load_artifact
+
+    assert validate_load_artifact(_minimal_artifact()) == []
+    bad = _minimal_artifact()
+    bad["requests"]["ok"] = 99               # ok+rejected+errors != total
+    assert validate_load_artifact(bad)
+    bad = _minimal_artifact()
+    del bad["latency_ms"]
+    assert validate_load_artifact(bad)
+    bad = _minimal_artifact()
+    bad["latency_ms"]["p50"] = 99.0          # quantiles must be ordered
+    assert validate_load_artifact(bad)
+    bad = _minimal_artifact()
+    bad["schema"] = "pvraft_serve_load/v0"
+    assert validate_load_artifact(bad)
+
+
+def test_committed_load_artifact_validates():
+    """The committed CPU-synthetic evidence parses against both schemas
+    (same gate scripts/lint.sh runs)."""
+    import os
+
+    from pvraft_tpu.obs.events import validate_events_file
+    from pvraft_tpu.serve.loadgen import validate_load_artifact_file
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    art = os.path.join(root, "artifacts", "serve_cpu_synthetic.json")
+    events = os.path.join(root, "artifacts",
+                          "serve_cpu_synthetic.events.jsonl")
+    assert validate_load_artifact_file(art) == []
+    assert validate_events_file(events) == []
+
+
+# --------------------------------------- default-path jaxpr (convention) --
+
+
+def test_mask_off_jaxpr_identity():
+    """The mask plumbing is a Python-level branch: with masks absent the
+    SetConv jaxpr is byte-identical to a verbatim pre-mask replica
+    (repo convention: opt-in features leave the default path untouched)."""
+    import flax.linen as nn
+
+    from pvraft_tpu.analysis.jaxpr.rules import normalize_jaxpr_str
+    from pvraft_tpu.models.layers import SetConv
+    from pvraft_tpu.ops.geometry import Graph, build_graph, gather_neighbors
+
+    class OldSetConv(nn.Module):
+        """Pre-PR SetConv body, replicated verbatim (mask-free)."""
+
+        out_ch: int
+
+        @nn.compact
+        def __call__(self, x, graph):
+            b, n, c = x.shape
+            mid = (self.out_ch + c) // 2 if c % 2 == 0 else self.out_ch // 2
+            nb = gather_neighbors(x, graph.neighbors)
+            edge = nb - x[:, :, None, :]
+            h = jnp.concatenate(
+                [edge, graph.rel_pos.astype(x.dtype)], axis=-1)
+            h = nn.Dense(mid, use_bias=False, name="fc1")(h)
+            h = nn.GroupNorm(num_groups=8, epsilon=1e-5, name="gn1")(h)
+            h = jax.nn.leaky_relu(h, 0.1)
+            h = jnp.max(h, axis=2)
+            h = nn.Dense(self.out_ch, use_bias=False, name="fc2")(h)
+            h = nn.GroupNorm(num_groups=8, epsilon=1e-5, name="gn2")(h)
+            h = jax.nn.leaky_relu(h, 0.1)
+            h = nn.Dense(self.out_ch, use_bias=False, name="fc3")(h)
+            h = nn.GroupNorm(num_groups=8, epsilon=1e-5, name="gn3")(h)
+            h = jax.nn.leaky_relu(h, 0.1)
+            return h
+
+    rng = np.random.default_rng(0)
+    pc = jnp.asarray(rng.uniform(-1, 1, (2, 24, 3)).astype(np.float32))
+    graph = build_graph(pc, 4)
+
+    def jaxpr_of(module):
+        params = module.init(jax.random.key(0), pc, graph)
+        return normalize_jaxpr_str(str(jax.make_jaxpr(
+            lambda p, x: module.apply(p, x, graph))(params, pc)))
+
+    assert jaxpr_of(SetConv(16)) == jaxpr_of(OldSetConv(16))
