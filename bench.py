@@ -48,17 +48,15 @@ PROBE_TIMEOUT_S = float(os.environ.get("PVRAFT_BENCH_PROBE_TIMEOUT_S", 240))
 # the TPU claim, so variant children get a generous window.
 VARIANT_TIMEOUT_S = float(os.environ.get("PVRAFT_BENCH_VARIANT_TIMEOUT_S", 1200))
 
-# use_pallas pinned explicitly per variant (the config's None-auto default
-# would silently turn Pallas on for every TPU variant, making the fallback
-# ladder meaningless).
-VARIANTS = [
-    ("bf16+pallas+approx", dict(compute_dtype="bfloat16", use_pallas=True,
-                                approx_topk=True)),
-    ("bf16+approx", dict(compute_dtype="bfloat16", use_pallas=False,
-                         approx_topk=True)),
-    ("bf16", dict(compute_dtype="bfloat16", use_pallas=False)),
-    ("fp32", dict(use_pallas=False)),
-]
+# Variant ladder and A/B lever enumeration come from the program
+# registry's data module (pvraft_tpu/programs/geometries.py — pure data,
+# no jax import, so the parent process stays jax-free). The registry
+# also AOT-certifies the flagship subset of these same dicts
+# (programs/catalog.py), so the ladder bench measures and the programs
+# the readiness sweep compiles cannot drift apart.
+from pvraft_tpu.programs.geometries import AB_LEVERS, BENCH_VARIANTS
+
+VARIANTS = list(BENCH_VARIANTS)
 
 
 def _unit(points: int = N_POINTS, iters: int = ITERS,
@@ -100,18 +98,28 @@ def _child_variant(name: str) -> None:
     # Backward-path A/B levers (PR "scatter-free VJPs + remat policy"):
     # opt-in env flags so the same variant ladder can be re-measured with
     # the optimized backward and the pair recorded side by side
-    # (BENCHMARKS.md "Backward-path A/B").
+    # (BENCHMARKS.md "Backward-path A/B"). The lever records — env var,
+    # target field, arming rule — are registry declarations (AB_LEVERS);
+    # "flag" levers arm on the literal "1", "str" levers on any
+    # non-empty value, and "step_arg" levers feed the step factory
+    # (grad_dtype) instead of ModelConfig.
     ab_flags = {}
-    if os.environ.get("PVRAFT_BENCH_SCATTER_FREE", "") == "1":
-        kwargs = dict(kwargs, scatter_free_vjp=True)
-        ab_flags["scatter_free_vjp"] = True
-    remat_policy = os.environ.get("PVRAFT_BENCH_REMAT_POLICY", "")
-    if remat_policy:
-        kwargs = dict(kwargs, remat_policy=remat_policy)
-        ab_flags["remat_policy"] = remat_policy
-    grad_dtype = os.environ.get("PVRAFT_BENCH_GRAD_DTYPE", "") or None
-    if grad_dtype:
-        ab_flags["grad_dtype"] = grad_dtype
+    grad_dtype = None
+    for lever in AB_LEVERS:
+        raw = os.environ.get(lever["env"], "")
+        if lever["kind"] == "flag":
+            if raw != "1":
+                continue
+            val = True
+        else:
+            if not raw:
+                continue
+            val = raw
+        ab_flags[lever["field"]] = val
+        if lever.get("step_arg"):
+            grad_dtype = val
+        else:
+            kwargs = dict(kwargs, **{lever["field"]: val})
 
     import numpy as np
 

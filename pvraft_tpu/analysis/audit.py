@@ -39,6 +39,12 @@ class AuditResult:
 class AuditEntry:
     """One registered op: the thunk plus the metadata deepcheck reads.
 
+    Since the program-registry refactor this is a *view* over a
+    :class:`pvraft_tpu.programs.spec.ProgramSpec` tagged ``"audit"`` —
+    ``audit_entry`` registers a spec, and :func:`entries` projects the
+    audit-tagged slice of the registry back into these records, so the
+    deepcheck corpus and the program inventory can never diverge.
+
     ``precision`` declares the entry's dtype intent for rule GJ006
     (``"f32"``: no 16-bit floats anywhere; ``"bf16_grads"``: the
     grad-cast lever must actually appear and not leak; ``"any"``: opt
@@ -54,31 +60,47 @@ class AuditEntry:
     line: int = 0
 
 
-_ENTRIES: Dict[str, AuditEntry] = {}
+AUDIT_TAG = "audit"
 
 
 def audit_entry(name: str, precision: str = "f32",
-                spmd_group: Optional[str] = None):
+                spmd_group: Optional[str] = None,
+                tags: Tuple[str, ...] = ()):
+    """Register one audit entry as an ``"audit"``-tagged ProgramSpec.
+
+    Extra ``tags`` classify the entry in the program inventory
+    (``python -m pvraft_tpu.programs list``): "op", "model", "train",
+    "eval", "serve", "parallel", ... Duplicate names raise (the
+    registry enforces declare-exactly-once)."""
+    from pvraft_tpu.programs.spec import ProgramSpec, register_spec
+
     def deco(thunk):
-        if name in _ENTRIES:
-            raise ValueError(f"duplicate audit entry {name}")
         code = getattr(thunk, "__code__", None)
-        _ENTRIES[name] = AuditEntry(
+        register_spec(ProgramSpec(
             name=name,
             thunk=thunk,
+            tags=(AUDIT_TAG,) + tuple(tags),
             precision=precision,
             spmd_group=spmd_group,
             path=getattr(code, "co_filename", "") or "",
             line=getattr(code, "co_firstlineno", 0) or 0,
-        )
+        ))
         return thunk
 
     return deco
 
 
 def entries() -> Dict[str, AuditEntry]:
-    """The registry — deepcheck's corpus (copy; mutation-safe)."""
-    return dict(_ENTRIES)
+    """Deepcheck's corpus: the ``"audit"`` slice of the program
+    registry, projected into AuditEntry views (copy; mutation-safe)."""
+    from pvraft_tpu.programs.spec import by_tag
+
+    return {
+        s.name: AuditEntry(name=s.name, thunk=s.thunk,
+                           precision=s.precision, spmd_group=s.spmd_group,
+                           path=s.path, line=s.line)
+        for s in by_tag(AUDIT_TAG)
+    }
 
 
 def _f32(*shape):
@@ -101,21 +123,21 @@ def _bool(*shape):
 
 # --- ops/geometry ---------------------------------------------------------
 
-@audit_entry("geometry.pairwise_sqdist")
+@audit_entry("geometry.pairwise_sqdist", tags=("op",))
 def _e_pairwise():
     from pvraft_tpu.ops.geometry import pairwise_sqdist
 
     return pairwise_sqdist, (_f32(B, N, 3), _f32(B, M, 3))
 
 
-@audit_entry("geometry.knn_indices")
+@audit_entry("geometry.knn_indices", tags=("op",))
 def _e_knn():
     from pvraft_tpu.ops.geometry import knn_indices
 
     return lambda q, p: knn_indices(q, p, K), (_f32(B, N, 3), _f32(B, M, 3))
 
 
-@audit_entry("geometry.knn_indices[chunked]")
+@audit_entry("geometry.knn_indices[chunked]", tags=("op",))
 def _e_knn_chunked():
     from pvraft_tpu.ops.geometry import knn_indices
 
@@ -125,14 +147,14 @@ def _e_knn_chunked():
     )
 
 
-@audit_entry("geometry.gather_neighbors")
+@audit_entry("geometry.gather_neighbors", tags=("op",))
 def _e_gather():
     from pvraft_tpu.ops.geometry import gather_neighbors
 
     return gather_neighbors, (_f32(B, M, D), _i32(B, N, K))
 
 
-@audit_entry("geometry.build_graph")
+@audit_entry("geometry.build_graph", tags=("op",))
 def _e_graph():
     from pvraft_tpu.ops.geometry import build_graph
 
@@ -141,14 +163,14 @@ def _e_graph():
 
 # --- ops/corr -------------------------------------------------------------
 
-@audit_entry("corr.corr_volume")
+@audit_entry("corr.corr_volume", tags=("op",))
 def _e_corr_volume():
     from pvraft_tpu.ops.corr import corr_volume
 
     return corr_volume, (_f32(B, N, D), _f32(B, M, D))
 
 
-@audit_entry("corr.corr_init")
+@audit_entry("corr.corr_init", tags=("op",))
 def _e_corr_init():
     from pvraft_tpu.ops.corr import corr_init
 
@@ -158,7 +180,7 @@ def _e_corr_init():
     )
 
 
-@audit_entry("corr.corr_init[chunked]")
+@audit_entry("corr.corr_init[chunked]", tags=("op",))
 def _e_corr_init_chunked():
     from pvraft_tpu.ops.corr import corr_init
 
@@ -168,7 +190,7 @@ def _e_corr_init_chunked():
     )
 
 
-@audit_entry("corr.knn_lookup")
+@audit_entry("corr.knn_lookup", tags=("op",))
 def _e_knn_lookup():
     from pvraft_tpu.ops.corr import CorrState, knn_lookup
 
@@ -181,7 +203,7 @@ def _e_knn_lookup():
 
 # --- ops/scatter_free (the custom VJPs must TRACE through grad) -----------
 
-@audit_entry("scatter_free.gather_neighbors_onehot[grad]")
+@audit_entry("scatter_free.gather_neighbors_onehot[grad]", tags=("op", "grad"))
 def _e_sf_gather():
     import jax
 
@@ -193,7 +215,7 @@ def _e_sf_gather():
     return fn, (_f32(B, M, D), _i32(B, N, K))
 
 
-@audit_entry("scatter_free.take_pair_onehot[grad]")
+@audit_entry("scatter_free.take_pair_onehot[grad]", tags=("op", "grad"))
 def _e_sf_take_pair():
     import jax
 
@@ -209,7 +231,7 @@ def _e_sf_take_pair():
     return fn, (_f32(B, N, K), _f32(B, N, K, 3), _i32(B, N, K // 2))
 
 
-@audit_entry("scatter_free.max_pool_argmax[grad]")
+@audit_entry("scatter_free.max_pool_argmax[grad]", tags=("op", "grad"))
 def _e_sf_max_pool():
     import jax
 
@@ -223,7 +245,7 @@ def _e_sf_max_pool():
 
 # --- ops/voxel + Pallas kernels ------------------------------------------
 
-@audit_entry("voxel.voxel_bin_means")
+@audit_entry("voxel.voxel_bin_means", tags=("op",))
 def _e_voxel():
     from pvraft_tpu.ops.voxel import voxel_bin_means
 
@@ -233,7 +255,7 @@ def _e_voxel():
     )
 
 
-@audit_entry("pallas.voxel_bin_means_pallas")
+@audit_entry("pallas.voxel_bin_means_pallas", tags=("op", "pallas"))
 def _e_voxel_pallas():
     from pvraft_tpu.ops.pallas.voxel_corr import voxel_bin_means_pallas
 
@@ -243,7 +265,7 @@ def _e_voxel_pallas():
     )
 
 
-@audit_entry("pallas.fused_corr_lookup")
+@audit_entry("pallas.fused_corr_lookup", tags=("op", "pallas"))
 def _e_fused():
     from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
 
@@ -264,7 +286,7 @@ def _ring_seq() -> int:
     return 2 if jax.device_count() >= 2 else 1
 
 
-@audit_entry("ring.ring_corr_init")
+@audit_entry("ring.ring_corr_init", tags=("parallel",))
 def _e_ring():
     from jax.sharding import PartitionSpec as P
 
@@ -289,7 +311,7 @@ def _e_ring():
     return fn, (_f32(B, N, D), _f32(B, M, D), _f32(B, M, 3))
 
 
-@audit_entry("ring.ring_knn_indices")
+@audit_entry("ring.ring_knn_indices", tags=("parallel",))
 def _e_ring_knn():
     from jax.sharding import PartitionSpec as P
 
@@ -333,17 +355,17 @@ def _model_entry(refine: bool, **cfg_kwargs):
     return fn, (_f32(B, N, 3), _f32(B, M, 3))
 
 
-@audit_entry("models.PVRaft")
+@audit_entry("models.PVRaft", tags=("model",))
 def _e_pvraft():
     return _model_entry(refine=False)
 
 
-@audit_entry("models.PVRaftRefine")
+@audit_entry("models.PVRaftRefine", tags=("model",))
 def _e_refine():
     return _model_entry(refine=True)
 
 
-@audit_entry("models.PVRaft[scatter_free+save_corr]")
+@audit_entry("models.PVRaft[scatter_free+save_corr]", tags=("model",))
 def _e_pvraft_opt():
     # The optimized backward path end to end: scatter-free VJPs +
     # checkpoint_name-tagged corr under the save_corr remat policy.
@@ -353,7 +375,8 @@ def _e_pvraft_opt():
 
 # --- engine (the jitted train step, end to end) ---------------------------
 
-@audit_entry("engine.train_step", spmd_group="train-step")
+@audit_entry("engine.train_step", spmd_group="train-step",
+             tags=("train",))
 def _e_train_step():
     import jax
     import optax
@@ -377,33 +400,40 @@ def _e_train_step():
 
 
 @audit_entry("engine.train_step[optimized_backward]",
-             precision="bf16_grads", spmd_group="train-step")
+             precision="bf16_grads", spmd_group="train-step",
+             tags=("train", "ab"))
 def _e_train_step_opt():
     # Full optimized train step: scatter-free VJPs, dots remat policy,
-    # bf16 gradient cast — the bench A/B configuration, traced end to end.
+    # bf16 gradient cast — the bench A/B configuration, traced end to
+    # end. The lever values come from the registry's single declaration
+    # (programs/geometries.AB_PRIMARY), so the variant bench.py measures
+    # and the variant deepcheck walks cannot drift apart.
     import jax
     import optax
 
     from pvraft_tpu.config import ModelConfig
     from pvraft_tpu.engine.steps import make_train_step
     from pvraft_tpu.models.raft import PVRaft
+    from pvraft_tpu.programs.geometries import AB_PRIMARY
 
-    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2,
-                      scatter_free_vjp=True, remat_policy="dots")
+    ab = dict(AB_PRIMARY)
+    grad_dtype = ab.pop("grad_dtype")
+    cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2, **ab)
     model = PVRaft(cfg)
     tx = optax.sgd(1e-2)
 
     def fn(pc1, pc2, mask, gt):
         params = model.init(jax.random.key(0), pc1, pc2, 3)
         opt_state = tx.init(params)
-        step = make_train_step(model, tx, 0.8, 3, grad_dtype="bfloat16")
+        step = make_train_step(model, tx, 0.8, 3, grad_dtype=grad_dtype)
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
         return step(params, opt_state, batch)
 
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
-@audit_entry("engine.train_step[telemetry]", spmd_group="train-step")
+@audit_entry("engine.train_step[telemetry]", spmd_group="train-step",
+             tags=("train",))
 def _e_train_step_telemetry():
     # The telemetry-armed step traces end to end: the in-jit monitors
     # (obs/monitors.py) ride back as an extra metrics leaf.
@@ -428,7 +458,7 @@ def _e_train_step_telemetry():
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
-@audit_entry("engine.refine_train_step")
+@audit_entry("engine.refine_train_step", tags=("train",))
 def _e_refine_train_step():
     # Stage-2 step variant: frozen backbone, masked-L1 on the single
     # refined flow. In the corpus so deepcheck's donation and precision
@@ -454,7 +484,7 @@ def _e_refine_train_step():
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
-@audit_entry("engine.eval_step")
+@audit_entry("engine.eval_step", tags=("eval",))
 def _e_eval_step():
     # The jitted eval step (no donation by design: params are reused
     # across every val batch) — deepcheck verifies exactly that.
@@ -476,7 +506,7 @@ def _e_eval_step():
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
-@audit_entry("engine.eval_step[refine]")
+@audit_entry("engine.eval_step[refine]", tags=("eval",))
 def _e_eval_step_refine():
     import jax
 
@@ -523,12 +553,12 @@ def _serve_predict_entry(**model_kwargs):
     return fn, (_f32(B, N, 3), _f32(B, N, 3), _bool(B, N), _bool(B, N))
 
 
-@audit_entry("serve.predict")
+@audit_entry("serve.predict", tags=("serve",))
 def _e_serve_predict():
     return _serve_predict_entry()
 
 
-@audit_entry("serve.predict[bf16]", precision="any")
+@audit_entry("serve.predict[bf16]", precision="any", tags=("serve",))
 def _e_serve_predict_bf16():
     # bf16 matmul compute is the serve fast path's POINT, not drift, and
     # there is no gradient cast to declare (inference-only program) —
@@ -536,7 +566,7 @@ def _e_serve_predict_bf16():
     return _serve_predict_entry(compute_dtype="bfloat16")
 
 
-@audit_entry("engine.train_step[telemetry_off_jaxpr]")
+@audit_entry("engine.train_step[telemetry_off_jaxpr]", tags=("train", "guarantee"))
 def _e_train_step_telemetry_off_jaxpr():
     # Guarantee audit (GL009's dynamic twin): with telemetry OFF the
     # train-step jaxpr is byte-identical to the pre-telemetry step body,
@@ -602,10 +632,11 @@ def run_audit(verbose: bool = False) -> List[AuditResult]:
     ``AuditResult(ok=False)`` so one broken op can't hide the rest."""
     import jax
 
+    corpus = entries()
     results: List[AuditResult] = []
-    for name in sorted(_ENTRIES):
+    for name in sorted(corpus):
         try:
-            fn, args = _ENTRIES[name].thunk()
+            fn, args = corpus[name].thunk()
             out = jax.eval_shape(fn, *args)
             shapes = jax.tree_util.tree_map(
                 lambda s: tuple(s.shape), out

@@ -12,6 +12,8 @@ from pvraft_tpu.profiling.step_profiler import (  # noqa: F401
     MEASUREMENTS,
     SCHEMA_VERSION,
     derive_breakdown,
+    ladder_programs,
+    make_encoder,
     profile_step,
     validate_step_profile,
 )

@@ -39,8 +39,15 @@ from typing import Callable, Dict, List, Optional
 
 SCHEMA_VERSION = "pvraft_step_profile/v1"
 
-# Cumulative host-synced programs, in ladder order.
-MEASUREMENTS = ("encoder", "corr_cum", "fwd1", "fwdN", "fwdbwd", "step")
+# Cumulative host-synced programs, in ladder order. The tuple is THE
+# step-anatomy enumeration and lives in the registry's pure-data module:
+# ``ladder_programs`` builds the measured programs in this order, and
+# ``pvraft_tpu/programs/catalog.py`` registers one ``profile.<stage>``
+# ProgramSpec per entry (without importing this jax-heavy module) so the
+# registry's verify gate traces the same ladder the profiler times.
+from pvraft_tpu.programs.geometries import PROFILE_LADDER_STAGES
+
+MEASUREMENTS = PROFILE_LADDER_STAGES
 
 # Derived per-stage breakdown; telescopes to measurements["step"]["sec"].
 BREAKDOWN_STAGES = ("encoder", "corr_init", "gru_forward", "backward",
@@ -114,64 +121,37 @@ def validate_step_profile(record: dict, rel_tol: float = 0.02) -> List[str]:
     return problems
 
 
-def profile_step(
-    cfg,
-    points: int = 8192,
-    batch: int = 2,
-    iters: int = 8,
-    reps: int = 2,
-    gamma: float = 0.8,
-    lr: float = 1e-3,
-    grad_dtype: Optional[str] = None,
-    variant: str = "custom",
-    log: Optional[Callable[[str], None]] = None,
-) -> dict:
-    """Profile the flagship train step stage by stage; return the record.
+def make_encoder(cfg):
+    """The standalone PointEncoder exactly as the profiled model embeds
+    it (one definition for profile_step AND the registry's profile.*
+    specs, so the ladder's encoder stage cannot drift from the model's)."""
+    from pvraft_tpu.config import compute_dtype
+    from pvraft_tpu.models.encoder import PointEncoder
 
-    ``cfg`` is a :class:`~pvraft_tpu.config.ModelConfig`; every knob that
-    changes the step's content (scatter_free_vjp, remat_policy,
-    compute_dtype, use_pallas, approx_topk, ...) is honored, so A/B runs
-    are one config swap apart. ``grad_dtype`` mirrors
-    ``TrainConfig.grad_dtype`` through the same ``engine/steps`` cast.
-    """
-    import numpy as np
+    return PointEncoder(cfg.encoder_width, cfg.graph_k,
+                        dtype=compute_dtype(cfg),
+                        graph_chunk=cfg.graph_chunk,
+                        graph_approx=cfg.approx_knn,
+                        dense_vjp=cfg.scatter_free_vjp)
 
+
+def ladder_programs(cfg, model, enc, params, enc_params, tx, opt_state,
+                    pc1, pc2, mask, gt, iters, gamma=0.8, grad_dtype=None):
+    """The cumulative program ladder, as ``(name, fn)`` pairs in
+    ``MEASUREMENTS`` order — the single enumeration of the step's
+    anatomy. ``profile_step`` times these; ``programs/catalog.py``
+    registers each stage as a ``profile.*`` ProgramSpec so the registry
+    inventory and the profiler can never enumerate different programs.
+    Each ``fn(eps)`` perturbs its inputs by ``eps`` (fresh values defeat
+    result memoization) and returns a scalar whose host fetch is the
+    sync."""
     import jax
     import jax.numpy as jnp
     import optax
 
-    from pvraft_tpu.config import compute_dtype
     from pvraft_tpu.engine.loss import sequence_loss
     from pvraft_tpu.engine.steps import maybe_cast_grads
-    from pvraft_tpu.models import PVRaft
-    from pvraft_tpu.models.encoder import PointEncoder
     from pvraft_tpu.ops.corr import corr_init
-
-    say = log or (lambda msg: None)
-    model = PVRaft(cfg)
-    platform = jax.devices()[0].platform
-
-    rng = np.random.default_rng(0)
-    pc1 = jnp.asarray(
-        rng.uniform(-1, 1, (batch, points, 3)).astype(np.float32))
-    pc2 = jnp.asarray(
-        rng.uniform(-1, 1, (batch, points, 3)).astype(np.float32))
-    mask = jnp.ones((batch, points), jnp.float32)
-    gt = pc2 - pc1
-    # Init on a small cloud (params are point-count independent) — but it
-    # must still hold >= truncate_k candidate points for corr_init.
-    n_init = min(points, max(256, cfg.truncate_k))
-    params = model.init(
-        jax.random.key(0), pc1[:, :n_init], pc2[:, :n_init], 2)
-    tx = optax.adam(lr)
-    opt_state = tx.init(params)
-
-    enc = PointEncoder(cfg.encoder_width, cfg.graph_k,
-                       dtype=compute_dtype(cfg),
-                       graph_chunk=cfg.graph_chunk,
-                       graph_approx=cfg.approx_knn,
-                       dense_vjp=cfg.scatter_free_vjp)
-    enc_params = enc.init(jax.random.key(1), pc1[:, :n_init])
 
     @jax.jit
     def f_encoder(eps):
@@ -215,14 +195,79 @@ def profile_step(
                    for q in jax.tree_util.tree_leaves(new_params))
         return loss + 0.0 * psum
 
-    programs = [
-        ("encoder", f_encoder),
-        ("corr_cum", f_corr_cum),
-        ("fwd1", fwd(1)),
-        ("fwdN", fwd(iters)),
-        ("fwdbwd", f_fwdbwd),
-        ("step", f_step),
-    ]
+    builders = {
+        "encoder": f_encoder,
+        "corr_cum": f_corr_cum,
+        "fwd1": fwd(1),
+        "fwdN": fwd(iters),
+        "fwdbwd": f_fwdbwd,
+        "step": f_step,
+    }
+    # Order (and membership) comes from the declared enumeration: a
+    # stage added to PROFILE_LADDER_STAGES without a builder here — or
+    # a builder no stage names — fails loudly instead of silently
+    # desynchronizing the profiler from the registry's profile.* specs.
+    if set(builders) != set(MEASUREMENTS):
+        raise ValueError(
+            f"ladder builders {sorted(builders)} != declared stages "
+            f"{sorted(MEASUREMENTS)} (update geometries."
+            f"PROFILE_LADDER_STAGES and ladder_programs together)")
+    return [(name, builders[name]) for name in MEASUREMENTS]
+
+
+def profile_step(
+    cfg,
+    points: int = 8192,
+    batch: int = 2,
+    iters: int = 8,
+    reps: int = 2,
+    gamma: float = 0.8,
+    lr: float = 1e-3,
+    grad_dtype: Optional[str] = None,
+    variant: str = "custom",
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Profile the flagship train step stage by stage; return the record.
+
+    ``cfg`` is a :class:`~pvraft_tpu.config.ModelConfig`; every knob that
+    changes the step's content (scatter_free_vjp, remat_policy,
+    compute_dtype, use_pallas, approx_topk, ...) is honored, so A/B runs
+    are one config swap apart. ``grad_dtype`` mirrors
+    ``TrainConfig.grad_dtype`` through the same ``engine/steps`` cast.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pvraft_tpu.models import PVRaft
+
+    say = log or (lambda msg: None)
+    model = PVRaft(cfg)
+    platform = jax.devices()[0].platform
+
+    rng = np.random.default_rng(0)
+    pc1 = jnp.asarray(
+        rng.uniform(-1, 1, (batch, points, 3)).astype(np.float32))
+    pc2 = jnp.asarray(
+        rng.uniform(-1, 1, (batch, points, 3)).astype(np.float32))
+    mask = jnp.ones((batch, points), jnp.float32)
+    gt = pc2 - pc1
+    # Init on a small cloud (params are point-count independent) — but it
+    # must still hold >= truncate_k candidate points for corr_init.
+    n_init = min(points, max(256, cfg.truncate_k))
+    params = model.init(
+        jax.random.key(0), pc1[:, :n_init], pc2[:, :n_init], 2)
+    tx = optax.adam(lr)
+    opt_state = tx.init(params)
+
+    enc = make_encoder(cfg)
+    enc_params = enc.init(jax.random.key(1), pc1[:, :n_init])
+
+    programs = ladder_programs(
+        cfg, model, enc, params, enc_params, tx, opt_state,
+        pc1, pc2, mask, gt, iters, gamma=gamma, grad_dtype=grad_dtype)
 
     eps_counter = [0.0]
 

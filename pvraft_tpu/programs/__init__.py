@@ -1,0 +1,42 @@
+"""Program registry: what programs exist, at what shapes — queryable.
+
+``pvraft_tpu/programs`` is the single place a jitted or AOT entry point
+is declared (:class:`~pvraft_tpu.programs.spec.ProgramSpec`): its
+abstract arg geometry, precision intent, donation/aliasing, sharding
+group and tags. The trace audit + deepcheck corpus
+(``analysis/audit.py``), the serve engine's bucket-program table,
+``scripts/aot_readiness.py``, the step profiler's ladder and bench.py's
+variant/A-B enumeration all iterate these records instead of hand-rolled
+lists — registering one new spec buys audit + deepcheck + AOT compile
+evidence + profiling for free.
+
+CLI::
+
+    python -m pvraft_tpu.programs list               # the inventory
+    python -m pvraft_tpu.programs describe NAME      # geometry detail
+    python -m pvraft_tpu.programs verify             # eval_shape all specs
+    python -m pvraft_tpu.programs compile --tag kernel   # Mosaic gate
+
+This module (and :mod:`~pvraft_tpu.programs.spec` /
+:mod:`~pvraft_tpu.programs.geometries`) imports no jax: CLIs read the
+registry's data before pinning a backend.
+"""
+
+from pvraft_tpu.programs import geometries                  # noqa: F401
+from pvraft_tpu.programs.spec import (                      # noqa: F401
+    DuplicateProgramError,
+    ProgramSpec,
+    by_tag,
+    get,
+    register,
+    register_spec,
+    specs,
+)
+
+
+def load_catalog() -> None:
+    """Populate the registry: the audit corpus (``analysis/audit.py``)
+    plus the AOT catalog (``programs/catalog.py``). Idempotent — module
+    imports register once."""
+    import pvraft_tpu.analysis.audit      # noqa: F401
+    import pvraft_tpu.programs.catalog    # noqa: F401

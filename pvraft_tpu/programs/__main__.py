@@ -1,0 +1,223 @@
+"""CLI: ``python -m pvraft_tpu.programs {list,describe,verify,compile}``.
+
+``list`` renders the program inventory (no tracing — safe anywhere,
+golden-pinned by ``tests/test_programs.py`` against the committed
+``artifacts/programs_list.txt``). ``describe`` builds one spec and
+shows its abstract arg/out geometry. ``verify`` eval_shapes EVERY
+registered spec — the registry-wide superset of the old
+``analysis trace`` audit (which it subsumes in ``scripts/lint.sh``).
+``compile`` runs the deviceless topology compile gate over tag-selected
+specs; ``--tag kernel`` lowers every Pallas entry point through the
+real Mosaic pipeline so toolchain drift fails the gate loudly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+
+
+def _selected(args):
+    from pvraft_tpu.programs import load_catalog, specs
+
+    load_catalog()
+    out = list(specs().values())
+    for tag in getattr(args, "tag", None) or ():
+        out = [s for s in out if tag in s.tags]
+    only = getattr(args, "only", None) or ()
+    if only:
+        out = [s for s in out if any(sub in s.name for sub in only)]
+    return out
+
+
+def _cmd_list(args) -> int:
+    sel = _selected(args)
+    header = (f"{'name':<46} {'tags':<18} {'precision':<10} "
+              f"{'donate':<7} {'spmd_group':<12} topology")
+    print(header)
+    print("-" * len(header))
+    for s in sorted(sel, key=lambda s: s.name):
+        donate = ",".join(map(str, s.donate_argnums)) or "-"
+        print(f"{s.name:<46} {','.join(s.tags):<18} {s.precision:<10} "
+              f"{donate:<7} {s.spmd_group or '-':<12} {s.topology or '-'}")
+    n_audit = sum(1 for s in sel if "audit" in s.tags)
+    n_aot = sum(1 for s in sel if s.topology)
+    print(f"programs: {len(sel)} spec(s) — {n_audit} audit-corpus, "
+          f"{n_aot} AOT-certified", file=sys.stderr)
+    return 0
+
+
+def _render_tree(tree, max_len: int = 400) -> str:
+    import jax
+
+    rendered = jax.tree_util.tree_map(
+        lambda s: f"{getattr(s, 'dtype', '?')}{tuple(s.shape)}"
+        if hasattr(s, "shape") else repr(s), tree)
+    text = f"{rendered}"
+    if len(text) > max_len:
+        leaves = jax.tree_util.tree_leaves(rendered)
+        return f"<pytree of {len(leaves)} arrays>"
+    return text
+
+
+def _cmd_describe(args) -> int:
+    from pvraft_tpu.programs import get, load_catalog
+
+    load_catalog()
+    try:
+        s = get(args.name)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    print(f"name:        {s.name}")
+    print(f"tags:        {','.join(s.tags)}")
+    print(f"precision:   {s.precision}")
+    print(f"spmd_group:  {s.spmd_group or '-'}")
+    print(f"donate:      {','.join(map(str, s.donate_argnums)) or '-'}")
+    print(f"topology:    {s.topology or '-'}"
+          + (f" (x{s.n_devices} devices)" if s.n_devices > 1 else ""))
+    if s.expect_failure:
+        print(f"expects:     {s.expect_failure}")
+    if s.description:
+        print(f"about:       {s.description}")
+    print(f"declared:    {s.path}:{s.line}")
+    import jax
+
+    fn, built_args = s.build()
+    print(f"args:        {_render_tree(built_args)}")
+    out = jax.eval_shape(fn, *built_args)
+    print(f"out:         {_render_tree(out)}")
+    return 0
+
+
+def _cmd_verify(args) -> int:
+    """eval_shape every selected spec — zero FLOPs, CPU-safe; any trace
+    failure (shape drift, concretization, a broken thunk) exits 1."""
+    import jax
+
+    sel = _selected(args)
+    bad = 0
+    for s in sorted(sel, key=lambda s: s.name):
+        try:
+            fn, built_args = s.build()
+            out = jax.eval_shape(fn, *built_args)
+            print(f"[PASS] {s.name}: {_render_tree(out, max_len=160)}")
+        except Exception as e:  # noqa: BLE001 — report every spec
+            bad += 1
+            last = traceback.format_exception_only(type(e), e)[-1].strip()
+            print(f"[FAIL] {s.name}: {last[:500]}")
+    print(f"programs verify: {len(sel) - bad}/{len(sel)} spec(s) trace "
+          "clean", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def _cmd_compile(args) -> int:
+    from pvraft_tpu.programs.compile import (
+        ToolchainUnavailable,
+        pin_cpu_host,
+        run_compile,
+        topology_devices,
+    )
+
+    pin_cpu_host()
+    sel = [s for s in _selected(args) if s.topology]
+    if not sel:
+        print("no topology-declared specs match the selection",
+              file=sys.stderr)
+        return 2
+    try:
+        devs = topology_devices(args.topology)
+    except ToolchainUnavailable as e:
+        print(f"programs compile: {e}", file=sys.stderr)
+        if args.allow_missing_toolchain and e.libtpu_missing:
+            print("programs compile: SKIPPED (no libtpu installed on this "
+                  "host; the gate runs where the compile toolchain is "
+                  "present)", file=sys.stderr)
+            return 0
+        if args.allow_missing_toolchain:
+            # libtpu IS installed but topology construction failed — that
+            # is the toolchain breakage this gate exists to catch; a
+            # skip here would let Mosaic drift rot green.
+            print("programs compile: libtpu is installed but the topology "
+                  "failed to build — failing (not skipping)",
+                  file=sys.stderr)
+        return 1
+    try:
+        rec = run_compile(sel, topology=args.topology,
+                          cache_dir=args.cache_dir, devices=devs,
+                          allow_mismatch=args.force_topology)
+    except ValueError as e:
+        # Declared-topology mismatch: a caller error, reported cleanly
+        # (the specs are certified for their declared slice; compiling
+        # them elsewhere needs the explicit --force-topology opt-in).
+        print(f"programs compile: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        import os
+
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=2)
+    print(json.dumps({"ok": rec["ok"], "total_s": rec["total_s"],
+                      "programs": [(r["name"], r["ok"])
+                                   for r in rec["programs"]]}))
+    return 0 if rec["ok"] else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m pvraft_tpu.programs",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    def _common(p):
+        p.add_argument("--tag", action="append", default=[],
+                       help="keep specs carrying TAG (repeatable, ANDed)")
+        p.add_argument("--only", action="append", default=[],
+                       metavar="SUBSTR",
+                       help="keep specs whose name contains SUBSTR "
+                            "(repeatable, ORed)")
+
+    p_list = sub.add_parser("list", help="render the program inventory")
+    _common(p_list)
+    p_list.set_defaults(fn=_cmd_list)
+
+    p_desc = sub.add_parser("describe", help="one spec's geometry detail")
+    p_desc.add_argument("name")
+    p_desc.set_defaults(fn=_cmd_describe)
+
+    p_ver = sub.add_parser(
+        "verify",
+        help="eval_shape every registered spec (registry-wide trace audit)")
+    _common(p_ver)
+    p_ver.set_defaults(fn=_cmd_verify)
+
+    p_comp = sub.add_parser(
+        "compile",
+        help="deviceless topology compile of tag-selected specs")
+    _common(p_comp)
+    from pvraft_tpu.programs.geometries import TOPOLOGY
+
+    p_comp.add_argument("--topology", default=TOPOLOGY)
+    p_comp.add_argument("--force-topology", action="store_true",
+                        help="compile specs against --topology even when "
+                             "it differs from their declared target (each "
+                             "such record carries declared_topology)")
+    p_comp.add_argument("--out", default="",
+                        help="write the full artifact record (JSON)")
+    p_comp.add_argument("--cache-dir", default="artifacts/xla_cache")
+    p_comp.add_argument("--allow-missing-toolchain", action="store_true",
+                        help="exit 0 (loudly) when libtpu cannot provide "
+                             "the compile topology")
+    p_comp.set_defaults(fn=_cmd_compile)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""The AOT program catalog: every deviceless-certified entry point.
+
+Registration site for the ProgramSpecs that are *compiled* (not just
+traced): the Pallas kernel sweep (tag ``kernel`` — the Mosaic-drift
+canary ``scripts/lint.sh`` runs), the flagship training programs, the
+2x2 dp x sp sharded step, and the certified serve bucket programs. Also
+registers the step profiler's measurement ladder (tag ``profile``) so
+the registry's ``verify`` gate traces the same programs the profiler
+times. The trace/deepcheck corpus (tag ``audit``) registers from
+``pvraft_tpu/analysis/audit.py``; geometry *data* lives in
+:mod:`pvraft_tpu.programs.geometries`.
+
+Everything heavy is inside thunks (the audit-entry discipline): import
+this module freely — no jax, no model build, until a spec is built.
+
+Thunks here return plain ``jax.ShapeDtypeStruct`` args; the compile
+driver (``programs/compile.py``) attaches a replicated single-device
+sharding to any arg that carries none, so only genuinely sharded
+programs (``dp_sp_2x2_train_step``) deal with meshes themselves.
+"""
+
+from __future__ import annotations
+
+from pvraft_tpu.programs import geometries as g
+from pvraft_tpu.programs.spec import register
+
+# Tiny trace dims for the profile.* specs — deliberately the audit
+# module's pairwise-distinct dims so an axis mixup cannot type-check.
+from pvraft_tpu.analysis.audit import B, K, N  # noqa: F401  (registers audit specs too)
+
+
+def _f32(*shape):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, "float32")
+
+
+def _flagship_arrays():
+    b, n = g.FLAGSHIP_BATCH, g.FLAGSHIP_POINTS
+    k = g.FLAGSHIP_TRUNCATE_K
+    return (_f32(b, n, k), _f32(b, n, k, 3), _f32(b, n, 3))
+
+
+# --- Pallas kernels (tag "kernel": the lint.sh/CI Mosaic-drift canary) -----
+# Flagship-geometry Mosaic compiles of both kernels + their VJPs — every
+# Pallas entry point in the repo. The fused-lookup kernel has already
+# been silently broken once by Mosaic toolchain drift (integer-iota
+# argmin, fixed in PR 5); these four specs make the next drift fail the
+# gate loudly instead of rotting at HEAD.
+
+@register("pallas_voxel_fwd", tags=("kernel", "pallas"),
+          topology=g.TOPOLOGY)
+def _k_voxel_fwd():
+    """voxel_bin_means Pallas kernel, forward, flagship geometry."""
+    from pvraft_tpu.ops.pallas.voxel_corr import voxel_bin_means_pallas
+
+    corr, rel, _ = _flagship_arrays()
+    return (lambda c, r: voxel_bin_means_pallas(c, r, 3, 0.25, 3),
+            (corr, rel))
+
+
+@register("pallas_voxel_grad", tags=("kernel", "pallas"),
+          topology=g.TOPOLOGY)
+def _k_voxel_grad():
+    """voxel_bin_means Pallas kernel, VJP, flagship geometry."""
+    import jax
+
+    from pvraft_tpu.ops.pallas.voxel_corr import voxel_bin_means_pallas
+
+    corr, rel, _ = _flagship_arrays()
+    return (jax.grad(lambda c, r: voxel_bin_means_pallas(
+        c, r, 3, 0.25, 3).sum()), (corr, rel))
+
+
+@register("pallas_fused_lookup_fwd", tags=("kernel", "pallas"),
+          topology=g.TOPOLOGY)
+def _k_fused_fwd():
+    """Fused corr-lookup Pallas kernel, forward, flagship geometry."""
+    from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
+
+    corr, rel, coords = _flagship_arrays()
+    return (lambda c, x, q: fused_corr_lookup(c, x, q, 3, 0.25, 3, 32),
+            (corr, rel, coords))
+
+
+@register("pallas_fused_lookup_grad", tags=("kernel", "pallas"),
+          topology=g.TOPOLOGY)
+def _k_fused_grad():
+    """Fused corr-lookup Pallas kernel, VJP, flagship geometry."""
+    import jax
+
+    from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
+
+    corr, rel, coords = _flagship_arrays()
+    return (jax.grad(lambda c, x, q: sum(
+        o.sum() for o in fused_corr_lookup(c, x, q, 3, 0.25, 3, 32))),
+        (corr, rel, coords))
+
+
+# --- flagship training programs -------------------------------------------
+
+def _abstract_params(model, batch, n_points):
+    """Shape-only params via eval_shape (init runs no FLOPs here)."""
+    import jax
+    import jax.numpy as jnp
+
+    pc = jax.ShapeDtypeStruct((batch, n_points, 3), jnp.float32)
+    return jax.eval_shape(
+        lambda r, a, b: model.init(r, a, b, 2), jax.random.key(0), pc, pc)
+
+
+def _flagship_thunk(kind, model_kwargs):
+    """fwd or full train-step (fwd+bwd+adam) at the flagship geometry."""
+
+    def thunk():
+        import jax
+        import optax
+
+        from pvraft_tpu.config import ModelConfig
+        from pvraft_tpu.engine.loss import sequence_loss
+        from pvraft_tpu.models import PVRaft
+
+        b, n = g.FLAGSHIP_BATCH, g.FLAGSHIP_POINTS
+        iters, k = g.FLAGSHIP_ITERS, g.FLAGSHIP_TRUNCATE_K
+        cfg = ModelConfig(truncate_k=k, **model_kwargs)
+        model = PVRaft(cfg)
+        params = _abstract_params(model, b, max(256, k))
+        pc = _f32(b, n, 3)
+        mask = _f32(b, n)
+
+        if kind == "fwd":
+            def fwd(p, a, c):
+                flows, _ = model.apply(p, a, c, iters)
+                return flows[-1]
+
+            return fwd, (params, pc, pc)
+
+        tx = optax.adam(1e-3)
+        opt_state = jax.eval_shape(tx.init, params)
+
+        def train_step(p, o, a, c, m, gt):
+            def loss_fn(pp):
+                flows, _ = model.apply(pp, a, c, iters)
+                return sequence_loss(flows, m, gt, 0.8)
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            updates, o2 = tx.update(grads, o, p)
+            return optax.apply_updates(p, updates), o2, loss
+
+        return train_step, (params, opt_state, pc, pc, mask, pc)
+
+    return thunk
+
+
+# The certified flagship variants: fp32 (documents the single-chip HBM
+# limit), the remat fp32 path that fits, and the bench ladder's primary
+# bf16+pallas+approx rung — model kwargs come from the SAME dicts
+# bench.py measures (geometries.BENCH_VARIANTS).
+_BENCH = dict(g.BENCH_VARIANTS)
+_FLAGSHIP_VARIANTS = (
+    # Round-5 AOT finding: plain fp32 fwd+bwd+adam needs 19.5 GiB of HBM
+    # at the flagship shape — it does NOT fit a 16 GiB v5e chip; the
+    # train-step leg stays to document that limit (expect hbm_oom).
+    ("fp32", _BENCH["fp32"], ("fwd", "train_step"), "hbm_oom"),
+    # remat (jax.checkpoint around each GRU iteration) is the supported
+    # fp32 path on v5e; this leg certifies it fits (backward-only change,
+    # no separate fwd program).
+    ("fp32_remat", dict(_BENCH["fp32"], remat=True), ("train_step",), ""),
+    ("bf16_pallas_approx", _BENCH["bf16+pallas+approx"],
+     ("fwd", "train_step"), ""),
+)
+
+for _tag, _kwargs, _kinds, _expect in _FLAGSHIP_VARIANTS:
+    for _kind in _kinds:
+        register(
+            f"flagship_{_kind}_{_tag}",
+            tags=("flagship", "train" if _kind == "train_step" else "fwd"),
+            precision="f32" if _tag.startswith("fp32") else "any",
+            topology=g.TOPOLOGY,
+            expect_failure=_expect if _kind == "train_step" else "",
+            description=f"flagship {_kind} ({_tag}), "
+                        f"{g.FLAGSHIP_POINTS} pts x {g.FLAGSHIP_ITERS} iters",
+        )(_flagship_thunk(_kind, _kwargs))
+
+
+@register("dp_sp_2x2_train_step", tags=("flagship", "train", "sharded"),
+          topology=g.TOPOLOGY, n_devices=4,
+          description="2x2 dp x sp sharded train step (ring correlation)")
+def _dp_sp(devices=None):
+    """Batch over ``data``, points over ``seq`` (ring correlation),
+    params replicated — collectives must lower for the v5e slice. With
+    no devices (the verify/trace path) the mesh degrades to whatever the
+    host offers, the same discipline as the ring audit entries."""
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.loss import sequence_loss
+    from pvraft_tpu.models import PVRaft
+    from pvraft_tpu.parallel.mesh import make_mesh
+
+    if devices is not None:
+        mesh = make_mesh(n_data=2, n_seq=2, devices=list(devices)[:4])
+    else:
+        local = jax.devices()
+        n_seq = 2 if len(local) >= 2 else 1
+        n_data = 2 if len(local) >= 2 * n_seq else 1
+        mesh = make_mesh(n_data=n_data, n_seq=n_seq)
+    rep = NamedSharding(mesh, P())
+    batch_s = NamedSharding(mesh, P("data", "seq"))
+    b, n = g.FLAGSHIP_BATCH, g.FLAGSHIP_POINTS
+    iters, k = g.FLAGSHIP_ITERS, g.FLAGSHIP_TRUNCATE_K
+
+    cfg = ModelConfig(truncate_k=k, seq_shard=mesh.shape["seq"] > 1)
+    model = PVRaft(cfg, mesh=mesh)
+
+    def shard(tree, s):
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            tree)
+
+    params = shard(_abstract_params(model, b, max(256, k)), rep)
+    pc = jax.ShapeDtypeStruct((b, n, 3), np.float32, sharding=batch_s)
+    mask = jax.ShapeDtypeStruct((b, n), np.float32, sharding=batch_s)
+    tx = optax.adam(1e-3)
+    opt_state = shard(jax.eval_shape(tx.init, params), rep)
+
+    def train_step(p, o, a, c, m, gt):
+        def loss_fn(pp):
+            flows, _ = model.apply(pp, a, c, iters)
+            return sequence_loss(flows, m, gt, 0.8)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o2 = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o2, loss
+
+    return train_step, (params, opt_state, pc, pc, mask, pc)
+
+
+# --- certified serve bucket programs --------------------------------------
+# The exact program the serve engine AOT-compiles (masked forward, pc1
+# donated) at the certified (bucket, batch) geometries — claim-day
+# readiness covers inference, not just training. One spec per geometry,
+# enumerated from geometries.SERVE_CERTIFIED.
+
+def _serve_thunk(model_kwargs, bucket, bs):
+    def thunk():
+        import jax
+
+        from pvraft_tpu.config import ModelConfig
+        from pvraft_tpu.models import PVRaft
+        from pvraft_tpu.serve.engine import build_predict_fn
+
+        cfg = ModelConfig(truncate_k=g.FLAGSHIP_TRUNCATE_K,
+                          use_pallas=True, **model_kwargs)
+        model = PVRaft(cfg)
+        predict = build_predict_fn(model, g.SERVE_DEFAULT_ITERS)
+        params = _abstract_params(model, bs, max(256, g.FLAGSHIP_TRUNCATE_K))
+        pc = _f32(bs, bucket, 3)
+        vm = jax.ShapeDtypeStruct((bs, bucket), "bool")
+        return predict, (params, pc, pc, vm, vm)
+
+    return thunk
+
+
+for _tag, _kwargs, _geoms in g.SERVE_CERTIFIED:
+    for _bucket, _bs in _geoms:
+        register(
+            f"serve_predict_{_tag}_b{_bucket}_bs{_bs}",
+            tags=("serve", "aot"),
+            precision="f32" if _tag == "fp32" else "any",
+            donate_argnums=g.SERVE_PREDICT_DONATE,
+            topology=g.TOPOLOGY,
+            description=f"serve predict ({_tag}) bucket {_bucket} x "
+                        f"batch {_bs}, pc1 donated",
+        )(_serve_thunk(_kwargs, _bucket, _bs))
+
+
+# --- the step profiler's measurement ladder (tag "profile") ---------------
+# One spec per ladder stage, built by the SAME ladder_programs the
+# profiler times — registered at tiny audit dims so `programs verify`
+# traces the full ladder in milliseconds.
+
+def _profile_thunk(stage):
+    def thunk():
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pvraft_tpu.config import ModelConfig
+        from pvraft_tpu.models import PVRaft
+        from pvraft_tpu.profiling.step_profiler import (
+            ladder_programs,
+            make_encoder,
+        )
+
+        cfg = ModelConfig(truncate_k=K, corr_knn=K // 2, graph_k=K // 2)
+        model = PVRaft(cfg)
+        enc = make_encoder(cfg)
+        tx = optax.adam(1e-3)
+
+        def fn(pc1, pc2, mask, gt):
+            params = model.init(jax.random.key(0), pc1, pc2, 2)
+            enc_params = enc.init(jax.random.key(1), pc1)
+            opt_state = tx.init(params)
+            progs = dict(ladder_programs(
+                cfg, model, enc, params, enc_params, tx, opt_state,
+                pc1, pc2, mask, gt, iters=3))
+            return progs[stage](jnp.float32(0.0))
+
+        # pc1/pc2 share N: the ladder profiles the serve/train layout
+        # where both clouds fill one bucket (corr_init needs N >= k).
+        return fn, (_f32(B, N, 3), _f32(B, N, 3), _f32(B, N), _f32(B, N, 3))
+
+    return thunk
+
+
+for _stage in g.PROFILE_LADDER_STAGES:
+    register(f"profile.{_stage}", tags=("profile",),
+             description=f"step-profiler ladder stage {_stage!r} "
+                         "(profiling/step_profiler.py)")(
+        _profile_thunk(_stage))

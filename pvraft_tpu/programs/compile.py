@@ -1,0 +1,207 @@
+"""Deviceless AOT compile driver over the program registry.
+
+One ``lower -> compile -> memory-analysis`` path (``serve/aot.py``,
+shared with the live serve engine) applied to registry specs against a
+compile-only TPU topology: the image's local libtpu runs the REAL
+XLA:TPU + Mosaic pipeline on a CPU host, so program compilability —
+including Mosaic acceptance of every Pallas kernel — is certified
+before any TPU claim, and toolchain drift fails the lint/CI gate loudly
+instead of rotting at HEAD (the fused-lookup kernel's integer-iota
+argmin did exactly that once; fixed in PR 5).
+
+``scripts/aot_readiness.py`` is a thin shim over :func:`run_compile`
+(same artifact schema as always: per-program ``lower_s``/``compile_s``,
+XLA memory analysis with ``fits_16GiB_hbm``, ``expected_failure`` for
+the documented fp32 single-chip HBM limit). The CLI form is
+``python -m pvraft_tpu.programs compile [--tag ...]``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from pvraft_tpu.programs.geometries import HBM_BYTES, TOPOLOGY
+from pvraft_tpu.programs.spec import ProgramSpec
+
+
+class ToolchainUnavailable(RuntimeError):
+    """The deviceless compile gate cannot build its topology on this
+    host. ``libtpu_missing`` distinguishes "no libtpu installed" (a
+    legitimate --allow-missing-toolchain skip) from "libtpu present but
+    broken" (which must FAIL — otherwise the Mosaic-drift canary could
+    rot green-by-skip on exactly the toolchain breakage it exists to
+    catch)."""
+
+    def __init__(self, msg: str, libtpu_missing: bool = False):
+        super().__init__(msg)
+        self.libtpu_missing = libtpu_missing
+
+
+def pin_cpu_host() -> None:
+    """Compile-only runs must not grab an accelerator: host backend is
+    cpu (config API — the env var is captured at interpreter start) and
+    the Pallas kernels are forced into compiled (Mosaic) mode, since the
+    lowering *target* is the TPU topology, not the host."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["PVRAFT_PALLAS_INTERPRET"] = "0"
+    # Deviceless compile needs no TPU runtime: without this, libtpu init
+    # polls the GCP instance-metadata server (30 retries per variable,
+    # 403 on this host) and the first get_topology_desc call spends
+    # MINUTES in network waits before compiling anything. setdefault so
+    # a real TPU environment's own setting wins.
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+
+
+def topology_devices(topology: str = TOPOLOGY) -> list:
+    """Devices of a compile-only topology descriptor, or raise
+    :class:`ToolchainUnavailable` when libtpu cannot provide one."""
+    try:
+        # Deviceless AOT topology descriptors have no stable home; this
+        # driver is the only consumer, so no compat shim.
+        # graftlint: disable-next=GL004 -- experimental import, single consumer
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(topology, "tpu")
+        return list(topo.devices)
+    except Exception as e:  # noqa: BLE001 — classify, caller decides
+        import importlib.util
+
+        missing = importlib.util.find_spec("libtpu") is None
+        raise ToolchainUnavailable(
+            f"cannot build {topology!r} compile topology "
+            f"({type(e).__name__}: {e})", libtpu_missing=missing) from e
+
+
+def _ensure_sharded(args, devs):
+    """Attach a replicated single-device sharding to any abstract arg
+    that carries none (topology compiles need args bound to topology
+    devices; sharded specs attach their own mesh shardings)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(Mesh(np.array(devs[:1]), ("data",)), P())
+
+    def fix(x):
+        if getattr(x, "sharding", None) is None:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep)
+        return x
+
+    return jax.tree_util.tree_map(fix, args)
+
+
+def compile_spec(spec: ProgramSpec, devs, results: List[Dict[str, Any]],
+                 hbm_limit_bytes: int = HBM_BYTES) -> Dict[str, Any]:
+    """Compile one spec; append and return its artifact record.
+
+    ``spec.expect_failure == "hbm_oom"``: the program is KNOWN not to
+    fit a single chip (kept in the sweep so the artifact documents the
+    limit); an HBM RESOURCE_EXHAUSTED is recorded as the expected
+    outcome and does not fail the run — any OTHER failure still does."""
+    from pvraft_tpu.serve.aot import aot_compile
+
+    rec: Dict[str, Any] = {"name": spec.name}
+    try:
+        fn, args = spec.build(devices=devs)
+        args = _ensure_sharded(args, devs)
+        prog = aot_compile(spec.name, fn, tuple(args),
+                           donate_argnums=spec.donate_argnums,
+                           hbm_limit_bytes=hbm_limit_bytes)
+        rec["lower_s"] = round(prog.lower_s, 2)
+        rec["compile_s"] = round(prog.compile_s, 2)
+        mem = prog.memory
+        if mem is not None and "fits_hbm" in mem:
+            # The artifact keeps its historical memory key name.
+            mem = dict(mem)
+            mem["fits_16GiB_hbm"] = mem.pop("fits_hbm")
+        rec["memory"] = mem
+        rec["ok"] = True
+        if spec.expect_failure == "hbm_oom":
+            rec["note"] = ("expected an HBM OOM but compiled — the "
+                           "documented v5e limit no longer holds; "
+                           "re-derive BENCHMARKS.md and bench.py's remat "
+                           "fallback")
+        print(f"[aot] {spec.name}: lower {rec['lower_s']}s "
+              f"compile {rec['compile_s']}s OK", flush=True)
+    except Exception as e:  # noqa: BLE001 — one broken program must not hide the rest
+        err = f"{type(e).__name__}: {str(e)[:800]}"
+        oom = "RESOURCE_EXHAUSTED" in err and "hbm" in err
+        rec["ok"] = False
+        rec["error"] = err
+        if spec.expect_failure == "hbm_oom" and oom:
+            rec["expected_failure"] = "hbm_oom"
+            print(f"[aot] {spec.name}: HBM OOM (expected — documents the "
+                  f"single-chip fp32 limit)", flush=True)
+        else:
+            print(f"[aot] {spec.name}: FAIL {err[:200]}", flush=True)
+    results.append(rec)
+    return rec
+
+
+def run_compile(
+    specs: Sequence[ProgramSpec],
+    topology: str = TOPOLOGY,
+    cache_dir: Optional[str] = None,
+    devices: Optional[list] = None,
+    allow_mismatch: bool = False,
+) -> Dict[str, Any]:
+    """Compile every spec against ``topology``; return the full artifact
+    record (the historical ``aot_readiness.json`` schema). ``devices``:
+    pass an already-built topology device list (e.g. from a toolchain
+    probe) so the descriptor is constructed once per process.
+
+    Every spec DECLARES the topology it is certified against; compiling
+    it for some other target must be an explicit choice, never a silent
+    mis-certification (wrong HBM limit, wrong Mosaic target). Mismatches
+    raise before anything compiles unless ``allow_mismatch`` — then each
+    mismatched program's record carries its ``declared_topology`` so the
+    artifact cannot masquerade as the declared certification."""
+    mismatched = [s.name for s in specs
+                  if s.topology and s.topology != topology]
+    if mismatched and not allow_mismatch:
+        raise ValueError(
+            f"specs declare a different compile topology than {topology!r}: "
+            f"{mismatched} — pass allow_mismatch (CLI: --force-topology) to "
+            f"compile them against {topology!r} anyway")
+
+    import jax
+
+    if cache_dir:
+        # Persistent compilation cache: records whether topology
+        # compiles are cacheable at all (cross-version caveat in
+        # scripts/aot_readiness.py).
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    t0 = time.monotonic()
+    devs = list(devices) if devices is not None else topology_devices(topology)
+    results: List[Dict[str, Any]] = []
+    rec: Dict[str, Any] = {
+        "topology": topology,
+        "libtpu": None,
+        "n_topology_devices": len(devs),
+        "programs": results,
+    }
+    try:
+        import importlib.metadata as md
+
+        rec["libtpu"] = md.version("libtpu")
+    except Exception:
+        pass
+
+    for spec in specs:
+        rec_i = compile_spec(spec, devs, results)
+        if spec.topology and spec.topology != topology:
+            rec_i["declared_topology"] = spec.topology
+
+    rec["total_s"] = round(time.monotonic() - t0, 1)
+    if cache_dir and os.path.isdir(cache_dir):
+        rec["cache_files"] = len(
+            [f for f in os.listdir(cache_dir) if not f.startswith(".")])
+    rec["ok"] = all(r["ok"] or r.get("expected_failure") for r in results)
+    return rec

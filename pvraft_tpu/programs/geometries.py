@@ -1,0 +1,116 @@
+"""Program geometry data: the (shape, dtype, donation) facts, once.
+
+Pure data — imports nothing (not even jax) so any CLI can read the
+enumeration before pinning a backend (bench.py's parent process never
+imports jax; the serve entry points must parse flags before the platform
+is committed). Everything here used to be duplicated literals across
+``scripts/aot_readiness.py``, ``pvraft_tpu/serve/engine.py``,
+``bench.py`` and ``analysis/audit.py``; a new program variant (a serve
+bucket, a bench rung, an A/B lever) is declared HERE and the registry
+(``programs/catalog.py``) turns it into audit + deepcheck + AOT
+evidence. ``tests/test_programs.py`` guards that the old sites carry no
+geometry literals of their own anymore.
+"""
+
+from __future__ import annotations
+
+# --- AOT compile target ----------------------------------------------------
+
+# Deviceless compile topology (scripts/aot_readiness.py rationale): the
+# image's local libtpu lowers the REAL XLA:TPU + Mosaic pipeline for this
+# v5e slice with no device attached.
+TOPOLOGY = "v5e:2x2x1"
+HBM_BYTES = 16 * 1024**3  # v5e chip HBM; fit is checked per program
+
+# --- flagship training geometry (the reference run.sh configuration) -------
+
+FLAGSHIP_BATCH = 2
+FLAGSHIP_POINTS = 8192
+FLAGSHIP_ITERS = 8
+FLAGSHIP_TRUNCATE_K = 512
+
+# --- bench variant ladder (bench.py, fastest-expected first) ---------------
+
+# use_pallas pinned explicitly per variant (the config's None-auto default
+# would silently turn Pallas on for every TPU variant, making the fallback
+# ladder meaningless). bench.py iterates this; programs/catalog.py
+# registers the AOT-certified flagship subset from the same dicts.
+BENCH_VARIANTS = (
+    ("bf16+pallas+approx", {"compute_dtype": "bfloat16", "use_pallas": True,
+                            "approx_topk": True}),
+    ("bf16+approx", {"compute_dtype": "bfloat16", "use_pallas": False,
+                     "approx_topk": True}),
+    ("bf16", {"compute_dtype": "bfloat16", "use_pallas": False}),
+    ("fp32", {"use_pallas": False}),
+)
+
+# Backward-path A/B levers (PR 2): each record maps one bench env flag to
+# the config/step field it toggles. "flag" levers arm on the literal "1";
+# "str" levers arm on any non-empty value. ``step_arg`` levers are
+# per-step-factory arguments (grad_dtype), not ModelConfig fields.
+# bench.py's ab_flags enumeration iterates THIS, and the
+# ``engine.train_step[optimized_backward]`` audit entry builds its config
+# from AB_PRIMARY — the A/B variant a bench run measures and the variant
+# deepcheck walks are the same declaration.
+AB_LEVERS = (
+    {"env": "PVRAFT_BENCH_SCATTER_FREE", "field": "scatter_free_vjp",
+     "kind": "flag"},
+    {"env": "PVRAFT_BENCH_REMAT_POLICY", "field": "remat_policy",
+     "kind": "str"},
+    {"env": "PVRAFT_BENCH_GRAD_DTYPE", "field": "grad_dtype",
+     "kind": "str", "step_arg": True},
+)
+
+# The full optimized-backward configuration (all three levers armed) —
+# the decisive TPU A/B candidate (ROADMAP item 1).
+AB_PRIMARY = {"scatter_free_vjp": True, "remat_policy": "dots",
+              "grad_dtype": "bfloat16"}
+
+# --- step-profiler measurement ladder --------------------------------------
+
+# Cumulative host-synced profiler programs, in ladder order — THE step
+# anatomy enumeration. profiling/step_profiler.py builds (and times) the
+# programs in this order (its MEASUREMENTS is this tuple), and
+# programs/catalog.py registers one `profile.<stage>` spec per entry.
+# Lives here (pure data) so the catalog can enumerate the ladder without
+# importing the profiler (which imports jax).
+PROFILE_LADDER_STAGES = ("encoder", "corr_cum", "fwd1", "fwdN", "fwdbwd",
+                         "step")
+
+# --- serve geometry --------------------------------------------------------
+
+# Default production bucket table (ServeConfig defaults and the serve CLI
+# flag defaults both read these).
+SERVE_DEFAULT_BUCKETS = (2048, 4096, 8192)
+SERVE_DEFAULT_BATCH_SIZES = (1, 4)
+SERVE_DEFAULT_ITERS = 8
+
+# pc1 is donated to every predict program: the unique input whose
+# (shape, dtype) matches the flow output, so XLA aliases instead of
+# allocating (deepcheck GJ004/GJ005 verify this on the serve.predict
+# audit entries). Positions: (params, pc1, pc2, valid1, valid2).
+SERVE_PREDICT_DONATE = (1,)
+
+# AOT-certified serve geometries (the aot_readiness serve leg): per
+# variant tag, the model-config overrides and the (bucket, batch_size)
+# pairs certified for the v5e topology — the latency bucket at bs 1 and
+# the throughput bucket at bs 4, fp32 plus the bf16/Pallas fast path.
+SERVE_CERTIFIED = (
+    ("fp32", {}, ((2048, 1), (8192, 4))),
+    ("bf16_pallas", {"compute_dtype": "bfloat16"}, ((8192, 4),)),
+)
+
+
+def predict_program_name(bucket: int, batch_size: int) -> str:
+    """The serve engine's per-program name ('predict_b{bucket}_bs{bs}')
+    — what /healthz, serve_compile events and profiles report."""
+    return f"predict_b{bucket}_bs{batch_size}"
+
+
+def serve_program_keys(buckets, batch_sizes):
+    """The (bucket, batch_size) program table a serve config compiles —
+    THE enumeration behind InferenceEngine startup (one AOT program per
+    key, in this order)."""
+    for bucket in buckets:
+        for bs in batch_sizes:
+            yield bucket, bs

@@ -1,0 +1,152 @@
+"""Typed program registry: every jitted/AOT entry point, declared once.
+
+A :class:`ProgramSpec` is the repo's unit of "a program exists": a name,
+a lazy thunk building ``(fn, abstract_args)``, classification tags, the
+precision intent and ``spmd_group`` the deepcheck rules read, the
+donation intent, and — for ahead-of-time certified programs — the
+compile topology. The registry is the single enumeration behind:
+
+  * the eval_shape trace audit and the jaxpr ``deepcheck`` corpus
+    (``analysis/audit.py`` — ``AuditEntry`` is a *view* of specs tagged
+    ``"audit"``);
+  * the deviceless AOT readiness sweep (``scripts/aot_readiness.py``,
+    ``python -m pvraft_tpu.programs compile``), including the Pallas
+    ``kernel`` tag whose Mosaic lowering gates ``scripts/lint.sh``;
+  * the serve engine's bucket-program startup table and
+    ``aot_readiness``'s serve leg (geometry constants in
+    :mod:`pvraft_tpu.programs.geometries`);
+  * the step profiler's measurement ladder (``profile.*`` specs mirror
+    ``profiling/step_profiler.ladder_programs``).
+
+Import-light on purpose: no jax at module scope, so CLIs (bench.py, the
+serve entry points) can read the registry's *data* before pinning a
+backend. Thunks do all heavy imports lazily, exactly like the audit
+entries always have.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class DuplicateProgramError(ValueError):
+    """Two ProgramSpecs claimed the same name — the registry's whole
+    point is that a program is declared exactly once."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One declared program: geometry, intent, and how to build it.
+
+    ``thunk`` returns ``(fn, args)`` where array args are
+    ``jax.ShapeDtypeStruct``\\ s (the audit-entry convention). Thunks that
+    need devices (sharded programs construct a mesh) accept an optional
+    ``devices`` keyword; :meth:`build` passes it through when given.
+
+    ``precision`` and ``spmd_group`` carry the deepcheck GJ006/GJ003
+    intent (see :class:`pvraft_tpu.analysis.audit.AuditEntry`).
+    ``donate_argnums`` is the declared donation/aliasing intent the
+    compile path applies. ``topology`` names the AOT compile target
+    (``"v5e:2x2x1"``) for specs the deviceless compile gate certifies;
+    ``None`` means host-trace-only (audit/profile entries).
+    ``expect_failure`` documents a known-expected compile outcome
+    (``"hbm_oom"``: the program is KEPT in the sweep to document a chip
+    limit). ``path``/``line`` anchor the declaration site for findings
+    and suppressions."""
+
+    name: str
+    thunk: Callable
+    tags: Tuple[str, ...] = ()
+    precision: str = "f32"
+    spmd_group: Optional[str] = None
+    donate_argnums: Tuple[int, ...] = ()
+    topology: Optional[str] = None
+    n_devices: int = 1
+    expect_failure: str = ""
+    description: str = ""
+    path: str = ""
+    line: int = 0
+
+    def build(self, devices=None):
+        """``(fn, args)`` — abstract when ``devices`` is None, with the
+        spec's own mesh/shardings when topology devices are passed."""
+        try:
+            params = inspect.signature(self.thunk).parameters
+        except (TypeError, ValueError):  # builtins/partials without sigs
+            params = {}
+        if "devices" in params:
+            return self.thunk(devices=devices)
+        return self.thunk()
+
+
+_REGISTRY: Dict[str, ProgramSpec] = {}
+
+
+def register_spec(spec: ProgramSpec) -> ProgramSpec:
+    """Add one spec; duplicate names are an error, not a shadow."""
+    if spec.name in _REGISTRY:
+        prev = _REGISTRY[spec.name]
+        raise DuplicateProgramError(
+            f"duplicate program spec {spec.name!r} "
+            f"(already declared at {prev.path}:{prev.line})")
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def register(name: str, *, tags: Tuple[str, ...] = (),
+             precision: str = "f32", spmd_group: Optional[str] = None,
+             donate_argnums: Tuple[int, ...] = (),
+             topology: Optional[str] = None, n_devices: int = 1,
+             expect_failure: str = "", description: str = ""):
+    """Decorator form: anchor path/line at the ``register(...)`` call
+    site — the actual declaration. For ``@register`` on a def that is
+    the decorator line; for loop-registered factory thunks it is the
+    loop's call, NOT the factory's shared inner ``def thunk`` (which
+    would make every loop-produced spec claim one line). Description
+    defaults to the thunk's first docstring line."""
+    caller = inspect.currentframe().f_back  # O(1); stack() reads source
+    anchor_path = caller.f_code.co_filename if caller else ""
+    anchor_line = caller.f_lineno if caller else 0
+
+    def deco(thunk):
+        code = getattr(thunk, "__code__", None)
+        doc = (thunk.__doc__ or "").strip()
+        register_spec(ProgramSpec(
+            name=name,
+            thunk=thunk,
+            tags=tuple(tags),
+            precision=precision,
+            spmd_group=spmd_group,
+            donate_argnums=tuple(donate_argnums),
+            topology=topology,
+            n_devices=n_devices,
+            expect_failure=expect_failure,
+            description=description or (doc.splitlines()[0] if doc else ""),
+            path=anchor_path or getattr(code, "co_filename", "") or "",
+            line=anchor_line or getattr(code, "co_firstlineno", 0) or 0,
+        ))
+        return thunk
+
+    return deco
+
+
+def specs() -> Dict[str, ProgramSpec]:
+    """The registry in declaration order (copy; mutation-safe)."""
+    return dict(_REGISTRY)
+
+
+def get(name: str) -> ProgramSpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"no program spec named {name!r}; see "
+            f"`python -m pvraft_tpu.programs list`") from None
+
+
+def by_tag(*tags: str) -> List[ProgramSpec]:
+    """Specs carrying ALL the given tags, in declaration order."""
+    want = set(tags)
+    return [s for s in _REGISTRY.values() if want.issubset(s.tags)]

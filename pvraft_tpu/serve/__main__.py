@@ -14,6 +14,11 @@ import argparse
 import sys
 
 from pvraft_tpu import parse_int_list as _parse_ints
+from pvraft_tpu.programs.geometries import (
+    SERVE_DEFAULT_BATCH_SIZES,
+    SERVE_DEFAULT_BUCKETS,
+    SERVE_DEFAULT_ITERS,
+)
 
 
 def _cmd_serve(args) -> int:
@@ -99,11 +104,16 @@ def main(argv=None) -> int:
                      help="checkpoint (.msgpack file or .orbax directory)")
     srv.add_argument("--host", default="127.0.0.1")
     srv.add_argument("--port", type=int, default=8000)
-    srv.add_argument("--buckets", default="2048,4096,8192",
+    # Geometry defaults come from the program registry's declarations
+    # (pvraft_tpu/programs/geometries.py), the same table the engine
+    # compiles and aot_readiness certifies.
+    srv.add_argument("--buckets",
+                     default=",".join(map(str, SERVE_DEFAULT_BUCKETS)),
                      help="comma-separated point-count buckets (ascending)")
-    srv.add_argument("--batch_sizes", default="1,4",
+    srv.add_argument("--batch_sizes",
+                     default=",".join(map(str, SERVE_DEFAULT_BATCH_SIZES)),
                      help="comma-separated compiled batch sizes (ascending)")
-    srv.add_argument("--iters", type=int, default=8,
+    srv.add_argument("--iters", type=int, default=SERVE_DEFAULT_ITERS,
                      help="GRU refinement iterations per predict")
     srv.add_argument("--truncate_k", type=int, default=512)
     srv.add_argument("--corr_knn", type=int, default=32)

@@ -41,6 +41,14 @@ import numpy as np
 
 from pvraft_tpu.analysis.contracts import shapecheck
 from pvraft_tpu.config import ModelConfig
+from pvraft_tpu.programs.geometries import (
+    SERVE_DEFAULT_BATCH_SIZES,
+    SERVE_DEFAULT_BUCKETS,
+    SERVE_DEFAULT_ITERS,
+    SERVE_PREDICT_DONATE,
+    predict_program_name,
+    serve_program_keys,
+)
 from pvraft_tpu.serve.aot import AotProgram, aot_compile
 
 
@@ -62,16 +70,21 @@ class ServeConfig:
 
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     # Point-count buckets, ascending. A request with n points runs in the
-    # smallest bucket >= n; larger requests are rejected (413).
-    buckets: Tuple[int, ...] = (2048, 4096, 8192)
+    # smallest bucket >= n; larger requests are rejected (413). Defaults
+    # are the registry-declared production geometry
+    # (pvraft_tpu/programs/geometries.py) — the single place bucket/batch
+    # tables live; tests/test_programs.py guards this file against
+    # re-growing its own literals.
+    buckets: Tuple[int, ...] = SERVE_DEFAULT_BUCKETS
     # Batch sizes compiled per bucket. The micro-batcher dispatches with
     # the smallest compiled size that fits the pending group and fills
     # unused slots with a copy of the first request (batch-parallel ops
     # make that exact).
-    batch_sizes: Tuple[int, ...] = (1, 4)
+    batch_sizes: Tuple[int, ...] = SERVE_DEFAULT_BATCH_SIZES
     # GRU refinement iterations at serve time (the reference evaluates at
-    # 32; 8 is the latency-lean choice — an accuracy/latency knob).
-    num_iters: int = 8
+    # 32; the default is the latency-lean choice — an accuracy/latency
+    # knob).
+    num_iters: int = SERVE_DEFAULT_ITERS
     # Serve a stage-2 (PVRaftRefine) checkpoint.
     refine: bool = False
     # Valid requests keep every |coordinate| < coord_limit; padding points
@@ -164,17 +177,19 @@ class InferenceEngine:
             self.model, cfg.num_iters, refine=cfg.refine)
         # Commit params to device once; every program call reuses them.
         self.params = jax.device_put(params)
+        # The (bucket, batch) program table is the registry's enumeration
+        # (programs/geometries.serve_program_keys) — the same iteration
+        # order aot_readiness certifies and /healthz reports.
         self._programs: Dict[Tuple[int, int], AotProgram] = {}
-        for bucket in cfg.buckets:
-            for bs in cfg.batch_sizes:
-                prog = self._compile(bucket, bs)
-                self._programs[(bucket, bs)] = prog
-                if telemetry is not None:
-                    telemetry.emit_compile(
-                        bucket=bucket, batch=bs,
-                        lower_s=round(prog.lower_s, 3),
-                        compile_s=round(prog.compile_s, 3),
-                        memory=prog.memory)
+        for bucket, bs in serve_program_keys(cfg.buckets, cfg.batch_sizes):
+            prog = self._compile(bucket, bs)
+            self._programs[(bucket, bs)] = prog
+            if telemetry is not None:
+                telemetry.emit_compile(
+                    bucket=bucket, batch=bs,
+                    lower_s=round(prog.lower_s, 3),
+                    compile_s=round(prog.compile_s, 3),
+                    memory=prog.memory)
 
     @classmethod
     def from_checkpoint(cls, path: str, cfg: ServeConfig, telemetry=None):
@@ -194,12 +209,13 @@ class InferenceEngine:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.params)
         # Donate pc1 only: it is the unique input aliasing the (bs,
         # bucket, 3) f32 output; donating pc2/masks too would just be
-        # silent copies (GJ004).
+        # silent copies (GJ004). The donation intent and program naming
+        # are registry declarations (programs/geometries.py).
         return aot_compile(
-            f"predict_b{bucket}_bs{bs}",
+            predict_program_name(bucket, bs),
             self._predict_fn,
             (params_sds, f32, f32, vmask, vmask),
-            donate_argnums=(1,),
+            donate_argnums=SERVE_PREDICT_DONATE,
         )
 
     # ---------------------------------------------------------------- API --

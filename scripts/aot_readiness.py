@@ -6,41 +6,31 @@ does return, the first compile of the flagship program through the tunnel
 was measured in MINUTES (BENCHMARKS.md round 2) — a short claim window
 can be eaten entirely by compilation. This script de-risks that window
 *without touching the claim at all*: the image ships a local
-``libtpu.so`` (site-packages ``libtpu`` 0.0.34), so
-``jax.experimental.topologies.get_topology_desc("v5e:2x2x1", "tpu")``
-creates a compile-only v5e topology and ``jit(...).lower(...).compile()``
-runs the REAL XLA:TPU + Mosaic pipeline on this host, deviceless.
+``libtpu.so``, so ``jit(...).lower(...).compile()`` against a
+compile-only v5e topology runs the REAL XLA:TPU + Mosaic pipeline on
+this host, deviceless.
 
-What this certifies before any claim:
-  * the flagship programs (fwd, fwd+bwd+adam; fp32 and the bench's
-    primary bf16+pallas+approx variant) COMPILE for v5e — any
-    XLA/Mosaic rejection surfaces here, not mid-claim;
-  * the Pallas voxel / fused-lookup kernels compile through Mosaic
-    (``PVRAFT_PALLAS_INTERPRET=0``) at the flagship (tile=64, K=512)
-    geometry — VMEM overflow at that tile would fail THIS step (the
-    numerics certification still needs a device, ``scripts/
-    tpu_consistency.py``, queued);
-  * the dp x sp sharded train step compiles for a 2x2 v5e slice
-    (collectives lower for ICI);
-  * the serve bucket predict programs (``pvraft_tpu/serve``: masked
-    forward, donated pc1, fp32 + bf16/Pallas) compile at the latency
-    (2048, bs 1) and throughput (8192, bs 4) geometries — claim-day
-    readiness covers inference, not just training;
-  * per-program compile seconds + XLA memory analysis (argument /
-    output / temp / generated-code bytes) are recorded so the claim-day
-    budget is known, and HBM fit (16 GiB/chip on v5e) is checked from
-    the memory analysis.
+Since the program-registry refactor this is a thin shim: the certified
+program set — Pallas kernels (fwd + VJP at flagship geometry), flagship
+train/fwd variants (incl. the documented fp32 HBM-OOM limit and its
+remat fix), the 2x2 dp x sp sharded step, and the serve bucket predict
+programs — is *declared once* in ``pvraft_tpu/programs/catalog.py``
+(geometry data in ``programs/geometries.py``), and this script iterates
+those registry records through the shared compile driver
+(``pvraft_tpu/programs/compile.py`` -> ``serve/aot.aot_compile`` — the
+same lower/compile/memory-analysis path the live serve engine reports).
+``python -m pvraft_tpu.programs compile`` is the tag-selectable CLI
+form; ``--skip-big`` here equals ``--tag kernel`` there (the lint.sh /
+CI Mosaic-drift gate).
 
 Caveats (documented, not hidden): executables compiled here cannot be
 shipped to the remote PJRT client (different client instance), and the
 persistent compilation cache key includes the backend's compiler
 version — whether the axon backend hits a cache warmed here depends on
-its libtpu matching 0.0.34, which cannot be verified without a claim.
-The guaranteed claim-window win is different: enabling
-``JAX_COMPILATION_CACHE_DIR`` for the queue jobs (scripts/tpu_batch.sh)
-makes the SECOND and later jobs of a claim reuse the first job's
-remote-compiled executables, since every queue job re-runs the same
-flagship programs in a fresh process.
+its libtpu matching, which cannot be verified without a claim. The
+guaranteed claim-window win is ``JAX_COMPILATION_CACHE_DIR`` for the
+queue jobs (scripts/tpu_batch.sh): the SECOND and later jobs of a claim
+reuse the first job's remote-compiled executables.
 
 Usage: ``python scripts/aot_readiness.py [--skip-big]`` ->
 ``artifacts/aot_readiness.json``.
@@ -52,319 +42,37 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import numpy as np  # noqa: E402
-
-TOPOLOGY = "v5e:2x2x1"
-HBM_BYTES = 16 * 1024**3  # v5e chip HBM
-
-
-def _pin_cpu():
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-
-
-def _topology_devices():
-    # Deviceless AOT topology descriptors have no stable home; this script
-    # is the only consumer, so no compat shim.
-    # graftlint: disable-next=GL004 -- experimental import, see above
-    from jax.experimental import topologies
-
-    topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
-    return list(topo.devices)
-
-
-def _compile(name, fn, args_sds, results, in_shardings=None,
-             expect_hbm_oom=False, donate_argnums=()):
-    """``expect_hbm_oom``: the program is KNOWN not to fit a single v5e
-    chip (kept in the list so the artifact documents the limit); an HBM
-    RESOURCE_EXHAUSTED is then recorded as the expected outcome and does
-    not fail the run — any OTHER failure still does."""
-    # One lower -> compile -> memory-analysis code path with the serve
-    # engine (serve/aot.py): the live service and claim-day readiness
-    # must report compile cost and HBM fit the same way. The artifact
-    # keeps its historical memory key name.
-    from pvraft_tpu.serve.aot import aot_compile
-
-    rec = {"name": name}
-    try:
-        prog = aot_compile(name, fn, tuple(args_sds),
-                           donate_argnums=tuple(donate_argnums),
-                           in_shardings=in_shardings,
-                           hbm_limit_bytes=HBM_BYTES)
-        rec["lower_s"] = round(prog.lower_s, 2)
-        rec["compile_s"] = round(prog.compile_s, 2)
-        mem = prog.memory
-        if mem is not None and "fits_hbm" in mem:
-            mem = dict(mem)
-            mem["fits_16GiB_hbm"] = mem.pop("fits_hbm")
-        rec["memory"] = mem
-        rec["ok"] = True
-        if expect_hbm_oom:
-            rec["note"] = ("expected an HBM OOM but compiled — the "
-                           "documented v5e limit no longer holds; "
-                           "re-derive BENCHMARKS.md and bench.py's remat "
-                           "fallback")
-        print(f"[aot] {name}: lower {rec['lower_s']}s "
-              f"compile {rec['compile_s']}s OK", flush=True)
-    except Exception as e:
-        err = f"{type(e).__name__}: {str(e)[:800]}"
-        oom = "RESOURCE_EXHAUSTED" in err and "hbm" in err
-        rec["ok"] = False
-        rec["error"] = err
-        if expect_hbm_oom and oom:
-            rec["expected_failure"] = "hbm_oom"
-            print(f"[aot] {name}: HBM OOM (expected — documents the "
-                  f"single-chip fp32 limit)", flush=True)
-        else:
-            print(f"[aot] {name}: FAIL {err[:200]}", flush=True)
-    results.append(rec)
-    return rec
-
-
-def pallas_kernels(devs, results):
-    """Flagship-geometry Mosaic compiles of both kernels + their VJPs."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
-    from pvraft_tpu.ops.pallas.voxel_corr import voxel_bin_means_pallas
-
-    mesh1 = Mesh(np.array(devs[:1]), ("data",))
-    s = NamedSharding(mesh1, P())
-    b, n, k = 2, 8192, 512
-    f32 = jnp.float32
-    corr = jax.ShapeDtypeStruct((b, n, k), f32, sharding=s)
-    rel = jax.ShapeDtypeStruct((b, n, k, 3), f32, sharding=s)
-    coords = jax.ShapeDtypeStruct((b, n, 3), f32, sharding=s)
-
-    _compile("pallas_voxel_fwd",
-             lambda c, r: voxel_bin_means_pallas(c, r, 3, 0.25, 3),
-             (corr, rel), results)
-    _compile("pallas_voxel_grad",
-             jax.grad(lambda c, r: voxel_bin_means_pallas(
-                 c, r, 3, 0.25, 3).sum()),
-             (corr, rel), results)
-    _compile("pallas_fused_lookup_fwd",
-             lambda c, x, q: fused_corr_lookup(c, x, q, 3, 0.25, 3, 32),
-             (corr, rel, coords), results)
-    _compile("pallas_fused_lookup_grad",
-             jax.grad(lambda c, x, q: sum(
-                 o.sum() for o in fused_corr_lookup(
-                     c, x, q, 3, 0.25, 3, 32))),
-             (corr, rel, coords), results)
-
-
-def _abstract_params(model, batch, n_points, dtype=None):
-    """Shape-only params via eval_shape (init runs no FLOPs here)."""
-    import jax
-    import jax.numpy as jnp
-
-    pc = jax.ShapeDtypeStruct((batch, n_points, 3), jnp.float32)
-    return jax.eval_shape(
-        lambda r, a, b: model.init(r, a, b, 2),
-        jax.random.key(0), pc, pc)
-
-
-def _with_sharding(tree, sharding):
-    import jax
-
-    return jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding),
-        tree)
-
-
-def flagship_programs(devs, results):
-    """Single-chip flagship: fwd and fwd+bwd+adam, fp32 and the bench's
-    bf16+pallas+approx primary variant (bench.py VARIANTS[0])."""
-    import jax
-    import jax.numpy as jnp
-    import optax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    from pvraft_tpu.config import ModelConfig
-    from pvraft_tpu.engine.loss import sequence_loss
-    from pvraft_tpu.models import PVRaft
-
-    mesh1 = Mesh(np.array(devs[:1]), ("data",))
-    s = NamedSharding(mesh1, P())
-    b, n, iters, k = 2, 8192, 8, 512
-
-    for tag, kwargs in [
-        ("fp32", dict()),
-        # Round-5 AOT finding: plain fp32 fwd+bwd+adam needs 19.5 GiB of
-        # HBM at the flagship shape — it does NOT fit a 16 GiB v5e chip.
-        # remat (jax.checkpoint around each GRU iteration) is the
-        # supported fp32 path on v5e; this leg certifies it fits.
-        ("fp32_remat", dict(remat=True)),
-        ("bf16_pallas_approx", dict(compute_dtype="bfloat16",
-                                    use_pallas=True, approx_topk=True)),
-    ]:
-        cfg = ModelConfig(truncate_k=k, **kwargs)
-        model = PVRaft(cfg)
-        params = _with_sharding(
-            _abstract_params(model, b, max(256, k)), s)
-        pc = jax.ShapeDtypeStruct((b, n, 3), jnp.float32, sharding=s)
-        mask = jax.ShapeDtypeStruct((b, n), jnp.float32, sharding=s)
-
-        def fwd(p, a, c):
-            flows, _ = model.apply(p, a, c, iters)
-            return flows[-1]
-
-        if "remat" not in tag:  # remat only changes the backward pass
-            _compile(f"flagship_fwd_{tag}", fwd, (params, pc, pc), results)
-
-        tx = optax.adam(1e-3)
-        opt_state = _with_sharding(
-            jax.eval_shape(tx.init, params), s)
-
-        def train_step(p, o, a, c, m, g):
-            def loss_fn(pp):
-                flows, _ = model.apply(pp, a, c, iters)
-                return sequence_loss(flows, m, g, 0.8)
-
-            loss, grads = jax.value_and_grad(loss_fn)(p)
-            updates, o2 = tx.update(grads, o, p)
-            return optax.apply_updates(p, updates), o2, loss
-
-        _compile(f"flagship_train_step_{tag}", train_step,
-                 (params, opt_state, pc, pc, mask, pc), results,
-                 expect_hbm_oom=(tag == "fp32"))
-
-
-def serve_programs(devs, results):
-    """Serve bucket predict programs (``pvraft_tpu/serve``): claim-day
-    readiness covers inference, not just training. The exact program the
-    engine AOT-compiles — masked forward, pc1 donated — at the latency
-    bucket (2048, bs 1) and the throughput bucket (8192, bs 4), fp32 and
-    the bf16 fast path, with the Pallas kernels (the certified TPU
-    lookup path the engine resolves to on device)."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    from pvraft_tpu.config import ModelConfig
-    from pvraft_tpu.models import PVRaft
-    from pvraft_tpu.serve.engine import build_predict_fn
-
-    mesh1 = Mesh(np.array(devs[:1]), ("data",))
-    s = NamedSharding(mesh1, P())
-    k = 512
-    for tag, kwargs, geometries in [
-        ("fp32", dict(), ((2048, 1), (8192, 4))),
-        ("bf16_pallas", dict(compute_dtype="bfloat16"), ((8192, 4),)),
-    ]:
-        cfg = ModelConfig(truncate_k=k, use_pallas=True, **kwargs)
-        model = PVRaft(cfg)
-        predict = build_predict_fn(model, 8)
-        for bucket, bs in geometries:
-            params = _with_sharding(
-                _abstract_params(model, bs, max(256, k)), s)
-            pc = jax.ShapeDtypeStruct((bs, bucket, 3), jnp.float32,
-                                      sharding=s)
-            vm = jax.ShapeDtypeStruct((bs, bucket), jnp.bool_, sharding=s)
-            _compile(f"serve_predict_{tag}_b{bucket}_bs{bs}",
-                     predict, (params, pc, pc, vm, vm), results,
-                     donate_argnums=(1,))
-
-
-def dp_sp_program(devs, results):
-    """2x2 dp x sp sharded train step (the multi-chip flagship layout):
-    batch over ``data``, points over ``seq`` (ring correlation), params
-    replicated — collectives must lower for the v5e slice."""
-    import jax
-    import jax.numpy as jnp
-    import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from pvraft_tpu.config import ModelConfig
-    from pvraft_tpu.engine.loss import sequence_loss
-    from pvraft_tpu.models import PVRaft
-    from pvraft_tpu.parallel.mesh import make_mesh
-
-    mesh = make_mesh(n_data=2, n_seq=2, devices=devs[:4])
-    rep = NamedSharding(mesh, P())
-    batch_s = NamedSharding(mesh, P("data", "seq"))
-    b, n, iters, k = 2, 8192, 8, 512
-
-    cfg = ModelConfig(truncate_k=k, seq_shard=True)
-    model = PVRaft(cfg, mesh=mesh)
-    params = _with_sharding(_abstract_params(model, b, max(256, k)), rep)
-    pc = jax.ShapeDtypeStruct((b, n, 3), jnp.float32, sharding=batch_s)
-    mask = jax.ShapeDtypeStruct((b, n), jnp.float32, sharding=batch_s)
-    tx = optax.adam(1e-3)
-    opt_state = _with_sharding(jax.eval_shape(tx.init, params), rep)
-
-    def train_step(p, o, a, c, m, g):
-        def loss_fn(pp):
-            flows, _ = model.apply(pp, a, c, iters)
-            return sequence_loss(flows, m, g, 0.8)
-
-        loss, grads = jax.value_and_grad(loss_fn)(p)
-        updates, o2 = tx.update(grads, o, p)
-        return optax.apply_updates(p, updates), o2, loss
-
-    _compile("dp_sp_2x2_train_step", train_step,
-             (params, opt_state, pc, pc, mask, pc), results)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="artifacts/aot_readiness.json")
     ap.add_argument("--skip-big", action="store_true",
-                    help="kernels only (fast smoke)")
+                    help="kernels only (fast smoke; == programs compile "
+                         "--tag kernel)")
     ap.add_argument("--cache-dir", default="artifacts/xla_cache")
     args = ap.parse_args()
-    _pin_cpu()
-    # Force compiled (Mosaic) mode for the Pallas kernels: the host
-    # backend is cpu but the lowering target is the tpu topology.
-    os.environ["PVRAFT_PALLAS_INTERPRET"] = "0"
 
-    import jax
+    from pvraft_tpu.programs import load_catalog, specs
+    from pvraft_tpu.programs.compile import pin_cpu_host, run_compile
 
-    # Persistent compilation cache: records whether topology compiles are
-    # cacheable at all (see module docstring for the cross-version caveat).
-    os.makedirs(args.cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", args.cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    pin_cpu_host()
+    load_catalog()
+    # Registry declaration order keeps the historical artifact order:
+    # kernels first (the fast smoke subset), then flagship, dp_sp, serve.
+    topo_specs = [s for s in specs().values() if s.topology]
+    if args.skip_big:
+        topo_specs = [s for s in topo_specs if "kernel" in s.tags]
 
-    t0 = time.monotonic()
-    devs = _topology_devices()
-    results = []
-    rec = {
-        "topology": TOPOLOGY,
-        "libtpu": None,
-        "n_topology_devices": len(devs),
-        "programs": results,
-    }
-    try:
-        import importlib.metadata as md
-
-        rec["libtpu"] = md.version("libtpu")
-    except Exception:
-        pass
-
-    pallas_kernels(devs, results)
-    if not args.skip_big:
-        flagship_programs(devs, results)
-        dp_sp_program(devs, results)
-        serve_programs(devs, results)
-
-    rec["total_s"] = round(time.monotonic() - t0, 1)
-    rec["cache_files"] = len([
-        f for f in os.listdir(args.cache_dir)
-        if not f.startswith(".")]) if os.path.isdir(args.cache_dir) else 0
-    rec["ok"] = all(r["ok"] or r.get("expected_failure") for r in results)
+    rec = run_compile(topo_specs, cache_dir=args.cache_dir)
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(rec, f, indent=2)
     print(json.dumps({"ok": rec["ok"], "total_s": rec["total_s"],
-                      "programs": [(r["name"], r["ok"]) for r in results]}))
+                      "programs": [(r["name"], r["ok"])
+                                   for r in rec["programs"]]}))
     if not rec["ok"]:
         sys.exit(1)
 

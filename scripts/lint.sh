@@ -22,10 +22,14 @@ python -m pvraft_tpu.analysis lint --stats pvraft_tpu/ tests/ scripts/
 # loop with no collectives at all.
 _audit_flags="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
-echo "== graftlint: eval_shape trace-compat audit (zero-FLOP abstract traces)"
+echo "== programs: registry-wide eval_shape verify (zero-FLOP abstract traces)"
+# Supersedes the old `analysis trace` stage: the audit corpus is the
+# "audit"-tagged slice of the program registry, and `programs verify`
+# traces EVERY ProgramSpec — audit entries plus the AOT catalog
+# (flagship/serve/kernel geometries) and the profiler ladder.
 # CPU pin: shape propagation needs no accelerator and must not grab one.
 JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
-  python -m pvraft_tpu.analysis trace
+  python -m pvraft_tpu.programs verify
 
 echo "== deepcheck: jaxpr-level semantic analysis (GJ rules) over the audit corpus"
 # Traces every registered audit entry to a ClosedJaxpr and checks
@@ -33,6 +37,17 @@ echo "== deepcheck: jaxpr-level semantic analysis (GJ rules) over the audit corp
 # hazards. Tracing only — zero FLOPs, CPU-safe.
 JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
   python -m pvraft_tpu.analysis deepcheck
+
+echo "== programs: deviceless Mosaic compile of every Pallas kernel entry point"
+# The kernel-compile gate (ROADMAP item 1): lowers the `kernel`-tagged
+# registry programs (both Pallas kernels, fwd + VJP, flagship geometry)
+# through the REAL XLA:TPU + Mosaic pipeline against the declared v5e
+# topology — toolchain drift broke the fused-lookup kernel silently at
+# HEAD once (integer-iota argmin, fixed in PR 5); now it fails here.
+# --allow-missing-toolchain: on hosts with no libtpu (some CI runners)
+# the stage skips LOUDLY instead of failing on a missing compiler.
+JAX_PLATFORMS=cpu \
+  python -m pvraft_tpu.programs compile --tag kernel --allow-missing-toolchain
 
 echo "== pvraft_events/v1: committed event logs validate"
 # Any event log shipped as evidence (artifacts/) plus the golden test

@@ -1,0 +1,294 @@
+"""Tests for the typed program registry (``pvraft_tpu/programs``).
+
+Covers the registry mechanics (duplicate collision, decorator anchors),
+the audit-view projection (spec <-> AuditEntry round-trip, zero entries
+lost in the migration), the golden ``programs list`` inventory (pinned
+to the committed ``artifacts/programs_list.txt`` so the artifact cannot
+go stale), and the single-source guards: no (bucket, batch) geometry
+literals outside the registry, bench's variant/A-B enumeration and the
+profiler ladder both mirror registry records.
+"""
+
+import ast
+import contextlib
+import io
+import os
+
+import pytest
+
+from pvraft_tpu.programs import (
+    DuplicateProgramError,
+    ProgramSpec,
+    by_tag,
+    get,
+    load_catalog,
+    register,
+    register_spec,
+)
+from pvraft_tpu.programs import geometries as g
+from pvraft_tpu.programs import spec as spec_mod
+from pvraft_tpu.programs.__main__ import main as programs_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+load_catalog()
+
+
+# ------------------------------------------------------------ registry ----
+
+def test_duplicate_name_collision():
+    existing = get("engine.train_step")
+    with pytest.raises(DuplicateProgramError) as exc:
+        register_spec(existing)
+    # The error names the prior declaration site (actionable collision).
+    assert "engine.train_step" in str(exc.value)
+    assert existing.path in str(exc.value)
+
+
+def test_register_decorator_anchors_and_cleanup():
+    name = "test.tmp_registry_probe"
+    try:
+        @register(name, tags=("tmp",), donate_argnums=(0,))
+        def _probe():
+            """Probe spec for decorator metadata."""
+            return (lambda x: x), (None,)
+
+        s = get(name)
+        assert s.tags == ("tmp",)
+        assert s.donate_argnums == (0,)
+        assert s.path.endswith("test_programs.py") and s.line > 0
+        assert s.description == "Probe spec for decorator metadata."
+    finally:
+        spec_mod._REGISTRY.pop(name, None)  # keep the golden list clean
+
+
+def test_get_unknown_name_is_actionable():
+    with pytest.raises(KeyError) as exc:
+        get("no.such.program")
+    assert "programs list" in str(exc.value)
+
+
+# ------------------------------------------------- audit view migration ----
+
+# The full 29-entry corpus at the migration (PR 5 close) — the refactor
+# must lose none of these (new entries may be added on top).
+PRE_MIGRATION_CORPUS = {
+    "corr.corr_init", "corr.corr_init[chunked]", "corr.corr_volume",
+    "corr.knn_lookup",
+    "engine.eval_step", "engine.eval_step[refine]",
+    "engine.refine_train_step", "engine.train_step",
+    "engine.train_step[optimized_backward]", "engine.train_step[telemetry]",
+    "engine.train_step[telemetry_off_jaxpr]",
+    "geometry.build_graph", "geometry.gather_neighbors",
+    "geometry.knn_indices", "geometry.knn_indices[chunked]",
+    "geometry.pairwise_sqdist",
+    "models.PVRaft", "models.PVRaftRefine",
+    "models.PVRaft[scatter_free+save_corr]",
+    "pallas.fused_corr_lookup", "pallas.voxel_bin_means_pallas",
+    "ring.ring_corr_init", "ring.ring_knn_indices",
+    "scatter_free.gather_neighbors_onehot[grad]",
+    "scatter_free.max_pool_argmax[grad]",
+    "scatter_free.take_pair_onehot[grad]",
+    "serve.predict", "serve.predict[bf16]",
+    "voxel.voxel_bin_means",
+}
+
+
+def test_audit_corpus_complete():
+    from pvraft_tpu.analysis.audit import entries
+
+    names = set(entries())
+    assert len(PRE_MIGRATION_CORPUS) == 29
+    missing = PRE_MIGRATION_CORPUS - names
+    assert not missing, f"audit entries lost in the migration: {missing}"
+
+
+def test_audit_entry_is_view_of_program_spec():
+    from pvraft_tpu.analysis.audit import entries
+
+    ent = entries()
+    audit_specs = {s.name: s for s in by_tag("audit")}
+    assert set(ent) == set(audit_specs)
+    for name, e in ent.items():
+        s = audit_specs[name]
+        assert e.thunk is s.thunk
+        assert e.precision == s.precision
+        assert e.spmd_group == s.spmd_group
+        assert (e.path, e.line) == (s.path, s.line)
+
+
+def test_deepcheck_reads_the_registry_corpus():
+    """deepcheck's default corpus is audit.entries() — which is the
+    registry view; a registry-only entry filter must therefore see it."""
+    from pvraft_tpu.analysis.jaxpr.deepcheck import run_deepcheck
+
+    report = run_deepcheck(entry_filter=("geometry.pairwise_sqdist",),
+                           retrace=False)
+    assert [e.name for e in report.entries] == ["geometry.pairwise_sqdist"]
+    assert report.ok
+
+
+# ------------------------------------------------------- golden inventory --
+
+def test_programs_list_matches_committed_artifact():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = programs_main(["list"])
+    assert rc == 0
+    golden_path = os.path.join(REPO, "artifacts", "programs_list.txt")
+    with open(golden_path) as f:
+        golden = f.read()
+    assert buf.getvalue() == golden, (
+        "program inventory drifted from artifacts/programs_list.txt — "
+        "regenerate it: python -m pvraft_tpu.programs list > "
+        "artifacts/programs_list.txt")
+
+
+def test_describe_cli():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = programs_main(["describe", "serve_predict_fp32_b2048_bs1"])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "donate:      1" in out
+    assert "v5e:2x2x1" in out
+    assert "float32(1, 2048, 3)" in out  # the declared out geometry
+    assert programs_main(["describe", "no.such.program"]) == 2
+
+
+def test_verify_cli_subset():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = programs_main(["verify", "--only", "geometry.pairwise_sqdist"])
+    assert rc == 0
+    assert "[PASS] geometry.pairwise_sqdist" in buf.getvalue()
+
+
+# ------------------------------------------------- single-source guards ----
+
+def _code_int_literals(path):
+    """Every int literal in actual code. Docstrings/comments may still
+    *mention* geometry (they are str constants / not AST constants);
+    only executable code is held to the no-duplication rule."""
+    with open(path, "rb") as f:
+        tree = ast.parse(f.read(), filename=path)
+    lits = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            lits.append(node.value)
+    return lits
+
+
+@pytest.mark.parametrize("relpath", [
+    "pvraft_tpu/serve/engine.py",
+    "pvraft_tpu/serve/__main__.py",
+    "scripts/aot_readiness.py",
+])
+def test_no_duplicated_bucket_geometry_literals(relpath):
+    """The (bucket, batch, dtype) program tables live ONLY in
+    programs/geometries.py; the old enumeration sites must hold no
+    bucket-size literals of their own."""
+    banned = set(g.SERVE_DEFAULT_BUCKETS) | {g.FLAGSHIP_POINTS}
+    lits = _code_int_literals(os.path.join(REPO, relpath))
+    dupes = sorted(set(lits) & banned)
+    assert not dupes, (
+        f"{relpath} re-grows geometry literals {dupes}; declare them in "
+        f"pvraft_tpu/programs/geometries.py instead")
+
+
+def test_kernel_tag_covers_every_pallas_entry_point():
+    """The Mosaic-drift gate (`programs compile --tag kernel`) must
+    sweep both Pallas kernels, forward AND backward."""
+    names = {s.name for s in by_tag("kernel") if s.topology}
+    assert names == {"pallas_voxel_fwd", "pallas_voxel_grad",
+                     "pallas_fused_lookup_fwd", "pallas_fused_lookup_grad"}
+
+
+def test_bench_enumeration_mirrors_registry():
+    import dataclasses
+
+    import bench
+
+    from pvraft_tpu.config import ModelConfig
+
+    assert bench.VARIANTS == list(g.BENCH_VARIANTS)
+    names = [n for n, _ in g.BENCH_VARIANTS]
+    assert len(names) == len(set(names))
+    cfg_fields = {f.name for f in dataclasses.fields(ModelConfig)}
+    for _, kwargs in g.BENCH_VARIANTS:
+        unknown = set(kwargs) - cfg_fields
+        assert not unknown, f"bench variant kwargs not in ModelConfig: {unknown}"
+    for lever in g.AB_LEVERS:
+        if not lever.get("step_arg"):
+            assert lever["field"] in cfg_fields
+    # The audited A/B configuration arms every declared lever.
+    assert set(g.AB_PRIMARY) == {lv["field"] for lv in g.AB_LEVERS}
+
+
+def test_compile_topology_mismatch_is_loud(monkeypatch):
+    """Specs are certified for their DECLARED topology; a different
+    --topology must exit cleanly with the --force-topology hint, never
+    silently certify the wrong slice (and never traceback)."""
+    monkeypatch.setenv("PVRAFT_PALLAS_INTERPRET", "1")  # pin_cpu_host sets 0
+    monkeypatch.setenv("TPU_SKIP_MDS_QUERY", "1")
+    buf = io.StringIO()
+    with contextlib.redirect_stderr(buf):
+        try:
+            rc = programs_main(["compile", "--tag", "kernel",
+                                "--topology", "v5e:2x2x2"])
+        except Exception:  # pragma: no cover - the bug this test pins
+            pytest.fail("mismatched --topology must not raise")
+    err = buf.getvalue()
+    if "cannot build" in err:
+        pytest.skip("no TPU compile toolchain on this host")
+    assert rc == 2
+    assert "--force-topology" in err
+
+
+def test_catalog_import_is_jax_free():
+    """The registry's data surface (list CLI, bench's parent process)
+    must be readable before a backend is pinned: importing the full
+    catalog may not drag jax in (thunks stay lazy)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import pvraft_tpu.programs.catalog; "
+         "sys.exit(1 if 'jax' in sys.modules else 0)"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert out.returncode == 0, (
+        "importing pvraft_tpu.programs.catalog pulled in jax "
+        f"(stderr: {out.stderr[-300:]})")
+
+
+def test_profile_ladder_mirrors_registry():
+    from pvraft_tpu.profiling.step_profiler import MEASUREMENTS
+
+    prof = [s.name for s in by_tag("profile")]
+    assert prof == [f"profile.{m}" for m in MEASUREMENTS]
+
+
+def test_serve_program_key_enumeration():
+    assert list(g.serve_program_keys((32, 64), (2,))) == [(32, 2), (64, 2)]
+    assert g.predict_program_name(32, 2) == "predict_b32_bs2"
+    # ServeConfig defaults are the registry-declared production table.
+    from pvraft_tpu.serve.engine import ServeConfig
+
+    cfg = ServeConfig()
+    assert cfg.buckets == g.SERVE_DEFAULT_BUCKETS
+    assert cfg.batch_sizes == g.SERVE_DEFAULT_BATCH_SIZES
+
+
+def test_certified_serve_geometries_are_registered():
+    """Every SERVE_CERTIFIED (tag, bucket, batch) row has exactly one
+    registered AOT spec with the serve donation intent."""
+    specs = {s.name: s for s in by_tag("serve", "aot")}
+    want = {f"serve_predict_{tag}_b{bucket}_bs{bs}"
+            for tag, _, geoms in g.SERVE_CERTIFIED for bucket, bs in geoms}
+    assert set(specs) == want
+    for s in specs.values():
+        assert s.donate_argnums == g.SERVE_PREDICT_DONATE
+        assert s.topology == g.TOPOLOGY
