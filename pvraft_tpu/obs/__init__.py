@@ -1,6 +1,6 @@
 """Run telemetry subsystem.
 
-Three pillars (ISSUE 3 / ROADMAP "run-health telemetry"):
+Five pillars (ISSUEs 3 + 7 / ROADMAP "run-health telemetry"):
 
 * :mod:`pvraft_tpu.obs.monitors` — in-jit numerics monitors returned as
   an extra metrics leaf of the train step (``TrainConfig.telemetry``
@@ -10,7 +10,13 @@ Three pillars (ISSUE 3 / ROADMAP "run-health telemetry"):
   same stream out to TensorBoard and the text log;
 * :mod:`pvraft_tpu.obs.divergence` — trailing-window divergence
   detection and ``pvraft_snapshot/v1`` crash snapshots, replayed by
-  ``scripts/run_doctor.py``.
+  ``scripts/run_doctor.py``;
+* :mod:`pvraft_tpu.obs.trace` — request-level span tracing
+  (``pvraft_trace/v1``): per-stage decomposition of serve requests and
+  profiled train steps, riding the event stream as ``span`` records;
+* :mod:`pvraft_tpu.obs.slo` — the ``pvraft_slo/v1`` evidence report
+  joining loadgen artifacts with trace spans (per-(bucket, batch,
+  dtype) stage quantiles, max QPS under a p99 SLO).
 """
 
 from pvraft_tpu.obs.divergence import (  # noqa: F401
@@ -37,4 +43,20 @@ from pvraft_tpu.obs.monitors import (  # noqa: F401
     global_norm,
     nonfinite_count,
     telemetry_leaves,
+)
+from pvraft_tpu.obs.slo import (  # noqa: F401
+    SLO_SCHEMA,
+    build_slo_report,
+    validate_slo_report,
+    validate_slo_report_file,
+)
+from pvraft_tpu.obs.trace import (  # noqa: F401
+    SERVE_STAGES,
+    TRACE_SCHEMA,
+    RequestTrace,
+    Tracer,
+    collect_traces,
+    trace_from_step_profile,
+    validate_trace_artifact,
+    validate_trace_artifact_file,
 )

@@ -1,9 +1,12 @@
-"""CLI: validate ``pvraft_events/v1`` JSONL files.
+"""CLI: validate the obs subsystem's committed artifacts.
 
     python -m pvraft_tpu.obs validate artifacts/*.events.jsonl
+    python -m pvraft_tpu.obs validate-trace artifacts/*.trace.json
+    python -m pvraft_tpu.obs validate-slo artifacts/*.slo.json
 
-Exits non-zero on any schema problem — wired into ``scripts/lint.sh`` so
-a malformed committed event log fails the standing gate, same as a lint
+Each subcommand exits non-zero on any schema problem — all three are
+wired into ``scripts/lint.sh`` so a malformed committed event log,
+trace artifact or SLO report fails the standing gate, same as a lint
 finding.
 """
 
@@ -13,20 +16,15 @@ import argparse
 import sys
 
 from pvraft_tpu.obs.events import validate_events_file
+from pvraft_tpu.obs.slo import validate_slo_report_file
+from pvraft_tpu.obs.trace import validate_trace_artifact_file
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser("python -m pvraft_tpu.obs")
-    sub = parser.add_subparsers(dest="cmd", required=True)
-    val = sub.add_parser(
-        "validate", help="validate pvraft_events/v1 JSONL files")
-    val.add_argument("paths", nargs="+", help="event-log files")
-    args = parser.parse_args(argv)
-
+def _run(paths, validate) -> int:
     failed = 0
-    for path in args.paths:
+    for path in paths:
         try:
-            problems = validate_events_file(path)
+            problems = validate(path)
         except OSError as e:
             problems = [f"{path}: unreadable: {e}"]
         if problems:
@@ -36,6 +34,25 @@ def main(argv=None) -> int:
         else:
             print(f"{path}: OK")
     return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("python -m pvraft_tpu.obs")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    val = sub.add_parser(
+        "validate", help="validate pvraft_events/v1 JSONL files")
+    val.add_argument("paths", nargs="+", help="event-log files")
+    val.set_defaults(validate=validate_events_file)
+    tr = sub.add_parser(
+        "validate-trace", help="validate pvraft_trace/v1 artifacts")
+    tr.add_argument("paths", nargs="+", help="trace artifacts")
+    tr.set_defaults(validate=validate_trace_artifact_file)
+    slo = sub.add_parser(
+        "validate-slo", help="validate pvraft_slo/v1 reports")
+    slo.add_argument("paths", nargs="+", help="SLO reports")
+    slo.set_defaults(validate=validate_slo_report_file)
+    args = parser.parse_args(argv)
+    return _run(args.paths, args.validate)
 
 
 if __name__ == "__main__":

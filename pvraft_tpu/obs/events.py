@@ -36,6 +36,15 @@ covers training and serving telemetry:
                                                       [+ bucket, queue_depth]
     serve_shutdown served, rejected, drained
 
+Tracing events (``pvraft_tpu/obs/trace.py``) ride the same stream:
+
+    span        trace_id, span_id, name, start_ms, end_ms
+                [+ parent_id, attrs] — one request/step stage interval;
+                ``end_ms >= start_ms`` is enforced (a reversed span is a
+                clock bug, not data)
+    slo_report  path, slo_p99_ms    [+ max_qps_under_slo, programs,
+                requests] — pointer to a written pvraft_slo/v1 report
+
 Non-finite floats are encoded as the strings ``"NaN"``/``"Infinity"``/
 ``"-Infinity"`` (JSON has no spelling for them; a diverging run's whole
 point is to record them faithfully). ``validate_events`` accepts those
@@ -78,6 +87,10 @@ EVENT_TYPES: Dict[str, tuple] = {
                     ("queue_depth",)),
     "serve_reject": (("reason",), ("bucket", "queue_depth")),
     "serve_shutdown": (("served", "rejected", "drained"), ()),
+    "span": (("trace_id", "span_id", "name", "start_ms", "end_ms"),
+             ("parent_id", "attrs")),
+    "slo_report": (("path", "slo_p99_ms"),
+                   ("max_qps_under_slo", "programs", "requests")),
 }
 
 # serve_reject.reason vocabulary (validated like divergence.reason).
@@ -103,6 +116,9 @@ _NUMERIC_FIELDS = {
                     "queue_depth"),
     "serve_reject": ("bucket", "queue_depth"),
     "serve_shutdown": ("served", "rejected", "drained"),
+    "span": ("start_ms", "end_ms"),
+    "slo_report": ("slo_p99_ms", "max_qps_under_slo", "programs",
+                   "requests"),
 }
 
 _NONFINITE_STRINGS = ("NaN", "Infinity", "-Infinity")
@@ -185,6 +201,14 @@ def validate_event(record: Any, seq: Optional[int] = None) -> List[str]:
         problems.append(
             f"serve_reject: reason {record.get('reason')!r} must be one "
             f"of {SERVE_REJECT_REASONS}")
+    if etype == "span":
+        start, end = record.get("start_ms"), record.get("end_ms")
+        if (isinstance(start, (int, float)) and isinstance(end, (int, float))
+                and not isinstance(start, bool) and not isinstance(end, bool)
+                and end < start):
+            problems.append(
+                f"span: end_ms {end} < start_ms {start} (reversed span — "
+                "a clock bug, not data)")
     return problems
 
 
@@ -435,6 +459,12 @@ class RunTelemetry:
                       reason: str) -> None:
         self.events.emit("snapshot", epoch=epoch, step=step, path=path,
                          reason=reason)
+
+    def emit_span(self, **span: Any) -> None:
+        """One ``span`` record (pvraft_trace/v1 plane) — the train-side
+        twin of ``ServeTelemetry.emit_span``; the step profiler's stage
+        boundaries arrive here via ``obs.trace.trace_from_step_profile``."""
+        self.events.emit("span", **span)
 
     def close(self) -> None:
         self.events.close()

@@ -1,0 +1,384 @@
+"""Request-level span tracing: the ``pvraft_trace/v1`` plane.
+
+Every sampled serve request gets a trace id and a span tree
+
+    request
+      ├─ ingress          read + decode the HTTP body
+      ├─ validate         engine contract check (serve/engine.py)
+      ├─ queue_wait       enqueue -> worker dequeue
+      ├─ batch_form       dequeue -> dispatch (straggler wait, grouping)
+      ├─ device_execute   the AOT program incl. host fetch (bracketed by
+      │                   ``jax.profiler.TraceAnnotation`` so it lines up
+      │                   with XLA traces from ``/debug/trace``)
+      ├─ serialize        flow -> JSON/msgpack payload
+      └─ respond          socket write
+
+recorded from low-overhead ``time.monotonic()`` stamps at the existing
+hook points (``serve/server.py``, ``serve/batcher.py``). Train-side, the
+step profiler's telescoped stage boundaries map onto the SAME span
+schema (:func:`trace_from_step_profile`), so one decomposition format
+covers both workloads.
+
+Spans travel as ``pvraft_events/v1`` records of type ``span`` through
+the existing lock-serialized telemetry writers — no new sink, one
+validator. Timestamps are host-monotonic milliseconds: comparable
+within one process (one trace never crosses processes), deliberately
+NOT wall time (NTP steps would corrupt durations).
+
+Sampling is an explicit knob (:class:`Tracer`): 100% under loadgen
+(``scripts/serve_loadgen.py``), 1-in-N in production serve
+(``python -m pvraft_tpu.serve serve --trace_sample N``), 0 = off. The
+off path stamps nothing and allocates nothing per request beyond one
+``None`` check — tracing is pure host-side and cannot perturb any jaxpr
+(the ``engine.train_step[telemetry_off_jaxpr]`` guarantee is untouched).
+
+``collect_traces`` groups span events into the committed
+``pvraft_trace/v1`` artifact; ``validate_trace_artifact`` is its gate
+(wired into ``scripts/lint.sh`` via ``python -m pvraft_tpu.obs
+validate-trace``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+TRACE_SCHEMA = "pvraft_trace/v1"
+
+# The serve request decomposition, in pipeline order. The SLO report and
+# the trace-artifact completeness check both key on this tuple.
+SERVE_STAGES = (
+    "ingress", "validate", "queue_wait", "batch_form", "device_execute",
+    "serialize", "respond")
+
+# Root span names per workload (the "request" tree is the serve one; the
+# step profiler emits a "train_step" tree over its breakdown stages).
+SERVE_ROOT = "request"
+TRAIN_ROOT = "train_step"
+
+# The train-side stage vocabulary = the step profiler's telescoped
+# breakdown (single-sourced from the registry's pure-data geometry
+# module, so the two cannot drift and this import stays jax-free).
+# Together with SERVE_STAGES these are the only expected_stages a
+# pvraft_trace/v1 artifact may declare — the validator pins this, or a
+# hand-edited artifact could declare expected_stages=[] and mark
+# everything complete.
+from pvraft_tpu.programs.geometries import (  # noqa: E402
+    PROFILE_BREAKDOWN_STAGES as TRAIN_STAGES,
+)
+
+KNOWN_STAGE_SETS = (tuple(SERVE_STAGES), tuple(TRAIN_STAGES))
+
+# Span event fields (type "span" in pvraft_events/v1): required then
+# optional — mirrored in obs/events.py EVENT_TYPES.
+SPAN_REQUIRED = ("trace_id", "span_id", "name", "start_ms", "end_ms")
+SPAN_OPTIONAL = ("parent_id", "attrs")
+
+
+def _now_ms() -> float:
+    return time.monotonic() * 1000.0
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class RequestTrace:
+    """Monotonic stamp sheet for one traced request.
+
+    Worker threads ``mark`` stage intervals as they happen (list append
+    only — marks from the batcher worker happen-before the handler reads
+    them, ordered by the request's completion event); the handler thread
+    assembles the span tree once, at respond time, via :meth:`spans`.
+    """
+
+    __slots__ = ("trace_id", "t0", "_marks")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 t0: Optional[float] = None):
+        self.trace_id = trace_id or new_trace_id()
+        # Root start: monotonic SECONDS (converted to ms at build time,
+        # matching the time.monotonic() stamps the hook points take).
+        self.t0 = time.monotonic() if t0 is None else t0
+        self._marks: List[Tuple[str, float, float,
+                                Optional[Dict[str, Any]]]] = []
+
+    def mark(self, name: str, t_start: float, t_end: float,
+             attrs: Optional[Dict[str, Any]] = None) -> None:
+        """Record one stage interval (monotonic seconds)."""
+        self._marks.append((name, t_start, t_end, attrs))
+
+    def spans(self, t_end: Optional[float] = None,
+              root_name: str = SERVE_ROOT,
+              root_attrs: Optional[Dict[str, Any]] = None
+              ) -> List[Dict[str, Any]]:
+        """The span tree: a root span covering [t0, t_end] plus one child
+        per recorded mark, all parented to the root."""
+        t_end = time.monotonic() if t_end is None else t_end
+        root_id = uuid.uuid4().hex[:12]
+        root: Dict[str, Any] = {
+            "trace_id": self.trace_id, "span_id": root_id,
+            "name": root_name,
+            "start_ms": round(self.t0 * 1000.0, 3),
+            "end_ms": round(t_end * 1000.0, 3),
+        }
+        if root_attrs:
+            root["attrs"] = dict(root_attrs)
+        out = [root]
+        for i, (name, ts, te, attrs) in enumerate(self._marks):
+            span: Dict[str, Any] = {
+                "trace_id": self.trace_id,
+                "span_id": f"{root_id}.{i}",
+                "parent_id": root_id,
+                "name": name,
+                "start_ms": round(ts * 1000.0, 3),
+                "end_ms": round(te * 1000.0, 3),
+            }
+            if attrs:
+                span["attrs"] = dict(attrs)
+            out.append(span)
+        return out
+
+    def stage_durations_ms(self) -> Dict[str, float]:
+        """{stage: duration_ms} for the recorded marks (histogram feed)."""
+        return {name: round((te - ts) * 1000.0, 3)
+                for name, ts, te, _ in self._marks}
+
+
+class Tracer:
+    """Sampling decision + span emission, shared across handler threads.
+
+    ``sample_every=1`` traces everything (loadgen), ``N`` traces 1-in-N
+    (production serve), ``0`` disables tracing entirely. ``emit`` is the
+    span sink — typically ``ServeTelemetry.emit_span`` (lock-serialized)
+    or ``None`` to trace for metrics histograms only. ``sample_every``
+    is mutable on purpose: the overhead A/B toggles it on a live server
+    so off/on legs interleave within one process."""
+
+    def __init__(self, sample_every: int = 1,
+                 emit: Optional[Callable[..., Any]] = None):
+        if sample_every < 0:
+            raise ValueError("sample_every must be >= 0 (0 disables)")
+        self.sample_every = int(sample_every)
+        self.emit = emit
+        self._n = 0
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample_every > 0
+
+    def begin(self) -> Optional[RequestTrace]:
+        """A fresh :class:`RequestTrace` for a sampled request, else
+        ``None`` (the entire per-request cost of the unsampled path)."""
+        every = self.sample_every
+        if every <= 0:
+            return None
+        if every > 1:
+            with self._lock:
+                self._n += 1
+                if self._n % every:
+                    return None
+        return RequestTrace()
+
+    def emit_spans(self, spans: Sequence[Dict[str, Any]]) -> None:
+        if self.emit is None:
+            return
+        for span in spans:
+            self.emit(**span)
+
+
+# --------------------------------------------------------------- artifact --
+
+
+def trace_shape(spans: Sequence[Dict[str, Any]],
+                expected_stages: Sequence[str]
+                ) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]],
+                           Dict[str, float], bool]:
+    """The ONE definition of a trace's shape, shared by the artifact
+    builder, its validator, and the SLO join: ``(roots, orphans,
+    child stage durations ms, complete)``. *Complete* = exactly one
+    root (no ``parent_id``), no orphan spans (every ``parent_id``
+    resolves within the trace), every expected stage present among the
+    children."""
+    ids = {s.get("span_id") for s in spans}
+    roots = [s for s in spans if "parent_id" not in s]
+    orphans = [s for s in spans
+               if "parent_id" in s and s["parent_id"] not in ids]
+    stages = {s.get("name"): s.get("end_ms", 0.0) - s.get("start_ms", 0.0)
+              for s in spans if "parent_id" in s}
+    complete = (len(roots) == 1 and not orphans
+                and set(expected_stages) <= set(stages))
+    return roots, orphans, stages, complete
+
+
+def collect_traces(records: Sequence[Dict[str, Any]],
+                   expected_stages: Sequence[str] = SERVE_STAGES,
+                   source: str = "<events>") -> Dict[str, Any]:
+    """Group ``span`` events from a parsed ``pvraft_events/v1`` stream
+    into a ``pvraft_trace/v1`` artifact (completeness per
+    :func:`trace_shape`)."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        if rec.get("type") != "span":
+            continue
+        span = {k: rec[k] for k in (*SPAN_REQUIRED, *SPAN_OPTIONAL)
+                if k in rec}
+        by_trace.setdefault(rec["trace_id"], []).append(span)
+    traces, n_complete, n_orphans, n_spans = [], 0, 0, 0
+    for trace_id, spans in by_trace.items():
+        spans.sort(key=lambda s: (s["start_ms"], s["span_id"]))
+        roots, orphans, _, complete = trace_shape(spans, expected_stages)
+        n_complete += complete
+        n_orphans += len(orphans)
+        n_spans += len(spans)
+        entry: Dict[str, Any] = {
+            "trace_id": trace_id,
+            "root": roots[0]["name"] if len(roots) == 1 else None,
+            "complete": complete,
+            "spans": spans,
+        }
+        if len(roots) == 1:
+            entry["duration_ms"] = round(
+                roots[0]["end_ms"] - roots[0]["start_ms"], 3)
+        traces.append(entry)
+    traces.sort(key=lambda t: t["trace_id"])
+    return {
+        "schema": TRACE_SCHEMA,
+        "source": source,
+        "expected_stages": list(expected_stages),
+        "counts": {"traces": len(traces), "spans": n_spans,
+                   "complete": n_complete, "orphan_spans": n_orphans},
+        "traces": traces,
+    }
+
+
+def validate_trace_artifact(doc: Any,
+                            path: str = "<artifact>") -> List[str]:
+    """Schema problems of a ``pvraft_trace/v1`` artifact ([] = valid).
+    Recomputes completeness/orphan counts from the spans themselves so a
+    hand-edited ``complete`` flag cannot lie."""
+    if not isinstance(doc, dict):
+        return [f"{path}: artifact is {type(doc).__name__}, not an object"]
+    problems: List[str] = []
+    if doc.get("schema") != TRACE_SCHEMA:
+        problems.append(
+            f"{path}: schema {doc.get('schema')!r} != {TRACE_SCHEMA!r}")
+    for key in ("expected_stages", "counts", "traces"):
+        if key not in doc:
+            problems.append(f"{path}: missing field {key!r}")
+    if problems:
+        return problems
+    if not isinstance(doc["expected_stages"], list) or tuple(
+            doc["expected_stages"]) not in KNOWN_STAGE_SETS:
+        problems.append(
+            f"{path}: expected_stages {doc['expected_stages']!r} is not a "
+            f"known stage vocabulary (serve: {list(SERVE_STAGES)}, train: "
+            f"{list(TRAIN_STAGES)}) — completeness would be meaningless")
+        return problems
+    if not isinstance(doc["traces"], list) or not isinstance(
+            doc["counts"], dict):
+        # A malformed container must become a reported problem, not an
+        # unhandled traceback out of the lint gate.
+        problems.append(
+            f"{path}: traces must be a list and counts an object")
+        return problems
+    expected = set(doc["expected_stages"])
+    n_complete = n_orphans = n_spans = 0
+    for t_i, trace in enumerate(doc["traces"]):
+        where = f"{path}: traces[{t_i}]"
+        if (not isinstance(trace, dict)
+                or not isinstance(trace.get("spans"), list)
+                or not all(isinstance(s, dict) for s in trace["spans"])):
+            problems.append(
+                f"{where}: not an object with a list of span objects")
+            continue
+        spans = trace["spans"]
+        for s_i, span in enumerate(spans):
+            for key in SPAN_REQUIRED:
+                if key not in span:
+                    problems.append(
+                        f"{where}.spans[{s_i}]: missing {key!r}")
+            if "start_ms" in span and "end_ms" in span and (
+                    span["end_ms"] < span["start_ms"]):
+                problems.append(
+                    f"{where}.spans[{s_i}]: end_ms {span['end_ms']} < "
+                    f"start_ms {span['start_ms']}")
+            if span.get("trace_id") != trace.get("trace_id"):
+                problems.append(
+                    f"{where}.spans[{s_i}]: trace_id "
+                    f"{span.get('trace_id')!r} != {trace.get('trace_id')!r}")
+        roots, orphans, stages, complete = trace_shape(spans, expected)
+        if bool(trace.get("complete")) != complete:
+            problems.append(
+                f"{where}: complete={trace.get('complete')!r} but spans "
+                f"say {complete} (roots={len(roots)}, "
+                f"orphans={len(orphans)}, "
+                f"missing={sorted(expected - set(stages))})")
+        n_complete += complete
+        n_orphans += len(orphans)
+        n_spans += len(spans)
+    want = {"traces": len(doc["traces"]), "spans": n_spans,
+            "complete": n_complete, "orphan_spans": n_orphans}
+    if doc["counts"] != want:
+        problems.append(
+            f"{path}: counts {doc['counts']} != recomputed {want}")
+    return problems
+
+
+def validate_trace_artifact_file(path: str) -> List[str]:
+    import json
+
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable: {e}"]
+    return validate_trace_artifact(doc, path=path)
+
+
+# ----------------------------------------------------------- train bridge --
+
+
+def trace_from_step_profile(record: Dict[str, Any],
+                            trace_id: Optional[str] = None
+                            ) -> List[Dict[str, Any]]:
+    """Map a ``pvraft_step_profile/v1`` record's telescoped per-stage
+    breakdown onto the span schema: one ``train_step`` root of
+    ``total_step_s`` plus consecutive child spans in breakdown order
+    (the stages telescope, so laying them end-to-end IS the measured
+    decomposition). Gives train and serve the same trace format without
+    re-instrumenting the jitted step (which would break the
+    telemetry-off jaxpr guarantee)."""
+    if "breakdown_s" not in record or "total_step_s" not in record:
+        raise ValueError(
+            "step-profile record has no breakdown (incomplete ladder); "
+            "cannot build a trace")
+    tid = trace_id or new_trace_id()
+    root_id = uuid.uuid4().hex[:12]
+    spans: List[Dict[str, Any]] = [{
+        "trace_id": tid, "span_id": root_id, "name": TRAIN_ROOT,
+        "start_ms": 0.0,
+        "end_ms": round(record["total_step_s"] * 1000.0, 3),
+        "attrs": {"platform": record.get("platform"),
+                  "variant": record.get("variant"),
+                  "points": record.get("points"),
+                  "batch": record.get("batch"),
+                  "iters": record.get("iters")},
+    }]
+    cursor = 0.0
+    for i, (stage, sec) in enumerate(record["breakdown_s"].items()):
+        # Sub-noise stages can telescope slightly negative (validator
+        # tolerance); clamp so the span stays schema-legal while the
+        # profile artifact keeps the signed truth.
+        dur_ms = max(0.0, sec * 1000.0)
+        spans.append({
+            "trace_id": tid, "span_id": f"{root_id}.{i}",
+            "parent_id": root_id, "name": stage,
+            "start_ms": round(cursor, 3),
+            "end_ms": round(cursor + dur_ms, 3),
+        })
+        cursor += dur_ms
+    return spans

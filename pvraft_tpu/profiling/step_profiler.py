@@ -30,6 +30,12 @@ Runs identically on CPU and TPU (the host-fetch sync is what makes the
 TPU numbers honest; on CPU it is merely free). Individual derived stages
 can go slightly negative under timing noise — the validator checks the
 telescoped sum, which is exact by construction, and flags negatives.
+
+The telescoped breakdown doubles as a trace: ``obs.trace.
+trace_from_step_profile`` maps it onto the ``pvraft_trace/v1`` span
+schema (one ``train_step`` root, consecutive stage spans), so the serve
+request plane and the train step share one decomposition format
+(``scripts/step_profile.py --events``).
 """
 
 from __future__ import annotations
@@ -45,13 +51,17 @@ SCHEMA_VERSION = "pvraft_step_profile/v1"
 # ``pvraft_tpu/programs/catalog.py`` registers one ``profile.<stage>``
 # ProgramSpec per entry (without importing this jax-heavy module) so the
 # registry's verify gate traces the same ladder the profiler times.
-from pvraft_tpu.programs.geometries import PROFILE_LADDER_STAGES
+from pvraft_tpu.programs.geometries import (
+    PROFILE_BREAKDOWN_STAGES,
+    PROFILE_LADDER_STAGES,
+)
 
 MEASUREMENTS = PROFILE_LADDER_STAGES
 
 # Derived per-stage breakdown; telescopes to measurements["step"]["sec"].
-BREAKDOWN_STAGES = ("encoder", "corr_init", "gru_forward", "backward",
-                    "optimizer")
+# Declared in geometries (pure data) so the trace plane's validator can
+# share the vocabulary jax-free.
+BREAKDOWN_STAGES = PROFILE_BREAKDOWN_STAGES
 
 
 def derive_breakdown(measurements: Dict[str, dict]) -> Dict[str, float]:

@@ -77,6 +77,14 @@ AB_PRIMARY = {"scatter_free_vjp": True, "remat_policy": "dots",
 PROFILE_LADDER_STAGES = ("encoder", "corr_cum", "fwd1", "fwdN", "fwdbwd",
                          "step")
 
+# The derived per-stage breakdown the ladder telescopes into
+# (step_profiler.BREAKDOWN_STAGES is this tuple). Also the train-side
+# stage vocabulary of the pvraft_trace/v1 span plane (obs/trace.py
+# TRAIN_STAGES) — here, not in the profiler, so the jax-free trace
+# validator can pin it without dragging jax into its import chain.
+PROFILE_BREAKDOWN_STAGES = ("encoder", "corr_init", "gru_forward",
+                            "backward", "optimizer")
+
 # --- serve geometry --------------------------------------------------------
 
 # Default production bucket table (ServeConfig defaults and the serve CLI

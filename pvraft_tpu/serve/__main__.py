@@ -63,10 +63,14 @@ def _cmd_serve(args) -> int:
     server = build_service(engine, max_wait_ms=args.max_wait_ms,
                            queue_depth=args.queue_depth, host=args.host,
                            port=args.port, telemetry=telemetry,
-                           quiet=not args.verbose)
+                           quiet=not args.verbose,
+                           trace_sample_every=args.trace_sample,
+                           trace_dir=args.trace_dir)
     server.start()
     print(f"[serve] listening on http://{server.host}:{server.port} "
-          f"(/predict /healthz /metrics)", flush=True)
+          f"(/predict /healthz /metrics /debug/trace); tracing "
+          f"{'1-in-' + str(args.trace_sample) if args.trace_sample else 'off'}",
+          flush=True)
     try:
         import time
 
@@ -126,6 +130,12 @@ def main(argv=None) -> int:
     srv.add_argument("--queue_depth", type=int, default=64)
     srv.add_argument("--events", default="",
                      help="pvraft_events/v1 JSONL path for serve telemetry")
+    srv.add_argument("--trace_sample", type=int, default=16,
+                     help="trace 1-in-N requests (1 = all, 0 = off); "
+                          "spans ride the --events stream")
+    srv.add_argument("--trace_dir", default="",
+                     help="base directory for /debug/trace XLA profile "
+                          "windows (default: a temp dir)")
     srv.add_argument("--platform", default="",
                      help="force a jax platform (e.g. cpu)")
     srv.add_argument("--verbose", action="store_true",

@@ -55,7 +55,7 @@ class BatcherConfig:
 
 class _Request:
     __slots__ = ("pc1", "pc2", "result", "error", "done", "t_enqueue",
-                 "abandoned")
+                 "abandoned", "trace", "bucket", "t_dequeue")
 
     def __init__(self, pc1: np.ndarray, pc2: np.ndarray):
         self.pc1 = pc1
@@ -64,6 +64,13 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
         self.abandoned = False
+        # Trace plane (obs/trace.py): the handler attaches a
+        # RequestTrace for sampled requests; workers stamp dequeue /
+        # dispatch times on it. None = unsampled (the common case) —
+        # every hook below is a single attribute check.
+        self.trace = None
+        self.bucket: Optional[int] = None
+        self.t_dequeue: Optional[float] = None
 
     def resolve(self, result: np.ndarray) -> None:
         self.result = result
@@ -129,18 +136,30 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- intake --
 
-    def submit(self, pc1: np.ndarray, pc2: np.ndarray) -> _Request:
+    def submit(self, pc1: np.ndarray, pc2: np.ndarray,
+               trace=None) -> _Request:
         """Validate and enqueue one request; returns a handle whose
         ``wait()`` yields the un-padded (n1, 3) flow. Raises
         :class:`RequestError` (contract), :class:`QueueFullError`
-        (backpressure) or :class:`ShutdownError` (draining)."""
+        (backpressure) or :class:`ShutdownError` (draining). ``trace``
+        is an optional ``obs.trace.RequestTrace``: the validate stage is
+        marked here, the queue/dispatch stages by the workers."""
+        t_validate = time.monotonic()
         try:
             bucket = self.engine.validate_request(pc1, pc2)
         except RequestError as e:
+            if trace is not None:
+                trace.mark("validate", t_validate, time.monotonic(),
+                           attrs={"rejected": e.reason})
             self._reject(e.reason)
             raise
+        if trace is not None:
+            trace.mark("validate", t_validate, time.monotonic())
         req = _Request(np.asarray(pc1, np.float32),
                        np.asarray(pc2, np.float32))
+        req.trace = trace
+        req.bucket = bucket
+        n_points = max(pc1.shape[0], pc2.shape[0])
         req.t_enqueue = time.monotonic()
         # Check-and-enqueue is atomic w.r.t. shutdown (see _intake_lock):
         # an enqueue here happens-before the stop flag is set, so the
@@ -165,7 +184,7 @@ class MicroBatcher:
                 # would see responses_total > requests_total. Counter
                 # increments only — no telemetry I/O under the lock.
                 if self.metrics is not None:
-                    self.metrics.record_submit(bucket)
+                    self.metrics.record_submit(bucket, n_points=n_points)
                 self._queues[bucket].put_nowait(req)
         if reject == "shutdown":
             self._reject("shutdown")
@@ -219,16 +238,19 @@ class MicroBatcher:
             first = q.get(timeout=0.05)
         except queue.Empty:
             return []
+        first.t_dequeue = time.monotonic()
         group = [first]
-        deadline = time.monotonic() + self.cfg.max_wait_ms / 1000.0
+        deadline = first.t_dequeue + self.cfg.max_wait_ms / 1000.0
         while len(group) < self.cfg.max_batch:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             try:
-                group.append(q.get(timeout=remaining))
+                req = q.get(timeout=remaining)
             except queue.Empty:
                 break
+            req.t_dequeue = time.monotonic()
+            group.append(req)
         return group
 
     def _worker(self, bucket: int) -> None:
@@ -273,6 +295,29 @@ class MicroBatcher:
         # between this check and the waiter reading the result — is the
         # benign one noted in _Request.wait.
         live = [(r, f) for r, f in zip(group, flows) if not r.abandoned]
+        bs = self.engine.batch_size_for(len(group))
+        for r, _ in live:
+            # Re-read trace/abandoned per request: a waiter that 504'd
+            # since `live` was computed is assembling its (partial) span
+            # tree RIGHT NOW — skip marking it rather than race the
+            # iteration. (The residual window — abandonment landing
+            # mid-loop — only under-fills an error trace's tree, which
+            # is the documented shape of error-outcome traces.)
+            tr = r.trace
+            if tr is None or r.abandoned:
+                continue
+            # queue_wait: enqueue -> dequeue; batch_form: dequeue ->
+            # dispatch (straggler wait + grouping); device_execute: the
+            # AOT program incl. host fetch. For served requests the
+            # marks land before resolve() below, so the handler thread
+            # (which assembles spans after wait() returns) is
+            # ordered-after them.
+            t_dq = r.t_dequeue if r.t_dequeue is not None else t0
+            tr.mark("queue_wait", r.t_enqueue, t_dq)
+            tr.mark("batch_form", t_dq, t0)
+            tr.mark("device_execute", t0, now,
+                    attrs={"bucket": bucket, "batch": bs,
+                           "n": len(group)})
         latencies = [(now - r.t_enqueue) * 1000.0 for r, _ in live]
         # Account BEFORE resolving: resolve() unblocks the HTTP replies,
         # and a client that immediately polls /metrics must see counts
@@ -283,7 +328,6 @@ class MicroBatcher:
                 self._drained += len(live)
         # Fill reflects the dispatch itself (how full the AOT program's
         # slots were), so it stays keyed on the dispatched group size.
-        bs = self.engine.batch_size_for(len(group))
         fill = len(group) / bs
         if self.metrics is not None:
             self.metrics.record_batch(len(live), fill, latencies)

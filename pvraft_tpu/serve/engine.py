@@ -303,10 +303,18 @@ class InferenceEngine:
             v1.append(v1[0])
             v2.append(v2[0])
         prog = self._programs[(bucket, bs)]
-        flow = np.asarray(prog(
-            self.params,
-            np.stack(rows1), np.stack(rows2),
-            np.stack(v1), np.stack(v2)))
+        import jax
+
+        # The annotation brackets execute + host fetch (np.asarray is
+        # the sync), so the trace plane's device_execute span lines up
+        # with this named region in an XLA profile captured via
+        # /debug/trace.
+        with jax.profiler.TraceAnnotation(
+                f"serve_device_execute_b{bucket}_bs{bs}"):
+            flow = np.asarray(prog(
+                self.params,
+                np.stack(rows1), np.stack(rows2),
+                np.stack(v1), np.stack(v2)))
         return [flow[i, : requests[i][0].shape[0]]
                 for i in range(len(requests))]
 

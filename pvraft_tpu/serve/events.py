@@ -63,6 +63,22 @@ class ServeTelemetry:
         with self._lock:
             self.events.emit("serve_reject", **fields)
 
+    def emit_trace_window(self, action: str, trace_dir: str) -> None:
+        """An on-demand ``/debug/trace`` XLA profile window. The shared
+        ``trace_window`` event type requires an epoch; serving has none,
+        so -1 marks "not an epoch-indexed capture"."""
+        with self._lock:
+            self.events.emit("trace_window", action=action,
+                             trace_dir=trace_dir, epoch=-1)
+
+    def emit_span(self, **span: Any) -> None:
+        """One ``span`` record from the request trace plane
+        (``obs/trace.py``): emitted by the handler thread after the
+        response is written, so tracing never sits between the engine
+        and the client."""
+        with self._lock:
+            self.events.emit("span", **span)
+
     def emit_shutdown(self, served: int, rejected: int,
                       drained: int) -> None:
         with self._lock:

@@ -15,7 +15,17 @@ writes a latency/throughput artifact:
 Client-side latency quantiles are computed from the raw per-request
 samples (exact, unlike the server histogram's bucketed upper bounds).
 ``validate_load_artifact`` is the schema gate for the committed
-artifact (wired into ``scripts/lint.sh``)."""
+artifact (wired into ``scripts/lint.sh``).
+
+Schema-additive since the trace plane (``obs/trace.py``): the server's
+``X-Pvraft-Trace`` response header is recorded per request, so
+
+    "per_request": [{"status", "ms", "n", "trace_id"}, ...]
+    "request_points": {"edges": [...], "counts": [...]}
+
+join the loadgen artifact to span events by trace id —
+``scripts/slo_report.py`` builds the ``pvraft_slo/v1`` report from
+exactly that join. Both fields are optional for older artifacts."""
 
 from __future__ import annotations
 
@@ -82,6 +92,38 @@ def validate_load_artifact(doc: Any,
     for key in ("throughput_rps", "duration_s"):
         if key in doc and not isinstance(doc[key], (int, float)):
             problems.append(f"{path}: {key} must be a number")
+    # Additive trace-plane fields (absent in pre-trace artifacts).
+    if "per_request" in doc:
+        if not isinstance(doc["per_request"], list):
+            problems.append(f"{path}: per_request must be a list")
+        else:
+            for i, r in enumerate(doc["per_request"]):
+                if not isinstance(r, dict) or not isinstance(
+                        r.get("status"), int):
+                    problems.append(
+                        f"{path}: per_request[{i}] must carry an int "
+                        f"status")
+                elif r.get("trace_id") is not None and not isinstance(
+                        r["trace_id"], str):
+                    problems.append(
+                        f"{path}: per_request[{i}].trace_id must be a "
+                        f"string or null")
+            if isinstance(reqs, dict) and isinstance(
+                    reqs.get("total"), int) and len(
+                    doc["per_request"]) != reqs["total"]:
+                problems.append(
+                    f"{path}: per_request has {len(doc['per_request'])} "
+                    f"entries, requests.total is {reqs['total']}")
+    if "request_points" in doc:
+        rp = doc["request_points"]
+        if (not isinstance(rp, dict)
+                or not isinstance(rp.get("edges"), list)
+                or not isinstance(rp.get("counts"), list)
+                or len(rp.get("counts", [])) !=
+                len(rp.get("edges", [])) + 1):
+            problems.append(
+                f"{path}: request_points must carry edges + counts with "
+                f"len(counts) == len(edges) + 1")
     return problems
 
 
@@ -104,7 +146,8 @@ def _post_json(host: str, port: int, path: str, doc: Dict[str, Any],
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         body = resp.read()
-        return {"status": resp.status, "body": json.loads(body)}
+        return {"status": resp.status, "body": json.loads(body),
+                "trace_id": resp.getheader("X-Pvraft-Trace")}
     finally:
         conn.close()
 
@@ -137,11 +180,13 @@ def run_load(
     # Pre-generate the request payloads so client threads measure the
     # server, not numpy.
     payloads = []
-    for i in range(n_requests):
+    sizes = []          # recorded at build time: per_request[].n and the
+    for i in range(n_requests):  # size histogram report what was DRIVEN
         n = point_counts[i % len(point_counts)]
         pc1 = rng.uniform(-coord_scale, coord_scale, (n, 3)).astype(np.float32)
         flow = rng.normal(0, 0.05 * coord_scale, (n, 3)).astype(np.float32)
         payloads.append({"pc1": pc1.tolist(), "pc2": (pc1 + flow).tolist()})
+        sizes.append(n)
 
     results: List[Dict[str, Any]] = [None] * n_requests  # type: ignore
     cursor = {"i": 0}
@@ -159,9 +204,10 @@ def run_load(
                 r = _post_json(server.host, server.port, "/predict",
                                payloads[i])
                 ms = (time.monotonic() - t0) * 1000.0
-                results[i] = {"status": r["status"], "ms": ms}
+                results[i] = {"status": r["status"], "ms": ms,
+                              "trace_id": r["trace_id"]}
             except Exception as e:  # noqa: BLE001 — a client error is data
-                results[i] = {"status": -1, "ms": None,
+                results[i] = {"status": -1, "ms": None, "trace_id": None,
                               "error": f"{type(e).__name__}: {e}"}
 
     threads = [threading.Thread(target=client, daemon=True)
@@ -182,10 +228,25 @@ def run_load(
               if r["status"] not in (200, 400, 413, 503, 504)]
     lat = sorted(r["ms"] for r in ok)
 
+    # The SAME nearest-rank estimator the SLO report uses (its join
+    # reconciles client quantiles against span quantiles — reuse, not a
+    # parallel implementation that could drift).
+    from pvraft_tpu.obs.slo import exact_quantile
+
     def pct(q: float) -> Optional[float]:
-        if not lat:
-            return None
-        return round(lat[min(len(lat) - 1, int(q * len(lat)))], 3)
+        v = exact_quantile(lat, q)
+        return None if v is None else round(v, 3)
+
+    # Client-side request-size histogram on the server's exposed edges
+    # (pvraft_serve_request_points): the artifact records what sizes
+    # were DRIVEN, the server's histogram what it SAW — the pair must
+    # reconcile (same histogram class, same bucketing rule), and either
+    # seeds adaptive bucket geometry offline.
+    from pvraft_tpu.serve.metrics import POINT_EDGES, LatencyHistogram
+
+    size_hist = LatencyHistogram(edges=POINT_EDGES)
+    for n in sizes:
+        size_hist.observe(float(n))
 
     return {
         "requests": {"total": n_requests, "ok": len(ok),
@@ -197,5 +258,13 @@ def run_load(
         },
         "throughput_rps": round(len(ok) / duration, 3) if duration else 0.0,
         "duration_s": round(duration, 3),
+        "per_request": [
+            {"status": r["status"],
+             "ms": round(r["ms"], 3) if r["ms"] is not None else None,
+             "n": sizes[i],
+             "trace_id": r.get("trace_id")}
+            for i, r in enumerate(results)],
+        "request_points": {"edges": [int(e) for e in POINT_EDGES],
+                           "counts": list(size_hist.counts)},
         "server_metrics": _get_json(server.host, server.port, "/metrics"),
     }

@@ -6,12 +6,24 @@ histogram uses fixed log-spaced bucket edges (ms) so the snapshot is
 bounded-size no matter how long the server runs; quantiles reported from
 it are upper-bound estimates (the edge of the bucket the quantile falls
 in) — honest for SLO checks, not sub-bucket precise.
+
+Two exposition formats off the same store:
+
+* JSON (default ``/metrics``) — the pre-existing snapshot, shape-frozen
+  (``tests/test_trace.py`` pins the serialized bytes): dashboards built
+  against it keep parsing.
+* Prometheus text 0.0.4 (``/metrics?format=prometheus``,
+  :func:`render_prometheus`) — everything in the JSON snapshot PLUS the
+  per-(bucket, stage) latency histograms fed by the trace plane and the
+  request-size histogram (the seed data for adaptive bucket geometry,
+  ROADMAP item 3). New series appear only here so the JSON contract
+  never grows by accident.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # Fixed histogram edges (ms): latency falls in the first bucket whose
 # edge is >= the sample; the final bucket is unbounded.
@@ -20,20 +32,30 @@ LATENCY_EDGES_MS = (
     1000.0, 2000.0, 5000.0, 10000.0, 30000.0,
 )
 
+# Request-size (points per cloud) edges: power-of-two ladder spanning the
+# certified bucket range — the live histogram adaptive bucket geometry
+# will be learned from.
+POINT_EDGES = (
+    32.0, 64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0, 8192.0,
+    16384.0, 32768.0,
+)
+
 
 class LatencyHistogram:
     """Fixed-edge histogram with count/sum/max (no lock: the owner
-    serializes access)."""
+    serializes access). ``edges`` defaults to the latency ladder; the
+    request-size histogram reuses the class with point-count edges."""
 
-    def __init__(self):
-        self.counts = [0] * (len(LATENCY_EDGES_MS) + 1)
+    def __init__(self, edges: Sequence[float] = LATENCY_EDGES_MS):
+        self.edges = tuple(edges)
+        self.counts = [0] * (len(self.edges) + 1)
         self.count = 0
         self.sum_ms = 0.0
         self.max_ms = 0.0
 
     def observe(self, ms: float) -> None:
         i = 0
-        while i < len(LATENCY_EDGES_MS) and ms > LATENCY_EDGES_MS[i]:
+        while i < len(self.edges) and ms > self.edges[i]:
             i += 1
         self.counts[i] += 1
         self.count += 1
@@ -50,8 +72,8 @@ class LatencyHistogram:
         for i, c in enumerate(self.counts):
             seen += c
             if seen >= target and c:
-                if i < len(LATENCY_EDGES_MS):
-                    return LATENCY_EDGES_MS[i]
+                if i < len(self.edges):
+                    return self.edges[i]
                 return self.max_ms
         return self.max_ms
 
@@ -63,7 +85,7 @@ class LatencyHistogram:
             "p50_ms": self.quantile(0.50),
             "p95_ms": self.quantile(0.95),
             "p99_ms": self.quantile(0.99),
-            "bucket_edges_ms": list(LATENCY_EDGES_MS),
+            "bucket_edges_ms": list(self.edges),
             "bucket_counts": list(self.counts),
         }
 
@@ -81,12 +103,32 @@ class ServeMetrics:
         self.per_bucket_requests: Dict[int, int] = {int(b): 0
                                                     for b in buckets}
         self.latency = LatencyHistogram()
+        # Prometheus-only series (the JSON snapshot's shape is frozen):
+        # live request sizes (points per cloud) + per-(bucket, stage)
+        # latency fed from traced requests (obs/trace.py).
+        self.request_points = LatencyHistogram(edges=POINT_EDGES)
+        self.stage_latency: Dict[Tuple[int, str], LatencyHistogram] = {}
 
-    def record_submit(self, bucket: int) -> None:
+    def record_submit(self, bucket: int,
+                      n_points: Optional[int] = None) -> None:
         with self._lock:
             self.requests_total += 1
             self.per_bucket_requests[int(bucket)] = (
                 self.per_bucket_requests.get(int(bucket), 0) + 1)
+            if n_points is not None:
+                self.request_points.observe(float(n_points))
+
+    def record_stages(self, bucket: int,
+                      stage_ms: Dict[str, float]) -> None:
+        """Per-stage latencies of one traced request (sampled — the
+        histograms cover the traced subset, which loadgen makes 100%)."""
+        with self._lock:
+            for stage, ms in stage_ms.items():
+                hist = self.stage_latency.get((int(bucket), stage))
+                if hist is None:
+                    hist = LatencyHistogram()
+                    self.stage_latency[(int(bucket), stage)] = hist
+                hist.observe(ms)
 
     def record_reject(self, reason: str) -> None:
         with self._lock:
@@ -129,3 +171,124 @@ class ServeMetrics:
         if queue_depths is not None:
             snap["queue_depth"] = {str(k): v for k, v in queue_depths.items()}
         return snap
+
+    def prometheus(self, queue_depths: Optional[Dict[int, int]] = None
+                   ) -> str:
+        """Prometheus text exposition 0.0.4 of every counter, gauge and
+        histogram — serve with ``Content-Type: text/plain;
+        version=0.0.4``. Rendered under the one metrics lock so the
+        scrape is as consistent as the JSON snapshot."""
+        with self._lock:
+            return render_prometheus(self, queue_depths)
+
+
+# ------------------------------------------------ Prometheus exposition --
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def _prom_escape(value: Any) -> str:
+    return str(value).replace("\\", r"\\").replace(
+        "\n", r"\n").replace('"', r'\"')
+
+
+def _prom_labels(labels: Optional[Dict[str, Any]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_prom_escape(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _prom_num(v: float) -> str:
+    # Prometheus floats: integers render bare, floats repr-style.
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+class _PromDoc:
+    """Accumulates one exposition document; HELP/TYPE precede each
+    metric family exactly once (the format's requirement)."""
+
+    def __init__(self):
+        self.lines: List[str] = []
+
+    def family(self, name: str, mtype: str, help_text: str) -> None:
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {mtype}")
+
+    def sample(self, name: str, value: float,
+               labels: Optional[Dict[str, Any]] = None) -> None:
+        self.lines.append(f"{name}{_prom_labels(labels)} {_prom_num(value)}")
+
+    def histogram(self, name: str, hist: LatencyHistogram,
+                  labels: Optional[Dict[str, Any]] = None) -> None:
+        """Cumulative ``_bucket{le=}`` series + ``_sum``/``_count`` for
+        one labeled histogram (family() is the caller's job — labeled
+        histograms share one family)."""
+        cum = 0
+        for edge, count in zip(hist.edges, hist.counts):
+            cum += count
+            le = dict(labels or {})
+            le["le"] = _prom_num(float(edge))
+            self.sample(f"{name}_bucket", cum, le)
+        le = dict(labels or {})
+        le["le"] = "+Inf"
+        self.sample(f"{name}_bucket", cum + hist.counts[-1], le)
+        self.sample(f"{name}_sum", round(hist.sum_ms, 6), labels)
+        self.sample(f"{name}_count", hist.count, labels)
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_prometheus(metrics: "ServeMetrics",
+                      queue_depths: Optional[Dict[int, int]] = None) -> str:
+    """The ``pvraft_serve_*`` exposition. Caller must hold the metrics
+    lock (use :meth:`ServeMetrics.prometheus`)."""
+    doc = _PromDoc()
+    doc.family("pvraft_serve_requests_total", "counter",
+               "Requests received (accepted + rejected).")
+    doc.sample("pvraft_serve_requests_total", metrics.requests_total)
+    doc.family("pvraft_serve_responses_total", "counter",
+               "Successful predict responses.")
+    doc.sample("pvraft_serve_responses_total", metrics.responses_total)
+    doc.family("pvraft_serve_rejected_total", "counter",
+               "Rejected or failed requests by serve_reject reason.")
+    for reason, count in sorted(metrics.rejected.items()):
+        doc.sample("pvraft_serve_rejected_total", count,
+                   {"reason": reason})
+    doc.family("pvraft_serve_batches_total", "counter",
+               "Dispatched micro-batches.")
+    doc.sample("pvraft_serve_batches_total", metrics.batches_total)
+    doc.family("pvraft_serve_batch_fill_sum", "counter",
+               "Sum of per-batch fill ratios (divide by "
+               "pvraft_serve_batches_total for the mean).")
+    doc.sample("pvraft_serve_batch_fill_sum",
+               round(metrics.batch_fill_sum, 6))
+    doc.family("pvraft_serve_bucket_requests_total", "counter",
+               "Accepted requests per point-count bucket.")
+    for bucket, count in sorted(metrics.per_bucket_requests.items()):
+        doc.sample("pvraft_serve_bucket_requests_total", count,
+                   {"bucket": bucket})
+    if queue_depths is not None:
+        doc.family("pvraft_serve_queue_depth", "gauge",
+                   "Pending requests per bucket queue.")
+        for bucket, depth in sorted(queue_depths.items()):
+            doc.sample("pvraft_serve_queue_depth", depth,
+                       {"bucket": bucket})
+    doc.family("pvraft_serve_latency_ms", "histogram",
+               "End-to-end request latency (enqueue to resolve), ms.")
+    doc.histogram("pvraft_serve_latency_ms", metrics.latency)
+    doc.family("pvraft_serve_request_points", "histogram",
+               "Requested points per cloud (adaptive-bucket seed data).")
+    doc.histogram("pvraft_serve_request_points", metrics.request_points)
+    doc.family("pvraft_serve_stage_latency_ms", "histogram",
+               "Per-stage latency of traced requests by (bucket, stage) "
+               "— stages: ingress validate queue_wait batch_form "
+               "device_execute serialize respond.")
+    for (bucket, stage), hist in sorted(metrics.stage_latency.items()):
+        doc.histogram("pvraft_serve_stage_latency_ms", hist,
+                      {"bucket": bucket, "stage": stage})
+    return doc.render()

@@ -14,27 +14,47 @@ Endpoints:
       application/msgpack`` the body is a msgpack map whose ``pc1``/
       ``pc2`` values are raw little-endian float32 bytes (n*3 each);
       the response mirrors that (``flow`` as raw f32 bytes) — the
-      fast path, no float->decimal round-trips.
+      fast path, no float->decimal round-trips. Sampled requests
+      (``--trace_sample``) carry an ``X-Pvraft-Trace`` response header
+      with the trace id; their span tree lands on the event stream.
       Errors: 400 contract violations, 413 too large for every bucket,
       503 queue full / shutting down (explicit backpressure),
       504 predict timeout.
   ``GET /healthz``
       ``{"status": "ok", buckets, batch_sizes, programs: [...compile
-      report...]}`` — serving readiness including the AOT evidence.
+      report...], telemetry: {events_path, tracing, trace_sample_every,
+      trace_dir}}`` — serving readiness including the AOT evidence and
+      the live telemetry/tracing configuration (an operator confirms
+      tracing is on without grepping logs).
   ``GET /metrics``
-      JSON counters: request/response/reject counts, per-bucket queue
-      depth, batch-fill ratio, latency histogram (serve/metrics.py).
+      JSON counters (default, shape-frozen): request/response/reject
+      counts, per-bucket queue depth, batch-fill ratio, latency
+      histogram (serve/metrics.py). ``?format=prometheus`` renders the
+      same store as Prometheus text 0.0.4 (``pvraft_serve_*``) plus the
+      trace-fed per-(bucket, stage) histograms and the request-size
+      histogram.
+  ``GET /debug/trace?seconds=N``
+      Captures a ``jax.profiler.trace`` window of N seconds to a fresh
+      directory under ``trace_dir`` and returns its path — an XLA
+      profile from a LIVE server, no restart. One capture at a time
+      (409 while busy); start/stop ride the event stream as
+      ``trace_window`` records.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import tempfile
 import threading
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pvraft_tpu.obs.trace import Tracer
 from pvraft_tpu.serve.batcher import (
     BatcherConfig,
     MicroBatcher,
@@ -42,10 +62,15 @@ from pvraft_tpu.serve.batcher import (
     ShutdownError,
 )
 from pvraft_tpu.serve.engine import RequestError
-from pvraft_tpu.serve.metrics import ServeMetrics
+from pvraft_tpu.serve.metrics import PROM_CONTENT_TYPE, ServeMetrics
 
 MSGPACK_CT = "application/msgpack"
 JSON_CT = "application/json"
+
+# jax.profiler supports ONE active trace per process, so /debug/trace
+# captures serialize process-wide — even across multiple embedded
+# ServeHTTPServer instances (the loadgen/test pattern).
+_DEBUG_TRACE_LOCK = threading.Lock()
 
 
 def _decode_json(body: bytes) -> Tuple[np.ndarray, np.ndarray]:
@@ -87,6 +112,10 @@ class _Handler(BaseHTTPRequestHandler):
     # Set by ServeHTTPServer below.
     batcher: MicroBatcher = None  # type: ignore[assignment]
     metrics = None
+    tracer: Optional[Tracer] = None
+    telemetry = None
+    trace_dir: str = ""
+    events_path: str = ""
     predict_timeout_s: float = 60.0
     max_body_bytes: int = 1 << 24
     quiet: bool = True
@@ -103,6 +132,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
+        for key, value in getattr(self, "_extra_headers", ()):
+            self.send_header(key, value)
         if self.close_connection:
             # The stdlib honors the flag by closing the socket but never
             # advertises it; under HTTP/1.1 a pooled client would reuse
@@ -120,24 +151,120 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------- routes --
 
     def do_GET(self):  # noqa: N802 — stdlib handler naming
-        if self.path == "/healthz":
+        self._extra_headers: List[Tuple[str, str]] = []
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            tracer = self.tracer
             self._reply_json(200, {
                 "status": "ok",
                 "buckets": list(self.batcher.engine.cfg.buckets),
                 "batch_sizes": list(self.batcher.engine.cfg.batch_sizes),
                 "min_points": self.batcher.engine.cfg.min_points,
                 "programs": self.batcher.engine.compile_report(),
+                "telemetry": {
+                    "events_path": self.events_path or None,
+                    "tracing": bool(tracer is not None and tracer.enabled),
+                    "trace_sample_every": (
+                        tracer.sample_every if tracer is not None else 0),
+                    "trace_dir": self.trace_dir or None,
+                },
             })
             return
-        if self.path == "/metrics":
-            snap = (self.metrics.snapshot(self.batcher.queue_depths())
-                    if self.metrics is not None else {})
-            self._reply_json(200, snap)
+        if path == "/metrics":
+            fmt = urllib.parse.parse_qs(query).get("format", ["json"])[0]
+            depths = self.batcher.queue_depths()
+            if fmt == "prometheus":
+                text = (self.metrics.prometheus(depths)
+                        if self.metrics is not None else "")
+                self._reply(200, text.encode("utf-8"), PROM_CONTENT_TYPE)
+            elif fmt == "json":
+                snap = (self.metrics.snapshot(depths)
+                        if self.metrics is not None else {})
+                self._reply_json(200, snap)
+            else:
+                self._reply_error(
+                    400, "bad_request",
+                    f"unknown format {fmt!r} (json|prometheus)")
+            return
+        if path == "/debug/trace":
+            self._debug_trace(query)
             return
         self._reply_error(404, "not_found", self.path)
 
+    def _debug_trace(self, query: str) -> None:
+        """On-demand ``jax.profiler.trace`` window from the live server.
+        The handler thread blocks for the window (ThreadingHTTPServer:
+        other requests keep flowing, and the captured profile therefore
+        contains real serving work)."""
+        try:
+            seconds = float(
+                urllib.parse.parse_qs(query).get("seconds", ["2"])[0])
+        except ValueError:
+            self._reply_error(400, "bad_request", "seconds must be a number")
+            return
+        if not 0 < seconds <= 60:
+            self._reply_error(400, "bad_request",
+                              "seconds must be in (0, 60]")
+            return
+        if not _DEBUG_TRACE_LOCK.acquire(blocking=False):
+            self._reply_error(
+                409, "busy", "a trace window is already being captured")
+            return
+        try:
+            import jax
+
+            base = self.trace_dir or os.path.join(
+                tempfile.gettempdir(), "pvraft_traces")
+            os.makedirs(base, exist_ok=True)
+            # mkdtemp, not strftime: two captures inside one wall-clock
+            # second must land in distinguishable directories.
+            trace_dir = tempfile.mkdtemp(
+                prefix=time.strftime("trace_%Y%m%d_%H%M%S_"), dir=base)
+            profiling = announced = False
+            try:
+                jax.profiler.start_trace(trace_dir)
+                profiling = True
+                # Emit "start" only once the profiler is actually
+                # running (a failed start_trace must not leave an
+                # unpaired start on the stream — consumers pair
+                # start/stop)...
+                if self.telemetry is not None:
+                    self.telemetry.emit_trace_window("start", trace_dir)
+                    announced = True
+                time.sleep(seconds)
+            finally:
+                # ...and stop_trace runs on EVERY exit once started —
+                # the profiler is a process-wide singleton, and leaving
+                # it running (e.g. because the start emit raised) would
+                # 500 every future capture for the life of the process.
+                if profiling:
+                    jax.profiler.stop_trace()
+                    if announced:
+                        self.telemetry.emit_trace_window("stop", trace_dir)
+        except Exception as e:  # noqa: BLE001 — a handler must answer, not die
+            self._reply_error(500, "internal", f"{type(e).__name__}: {e}")
+            return
+        finally:
+            _DEBUG_TRACE_LOCK.release()
+        self._reply_json(200, {"trace_dir": trace_dir, "seconds": seconds})
+
+    def _finish_trace(self, trace, status: int,
+                      bucket: Optional[int] = None) -> None:
+        """Assemble + emit the span tree once the response is on the
+        wire (tracing cost sits after the client has its answer). Error
+        outcomes emit their partial tree too — a 503's queue state is
+        observability data; only 200s feed the per-stage histograms."""
+        if trace is None:
+            return
+        spans = trace.spans(root_attrs={"status": status})
+        if self.tracer is not None:
+            self.tracer.emit_spans(spans)
+        if self.metrics is not None and bucket is not None and status == 200:
+            self.metrics.record_stages(bucket, trace.stage_durations_ms())
+
     def do_POST(self):  # noqa: N802 — stdlib handler naming
-        if self.path != "/predict":
+        self._extra_headers = []
+        if self.path.partition("?")[0] != "/predict":
             # The body is left unread: a reused keep-alive connection
             # would parse it as the next request line, so close.
             self.close_connection = True
@@ -173,6 +300,13 @@ class _Handler(BaseHTTPRequestHandler):
                 413, "too_large",
                 f"body {length} B exceeds the {self.max_body_bytes} B cap")
             return
+        # Sampling decision + ingress start BEFORE the body read, so the
+        # ingress span covers read + decode. None = unsampled: no stamps,
+        # no allocations past this check.
+        trace = self.tracer.begin() if self.tracer is not None else None
+        if trace is not None:
+            self._extra_headers.append(("X-Pvraft-Trace", trace.trace_id))
+        t_ingress = time.monotonic()
         body = self.rfile.read(length)
         ctype = (self.headers.get("Content-Type") or JSON_CT).split(";")[0]
         use_msgpack = ctype.strip().lower() == MSGPACK_CT
@@ -185,30 +319,43 @@ class _Handler(BaseHTTPRequestHandler):
             # client-observed totals.
             self.batcher.record_reject(e.reason)
             self._reply_error(400, e.reason, str(e))
+            self._finish_trace(trace, 400)
             return
+        if trace is not None:
+            trace.mark("ingress", t_ingress, time.monotonic(),
+                       attrs={"bytes": length,
+                              "msgpack": use_msgpack,
+                              "n1": int(pc1.shape[0]),
+                              "n2": int(pc2.shape[0])})
         try:
-            req = self.batcher.submit(pc1, pc2)
+            req = self.batcher.submit(pc1, pc2, trace=trace)
             flow = req.wait(self.predict_timeout_s)
         except RequestError as e:
             code = 413 if e.reason == "too_large" else 400
             self._reply_error(code, e.reason, str(e))
+            self._finish_trace(trace, code)
             return
         except QueueFullError as e:
             self._reply_error(503, "queue_full", str(e))
+            self._finish_trace(trace, 503)
             return
         except ShutdownError as e:
             self._reply_error(503, "shutting_down", str(e))
+            self._finish_trace(trace, 503)
             return
         except TimeoutError as e:
             # Accepted-then-failed: counted at submit, so record the
             # outcome (not a fresh request) to keep /metrics reconciled.
             self.batcher.record_failure("timeout")
             self._reply_error(504, "timeout", str(e))
+            self._finish_trace(trace, 504)
             return
         except Exception as e:  # noqa: BLE001 — a handler must answer, not die
             self.batcher.record_failure("internal")
             self._reply_error(500, "internal", f"{type(e).__name__}: {e}")
+            self._finish_trace(trace, 500)
             return
+        t_serialize = time.monotonic()
         if use_msgpack:
             import msgpack
 
@@ -216,10 +363,19 @@ class _Handler(BaseHTTPRequestHandler):
                 "flow": np.ascontiguousarray(flow, np.float32).tobytes(),
                 "n": int(flow.shape[0]),
             })
-            self._reply(200, payload, MSGPACK_CT)
+            content_type = MSGPACK_CT
         else:
-            self._reply_json(200, {"flow": flow.tolist(),
-                                   "n": int(flow.shape[0])})
+            payload = json.dumps({"flow": flow.tolist(),
+                                  "n": int(flow.shape[0])}).encode("utf-8")
+            content_type = JSON_CT
+        if trace is not None:
+            t_respond = time.monotonic()
+            trace.mark("serialize", t_serialize, t_respond)
+            self._reply(200, payload, content_type)
+            trace.mark("respond", t_respond, time.monotonic())
+            self._finish_trace(trace, 200, bucket=req.bucket)
+        else:
+            self._reply(200, payload, content_type)
 
 
 class ServeHTTPServer:
@@ -232,16 +388,26 @@ class ServeHTTPServer:
 
     def __init__(self, batcher: MicroBatcher, host: str = "127.0.0.1",
                  port: int = 8000, metrics=None,
-                 predict_timeout_s: float = 60.0, quiet: bool = True):
+                 predict_timeout_s: float = 60.0, quiet: bool = True,
+                 tracer: Optional[Tracer] = None, telemetry=None,
+                 trace_dir: str = ""):
         self.batcher = batcher
+        self.tracer = tracer
         # 64 B/coordinate bounds any JSON float spelling (msgpack raw f32
         # is 4 B); anything past this cannot fit the largest bucket and
         # would only be buffered to be 413'd after parsing.
         largest = max(batcher.engine.cfg.buckets)
         max_body = 2 * largest * 3 * 64 + 65536
+        events_path = ""
+        if telemetry is not None and getattr(telemetry, "events", None):
+            events_path = getattr(telemetry.events, "path", "") or ""
         handler = type("BoundHandler", (_Handler,), {
             "batcher": batcher,
             "metrics": metrics,
+            "tracer": tracer,
+            "telemetry": telemetry,
+            "trace_dir": trace_dir,
+            "events_path": events_path,
             "predict_timeout_s": predict_timeout_s,
             "max_body_bytes": max_body,
             "quiet": quiet,
@@ -270,12 +436,16 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
                   queue_depth: int = 64, host: str = "127.0.0.1",
                   port: int = 0, telemetry=None,
                   predict_timeout_s: float = 60.0,
-                  quiet: bool = True) -> ServeHTTPServer:
+                  quiet: bool = True, trace_sample_every: int = 16,
+                  trace_dir: str = "") -> ServeHTTPServer:
     """The one canonical engine -> metrics -> batcher -> HTTP assembly,
     shared by ``python -m pvraft_tpu.serve`` and the load generator so
     the two serving surfaces cannot drift: ``max_batch`` is always the
     largest compiled batch size, and one :class:`ServeMetrics` reaches
-    both the batcher and the HTTP layer. Returns an unstarted server
+    both the batcher and the HTTP layer. ``trace_sample_every`` traces
+    1-in-N requests (1 = every request — what loadgen uses; 0 = off);
+    sampled spans go to ``telemetry`` when present and always feed the
+    per-stage Prometheus histograms. Returns an unstarted server
     (``.start()`` / ``.shutdown()``)."""
     metrics = ServeMetrics(engine.cfg.buckets)
     batcher = MicroBatcher(
@@ -283,5 +453,10 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
         BatcherConfig(max_batch=max(engine.cfg.batch_sizes),
                       max_wait_ms=max_wait_ms, queue_depth=queue_depth),
         telemetry=telemetry, metrics=metrics)
+    tracer = Tracer(
+        sample_every=trace_sample_every,
+        emit=telemetry.emit_span if telemetry is not None else None)
     return ServeHTTPServer(batcher, host=host, port=port, metrics=metrics,
-                           predict_timeout_s=predict_timeout_s, quiet=quiet)
+                           predict_timeout_s=predict_timeout_s, quiet=quiet,
+                           tracer=tracer, telemetry=telemetry,
+                           trace_dir=trace_dir)

@@ -63,11 +63,35 @@ fi
 
 echo "== pvraft_serve_load/v1: committed load-gen artifacts validate"
 # The serve latency/throughput evidence (scripts/serve_loadgen.py) must
-# parse against its schema, same discipline as the event logs.
-serve_artifacts=$(ls artifacts/serve_*.json 2>/dev/null || true)
+# parse against its schema, same discipline as the event logs. The
+# trace/SLO siblings (*.trace.json / *.slo.json) have their own
+# validators in the next stage — exclude them here.
+serve_artifacts=$(ls artifacts/serve_*.json 2>/dev/null \
+  | grep -v -e '\.trace\.json$' -e '\.slo\.json$' || true)
 if [ -n "$serve_artifacts" ]; then
   # shellcheck disable=SC2086 -- word splitting over the file list is intended
   python -m pvraft_tpu.serve validate-load $serve_artifacts
 else
   echo "(no committed serve artifacts)"
+fi
+
+echo "== pvraft_trace/v1 + pvraft_slo/v1: committed trace/SLO artifacts validate"
+# The request-tracing evidence: span trees grouped per trace
+# (serve_loadgen writes them) and the SLO report joining loadgen +
+# spans (scripts/slo_report.py). The validators recompute completeness
+# and orphan counts from the spans themselves, so a hand-edited
+# "complete" flag cannot pass.
+trace_artifacts=$(ls artifacts/*.trace.json 2>/dev/null || true)
+if [ -n "$trace_artifacts" ]; then
+  # shellcheck disable=SC2086 -- word splitting over the file list is intended
+  python -m pvraft_tpu.obs validate-trace $trace_artifacts
+else
+  echo "(no committed trace artifacts)"
+fi
+slo_artifacts=$(ls artifacts/*.slo.json 2>/dev/null || true)
+if [ -n "$slo_artifacts" ]; then
+  # shellcheck disable=SC2086 -- word splitting over the file list is intended
+  python -m pvraft_tpu.obs validate-slo $slo_artifacts
+else
+  echo "(no committed SLO reports)"
 fi

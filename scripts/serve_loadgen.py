@@ -7,11 +7,16 @@ over real HTTP, and commits the evidence:
 
     artifacts/serve_cpu_synthetic.json          pvraft_serve_load/v1
     artifacts/serve_cpu_synthetic.events.jsonl  pvraft_events/v1 (serve)
+    artifacts/serve_cpu_synthetic.trace.json    pvraft_trace/v1
 
-Both are validated by ``scripts/lint.sh`` (the JSON by ``python -m
-pvraft_tpu.serve validate-load``, the events by the shared obs
-validator), so a writer/schema drift fails the standing gate before a
-TPU run produces unreadable serve telemetry.
+All three are validated by ``scripts/lint.sh`` (the JSON by ``python -m
+pvraft_tpu.serve validate-load``, the events + trace artifact by the
+obs validators), so a writer/schema drift fails the standing gate
+before a TPU run produces unreadable serve telemetry.
+
+Tracing is 100% under loadgen (every request's span tree is recorded;
+the artifact's ``per_request[].trace_id`` joins to the spans), which is
+what ``scripts/slo_report.py`` turns into the ``pvraft_slo/v1`` report.
 
 Default geometry is the CPU-synthetic smoke tier (small model, small
 buckets) — the honest labels: this measures the serving machinery
@@ -104,9 +109,11 @@ def main() -> int:
     print(f"[loadgen] engine ready: "
           f"{[r['name'] for r in engine.compile_report()]}", flush=True)
 
+    # 100% sampling: loadgen is the SLO evidence path, so every
+    # request's span tree must exist for the slo_report join.
     server = build_service(engine, max_wait_ms=args.max_wait_ms,
                            queue_depth=args.queue_depth,
-                           telemetry=telemetry)
+                           telemetry=telemetry, trace_sample_every=1)
     server.start()
     print(f"[loadgen] serving on port {server.port}; "
           f"{args.requests} requests x {args.concurrency} clients",
@@ -139,6 +146,7 @@ def main() -> int:
             "truncate_k": model.truncate_k,
             "graph_k": model.graph_k,
             "corr_knn": model.corr_knn,
+            "compute_dtype": model.compute_dtype,
             "requests": args.requests,
             "concurrency": args.concurrency,
             "max_wait_ms": args.max_wait_ms,
@@ -157,7 +165,25 @@ def main() -> int:
         return 1
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2)
-    print(f"[loadgen] wrote {args.out} and {events_path}")
+
+    # Group the run's span events into the committed pvraft_trace/v1
+    # artifact (the per-request span trees, completeness pre-checked).
+    from pvraft_tpu.obs.trace import collect_traces, validate_trace_artifact
+
+    with open(events_path, "r", encoding="utf-8") as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    trace_doc = collect_traces(records, source=events_path)
+    trace_path = os.path.splitext(args.out)[0] + ".trace.json"
+    trace_problems = validate_trace_artifact(trace_doc, path=trace_path)
+    if trace_problems:
+        for p in trace_problems:
+            print(f"[loadgen] TRACE SCHEMA PROBLEM: {p}", file=sys.stderr)
+        return 1
+    with open(trace_path, "w") as f:
+        json.dump(trace_doc, f, indent=2)
+
+    print(f"[loadgen] wrote {args.out}, {events_path} and {trace_path}")
+    print(f"[loadgen] traces: {trace_doc['counts']}")
     print(json.dumps({
         "ok": artifact["requests"]["ok"],
         "rejected": artifact["requests"]["rejected"],

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Join loadgen artifacts + trace spans -> ``pvraft_slo/v1`` report.
+
+The CLI over :mod:`pvraft_tpu.obs.slo`: reads one or more
+``pvraft_serve_load/v1`` artifacts (each with its span-carrying
+``pvraft_events/v1`` stream, default ``<load stem>.events.jsonl``),
+joins requests to span trees by trace id, and writes the per-(bucket,
+batch, dtype) per-stage quantile report with max sustainable QPS under
+the configured p99 SLO:
+
+    python scripts/slo_report.py --load artifacts/serve_cpu_synthetic.json \
+        --slo-p99-ms 5000 --out artifacts/serve_cpu_synthetic.slo.json
+
+``--check`` enforces the evidence bar the report exists for: every ok
+request traced with a COMPLETE span tree (ingress through respond, no
+orphans), and the per-stage p99 sum within 10% of the end-to-end p99
+(``stage_sum_ratio`` in [0.9, 1.1]) — exits non-zero otherwise, so the
+committed artifact cannot silently degrade.
+
+``--emit-event`` appends an ``slo_report`` record to the (first) events
+stream, pointing at the written report — the run's own ledger records
+that its SLO evidence exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pvraft_tpu.obs.slo import (  # noqa: E402 — needs the path hack
+    build_slo_report,
+    validate_slo_report,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--load", action="append", required=True,
+                    help="pvraft_serve_load/v1 artifact (repeatable; one "
+                         "run per concurrency/geometry point)")
+    ap.add_argument("--events", action="append", default=None,
+                    help="events stream per --load (default: "
+                         "<load stem>.events.jsonl)")
+    ap.add_argument("--slo-p99-ms", type=float, default=5000.0,
+                    help="the p99 latency SLO the report evaluates")
+    ap.add_argument("--out", default="artifacts/serve_cpu_synthetic.slo.json")
+    ap.add_argument("--check", action="store_true",
+                    help="fail unless every ok request has a complete "
+                         "span tree and stage p99s sum to within 10%% of "
+                         "the e2e p99")
+    ap.add_argument("--emit-event", action="store_true",
+                    help="append an slo_report event to the first events "
+                         "stream")
+    args = ap.parse_args()
+
+    events_paths = args.events or []
+    if events_paths and len(events_paths) != len(args.load):
+        print("--events must be given once per --load (or not at all)",
+              file=sys.stderr)
+        return 2
+
+    sources = []
+    for i, load_path in enumerate(args.load):
+        with open(load_path, "r", encoding="utf-8") as f:
+            load_doc = json.load(f)
+        events_path = (events_paths[i] if events_paths
+                       else os.path.splitext(load_path)[0] + ".events.jsonl")
+        with open(events_path, "r", encoding="utf-8") as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        sources.append((load_path, load_doc, events_path, records))
+
+    report = build_slo_report(sources, slo_p99_ms=args.slo_p99_ms)
+    problems = validate_slo_report(report, path=args.out)
+    if problems:
+        for p in problems:
+            print(f"[slo_report] SCHEMA PROBLEM: {p}", file=sys.stderr)
+        return 1
+
+    failures = []
+    totals = report["totals"]
+    if totals["traced_ok"] < totals["ok"]:
+        failures.append(
+            f"{totals['ok'] - totals['traced_ok']} ok requests have no "
+            f"trace (sampling < 100%?)")
+    if totals["complete"] < totals["traced_ok"]:
+        failures.append(
+            f"{totals['traced_ok'] - totals['complete']} traced requests "
+            f"have incomplete span trees")
+    if totals["orphan_spans"]:
+        failures.append(f"{totals['orphan_spans']} orphan spans")
+    for row in report["programs"]:
+        ratio = row["stage_sum_ratio"]
+        if ratio is None or not 0.9 <= ratio <= 1.1:
+            failures.append(
+                f"bucket {row['bucket']} bs {row['batch']} "
+                f"{row['dtype']}: stage p99 sum / e2e p99 = {ratio} "
+                f"(outside [0.9, 1.1])")
+    for msg in failures:
+        print(f"[slo_report] EVIDENCE GAP: {msg}",
+              file=sys.stderr if args.check else sys.stdout)
+    if args.check and failures:
+        return 1
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+
+    if args.emit_event:
+        # Append to the existing stream: EventLog continues the seq
+        # chain (same machinery train.py --resume relies on).
+        from pvraft_tpu.obs.events import EventLog
+
+        log = EventLog(sources[0][2], enabled=True)
+        log.emit("slo_report", path=args.out,
+                 slo_p99_ms=args.slo_p99_ms,
+                 **({"max_qps_under_slo": report["max_qps_under_slo"]}
+                    if report["max_qps_under_slo"] is not None else {}),
+                 programs=len(report["programs"]),
+                 requests=totals["requests"])
+        log.close()
+
+    print(f"[slo_report] wrote {args.out}")
+    print(json.dumps({
+        "slo_p99_ms": args.slo_p99_ms,
+        "max_qps_under_slo": report["max_qps_under_slo"],
+        "programs": [
+            {"bucket": r["bucket"], "batch": r["batch"],
+             "dtype": r["dtype"], "e2e_p99_ms": r["e2e"]["p99_ms"],
+             "stage_sum_ratio": r["stage_sum_ratio"],
+             "meets_slo": r["meets_slo"]}
+            for r in report["programs"]],
+        "totals": totals,
+    }, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,12 @@ line. ``--cpu`` without explicit sizes shrinks to a labeled CPU-feasible
 config (the flagship 8,192-pt step is minutes per program on the host),
 mirroring ``bench.py``'s CPU-fallback discipline; the record carries the
 measured sizes so it can never masquerade as the flagship.
+
+``--events PATH`` additionally emits the breakdown as a ``train_step``
+span tree on a ``pvraft_events/v1`` stream (``obs.trace.
+trace_from_step_profile``) — the same ``pvraft_trace/v1`` span schema
+the serve request plane uses, so one trace consumer covers both
+workloads.
 """
 
 from __future__ import annotations
@@ -57,6 +63,9 @@ def main() -> int:
                    help="A/B flag: bfloat16 gradient cast "
                         "(TrainConfig.grad_dtype semantics)")
     p.add_argument("--out", default="artifacts/step_profile.json")
+    p.add_argument("--events", default="",
+                   help="also emit the breakdown as span events "
+                        "(pvraft_events/v1 stream at this path)")
     from _backend import add_cpu_flag, maybe_pin_cpu
 
     add_cpu_flag(p)
@@ -104,6 +113,18 @@ def main() -> int:
     os.makedirs(os.path.dirname(a.out) or ".", exist_ok=True)
     with open(a.out, "w") as f:
         json.dump(record, f, indent=1)
+
+    if a.events and "breakdown_s" in record:
+        from pvraft_tpu.obs.events import EventLog, run_metadata
+        from pvraft_tpu.obs.trace import trace_from_step_profile
+
+        log = EventLog(a.events, enabled=True)
+        if log.seq == 0:
+            log.emit("run_header", **run_metadata(cfg, mode="profile"))
+        for span in trace_from_step_profile(record):
+            log.emit("span", **span)
+        log.close()
+        print(f"[step_profile] span trace -> {a.events}", file=sys.stderr)
     return 0 if not problems else 1
 
 

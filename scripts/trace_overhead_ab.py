@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Interleaved A/B: serve latency with tracing off vs 100% sampled.
+
+The host is shared and noisy (BENCHMARKS.md discipline): sequential
+off/on legs would measure load, not tracing. This drives ONE live
+server (one engine, one compiled program set) and toggles
+``tracer.sample_every`` between 0 and 1 PER LEG, interleaved over
+``--reps`` rounds, reporting min AND median p50/p95 per mode. The
+tracing-on leg is the worst case: every request stamped, span tree
+built, 8 span events written to the JSONL sink.
+
+    python scripts/trace_overhead_ab.py --requests 48 --reps 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--concurrency", type=int, default=2)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--buckets", default="128,256")
+    ap.add_argument("--truncate_k", type=int, default=32)
+    ap.add_argument("--graph_k", type=int, default=8)
+    ap.add_argument("--corr_knn", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from pvraft_tpu import parse_int_list
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models.raft import PVRaft
+    from pvraft_tpu.serve import (
+        InferenceEngine,
+        ServeConfig,
+        ServeTelemetry,
+        build_service,
+    )
+    from pvraft_tpu.serve.loadgen import run_load
+
+    model = ModelConfig(truncate_k=args.truncate_k, graph_k=args.graph_k,
+                        corr_knn=args.corr_knn)
+    cfg = ServeConfig(model=model, buckets=parse_int_list(args.buckets),
+                      batch_sizes=(1, 4), num_iters=args.iters)
+    m = PVRaft(model)
+    rng = np.random.default_rng(args.seed)
+    pc = jax.numpy.asarray(
+        rng.uniform(-1, 1, (1, cfg.buckets[0], 3)).astype(np.float32))
+    params = m.init(jax.random.key(args.seed), pc, pc, 2)
+    engine = InferenceEngine(params, cfg)
+    events_path = os.path.join(tempfile.mkdtemp(), "ab.events.jsonl")
+    telemetry = ServeTelemetry(events_path, cfg=cfg)
+    server = build_service(engine, max_wait_ms=2.0, telemetry=telemetry,
+                           trace_sample_every=1)
+    server.start()
+
+    counts = [int(0.75 * b) for b in cfg.buckets]
+    legs = {"off": [], "on": []}
+    try:
+        # Warmup leg (first-touch costs: route, socket, histograms).
+        run_load(server, n_requests=8, concurrency=args.concurrency,
+                 point_counts=counts, seed=args.seed)
+        for rep in range(args.reps):
+            for mode, every in (("off", 0), ("on", 1)):
+                server.tracer.sample_every = every
+                r = run_load(server, n_requests=args.requests,
+                             concurrency=args.concurrency,
+                             point_counts=counts, seed=args.seed + rep)
+                legs[mode].append({"p50": r["latency_ms"]["p50"],
+                                   "p95": r["latency_ms"]["p95"],
+                                   "rps": r["throughput_rps"]})
+                print(f"[ab] rep {rep} {mode}: {legs[mode][-1]}",
+                      file=sys.stderr, flush=True)
+    finally:
+        server.shutdown(drain=True)
+        telemetry.close()
+
+    def agg(mode, key):
+        vals = [leg[key] for leg in legs[mode]]
+        return {"min": min(vals), "median": statistics.median(vals),
+                "all": vals}
+
+    out = {mode: {key: agg(mode, key) for key in ("p50", "p95", "rps")}
+           for mode in legs}
+    out["overhead_p50_median_pct"] = round(
+        100.0 * (out["on"]["p50"]["median"] / out["off"]["p50"]["median"]
+                 - 1.0), 2)
+    out["overhead_p50_min_pct"] = round(
+        100.0 * (out["on"]["p50"]["min"] / out["off"]["p50"]["min"]
+                 - 1.0), 2)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

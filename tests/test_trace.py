@@ -1,0 +1,382 @@
+"""Trace plane gate: span trees + sampling (obs/trace.py), the
+pvraft_trace/v1 artifact validator, the step-profile span bridge, the
+pvraft_slo/v1 report build/validate, Prometheus exposition (with a
+minimal text-format parser), and the frozen JSON /metrics shape.
+
+Everything here is host-side pure Python — no AOT compiles, no model —
+so the whole module costs seconds (tier-1 budget discipline)."""
+
+import json
+import re
+
+import pytest
+
+from pvraft_tpu.obs.events import validate_event
+from pvraft_tpu.obs.slo import (
+    build_slo_report,
+    exact_quantile,
+    validate_slo_report,
+)
+from pvraft_tpu.obs.trace import (
+    SERVE_STAGES,
+    RequestTrace,
+    Tracer,
+    collect_traces,
+    trace_from_step_profile,
+    validate_trace_artifact,
+)
+from pvraft_tpu.serve.metrics import ServeMetrics
+
+
+# ------------------------------------------------------- tracer/sampling --
+
+
+def test_tracer_sampling():
+    assert Tracer(sample_every=0).begin() is None          # disabled
+    assert Tracer(sample_every=1).begin() is not None      # everything
+    t = Tracer(sample_every=3)
+    hits = sum(t.begin() is not None for _ in range(30))
+    assert hits == 10                                      # exactly 1-in-3
+    with pytest.raises(ValueError):
+        Tracer(sample_every=-1)
+
+
+def test_request_trace_span_tree():
+    trace = RequestTrace(t0=100.0)
+    trace.mark("ingress", 100.0, 100.01)
+    trace.mark("device_execute", 100.02, 100.5,
+               attrs={"bucket": 32, "batch": 2, "n": 1})
+    spans = trace.spans(t_end=100.6, root_attrs={"status": 200})
+    assert [s["name"] for s in spans] == [
+        "request", "ingress", "device_execute"]
+    root = spans[0]
+    assert "parent_id" not in root
+    assert root["attrs"] == {"status": 200}
+    assert all(s["parent_id"] == root["span_id"] for s in spans[1:])
+    assert all(s["trace_id"] == trace.trace_id for s in spans)
+    assert root["end_ms"] - root["start_ms"] == pytest.approx(600.0)
+    durs = trace.stage_durations_ms()
+    assert durs["device_execute"] == pytest.approx(480.0)
+    # Every span is a valid pvraft_events/v1 record body.
+    for i, s in enumerate(spans):
+        rec = {"schema": "pvraft_events/v1", "type": "span", "time": 1.0,
+               "seq": i, **s}
+        assert validate_event(rec, seq=i) == [], s
+
+
+# --------------------------------------------------------- span events --
+
+
+def test_span_event_rejects_reversed_interval():
+    rec = {"schema": "pvraft_events/v1", "type": "span", "time": 1.0,
+           "seq": 0, "trace_id": "t", "span_id": "s", "name": "ingress",
+           "start_ms": 10.0, "end_ms": 9.0}
+    assert any("end_ms" in p for p in validate_event(rec, seq=0))
+    rec["end_ms"] = 10.0                                   # zero-width ok
+    assert validate_event(rec, seq=0) == []
+
+
+def test_slo_report_event():
+    rec = {"schema": "pvraft_events/v1", "type": "slo_report", "time": 1.0,
+           "seq": 0, "path": "artifacts/x.slo.json", "slo_p99_ms": 5000.0,
+           "max_qps_under_slo": 11.3, "programs": 2, "requests": 64}
+    assert validate_event(rec, seq=0) == []
+    del rec["slo_p99_ms"]
+    assert any("slo_p99_ms" in p for p in validate_event(rec, seq=0))
+
+
+# ------------------------------------------------------ trace artifact --
+
+
+def _spans(trace_id="t1", stages=SERVE_STAGES, status=200):
+    spans = [{"trace_id": trace_id, "span_id": "r", "name": "request",
+              "start_ms": 0.0, "end_ms": 100.0,
+              "attrs": {"status": status}}]
+    for i, stage in enumerate(stages):
+        span = {
+            "trace_id": trace_id, "span_id": f"r.{i}", "parent_id": "r",
+            "name": stage, "start_ms": float(i * 10),
+            "end_ms": float(i * 10 + 10),
+        }
+        if stage == "device_execute":
+            span["attrs"] = {"bucket": 32, "batch": 2, "n": 1}
+        spans.append(span)
+    return spans
+
+
+def _records(spans):
+    return [{"schema": "pvraft_events/v1", "type": "span", "time": 1.0,
+             "seq": i, **s} for i, s in enumerate(spans)]
+
+
+def test_collect_traces_complete_and_incomplete():
+    recs = _records(_spans("t1") + _spans("t2", stages=("ingress",)))
+    doc = collect_traces(recs, source="x.events.jsonl")
+    assert doc["counts"] == {"traces": 2, "spans": len(SERVE_STAGES) + 3,
+                             "complete": 1, "orphan_spans": 0}
+    by_id = {t["trace_id"]: t for t in doc["traces"]}
+    assert by_id["t1"]["complete"] and not by_id["t2"]["complete"]
+    assert by_id["t1"]["duration_ms"] == 100.0
+    assert validate_trace_artifact(doc) == []
+
+
+def test_collect_traces_orphans():
+    spans = _spans("t1")
+    spans[3]["parent_id"] = "nonexistent"
+    doc = collect_traces(_records(spans))
+    assert doc["counts"]["orphan_spans"] == 1
+    assert doc["counts"]["complete"] == 0
+    assert validate_trace_artifact(doc) == []
+
+
+def test_validate_trace_artifact_red():
+    doc = collect_traces(_records(_spans()))
+    bad = json.loads(json.dumps(doc))
+    bad["traces"][0]["complete"] = False        # forged flag
+    assert any("complete" in p for p in validate_trace_artifact(bad))
+    bad = json.loads(json.dumps(doc))
+    bad["counts"]["spans"] += 1                 # drifted counts
+    assert any("counts" in p for p in validate_trace_artifact(bad))
+    bad = json.loads(json.dumps(doc))
+    bad["traces"][0]["spans"][1]["end_ms"] = -1.0   # reversed span
+    assert any("end_ms" in p for p in validate_trace_artifact(bad))
+    bad = json.loads(json.dumps(doc))
+    bad["schema"] = "pvraft_trace/v0"
+    assert any("schema" in p for p in validate_trace_artifact(bad))
+    # expected_stages is pinned to a known vocabulary: emptying it (to
+    # make completeness vacuous) fails, it cannot be forged alongside
+    # the complete flags.
+    bad = json.loads(json.dumps(doc))
+    bad["expected_stages"] = []
+    assert any("known stage vocabulary" in p
+               for p in validate_trace_artifact(bad))
+    # Malformed containers report problems, never traceback (the lint
+    # gate runs this on hand-editable committed files).
+    bad = json.loads(json.dumps(doc))
+    bad["traces"] = 5
+    assert validate_trace_artifact(bad)
+    bad = json.loads(json.dumps(doc))
+    bad["traces"][0]["spans"] = "abc"
+    assert any("list of span objects" in p
+               for p in validate_trace_artifact(bad))
+
+
+# --------------------------------------------------- step-profile bridge --
+
+
+def test_trace_from_step_profile():
+    record = {
+        "platform": "cpu", "variant": "fp32", "points": 2048, "batch": 2,
+        "iters": 8, "total_step_s": 3.0,
+        "breakdown_s": {"encoder": 0.5, "corr_init": 0.3,
+                        "gru_forward": 0.4, "backward": 1.6,
+                        "optimizer": 0.2},
+    }
+    spans = trace_from_step_profile(record)
+    assert spans[0]["name"] == "train_step"
+    assert spans[0]["end_ms"] == 3000.0
+    assert [s["name"] for s in spans[1:]] == [
+        "encoder", "corr_init", "gru_forward", "backward", "optimizer"]
+    # Stages telescope: consecutive, gap-free, summing to the total.
+    cursor = 0.0
+    for s in spans[1:]:
+        assert s["start_ms"] == pytest.approx(cursor)
+        cursor = s["end_ms"]
+    assert cursor == pytest.approx(3000.0)
+    doc = collect_traces(
+        _records(spans),
+        expected_stages=tuple(record["breakdown_s"]))
+    assert doc["counts"]["complete"] == 1
+    with pytest.raises(ValueError, match="breakdown"):
+        trace_from_step_profile({"measurements": {}})
+
+
+# ------------------------------------------------------------ SLO report --
+
+
+def test_exact_quantile():
+    assert exact_quantile([], 0.99) is None
+    samples = list(range(100))
+    assert exact_quantile(samples, 0.50) == 50
+    assert exact_quantile(samples, 0.99) == 99
+
+
+def _load_doc(n=4, status=200, throughput=10.0):
+    return {
+        "schema": "pvraft_serve_load/v1",
+        "config": {"compute_dtype": "float32"},
+        "requests": {"total": n, "ok": n, "rejected": 0, "errors": 0},
+        "throughput_rps": throughput,
+        "per_request": [{"status": status, "ms": 100.0 + i,
+                         "n": 20, "trace_id": f"t{i}"}
+                        for i in range(n)],
+    }
+
+
+def test_build_slo_report_joins_and_quantifies():
+    doc = _load_doc(n=3)
+    records = []
+    for i in range(3):
+        spans = _spans(f"t{i}")
+        for s in spans:
+            if s["name"] == "device_execute":
+                s["attrs"] = {"bucket": 32, "batch": 2, "n": 1}
+        records += _records(spans)
+    report = build_slo_report(
+        [("load.json", doc, "load.events.jsonl", records)],
+        slo_p99_ms=5000.0)
+    assert validate_slo_report(report) == []
+    assert report["totals"] == {"requests": 3, "ok": 3, "traced_ok": 3,
+                                "complete": 3, "orphan_spans": 0}
+    assert len(report["programs"]) == 1
+    row = report["programs"][0]
+    assert (row["bucket"], row["batch"], row["dtype"]) == (32, 2, "float32")
+    assert row["requests"] == 3
+    assert set(row["stages"]) == set(SERVE_STAGES)
+    # Each synthetic stage is 10ms, e2e 100ms: 7 stages -> ratio 0.7.
+    assert row["e2e"]["p99_ms"] == 100.0
+    assert row["stage_p99_sum_ms"] == pytest.approx(70.0)
+    assert row["stage_sum_ratio"] == pytest.approx(0.7)
+    assert row["meets_slo"]
+    assert report["max_qps_under_slo"] == 10.0
+
+
+def test_build_slo_report_slo_miss_and_untraced():
+    doc = _load_doc(n=2, throughput=50.0)
+    doc["per_request"][1]["trace_id"] = None     # one untraced request
+    report = build_slo_report(
+        [("load.json", doc, "e.jsonl", _records(_spans("t0")))],
+        slo_p99_ms=50.0)                          # SLO below the 100ms e2e
+    assert report["totals"]["traced_ok"] == 1
+    assert report["runs"][0]["meets_slo"] is False
+    assert report["max_qps_under_slo"] is None
+    assert validate_slo_report(report) == []
+
+
+def test_validate_slo_report_red():
+    report = build_slo_report(
+        [("l.json", _load_doc(1), "e.jsonl", _records(_spans("t0")))],
+        slo_p99_ms=5000.0)
+    bad = json.loads(json.dumps(report))
+    bad["schema"] = "pvraft_slo/v0"
+    assert any("schema" in p for p in validate_slo_report(bad))
+    bad = json.loads(json.dumps(report))
+    del bad["max_qps_under_slo"]
+    assert any("max_qps_under_slo" in p for p in validate_slo_report(bad))
+    bad = json.loads(json.dumps(report))
+    bad["totals"]["complete"] = 99               # complete > traced_ok
+    assert any("complete" in p for p in validate_slo_report(bad))
+    bad = json.loads(json.dumps(report))
+    del bad["programs"][0]["stages"]["device_execute"]
+    assert any("device_execute" in p for p in validate_slo_report(bad))
+    bad = json.loads(json.dumps(report))
+    for run in bad["runs"]:
+        run["meets_slo"] = False                 # qps claim without a run
+    assert any("qualifying runs" in p for p in validate_slo_report(bad))
+    bad = json.loads(json.dumps(report))
+    bad["max_qps_under_slo"] = 999999.0          # forged headline number
+    assert any("qualifying runs" in p for p in validate_slo_report(bad))
+    # Malformed containers report problems, never traceback.
+    bad = json.loads(json.dumps(report))
+    bad["totals"] = None
+    assert any("totals" in p for p in validate_slo_report(bad))
+    bad = json.loads(json.dumps(report))
+    bad["programs"] = 5
+    assert any("programs" in p for p in validate_slo_report(bad))
+
+
+# ------------------------------------------------ Prometheus exposition --
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})? (\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text):
+    """Minimal text-format 0.0.4 parser: {family: {"help", "type",
+    "samples": [(name, labels-dict, float)]}}. Raises on any line that
+    is neither a comment nor a well-formed sample."""
+    families = {}
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            name, help_text = line[len("# HELP "):].split(" ", 1)
+            families.setdefault(
+                name, {"samples": []})["help"] = help_text
+        elif line.startswith("# TYPE "):
+            name, mtype = line[len("# TYPE "):].split(" ", 1)
+            families.setdefault(name, {"samples": []})["type"] = mtype
+        elif line.strip():
+            m = _SAMPLE_RE.match(line)
+            assert m, f"malformed sample line: {line!r}"
+            name, raw_labels, value = m.groups()
+            family = re.sub(r"_(bucket|sum|count)$", "", name)
+            key = family if family in families else name
+            labels = dict(_LABEL_RE.findall(raw_labels or ""))
+            families.setdefault(key, {"samples": []})["samples"].append(
+                (name, labels, float(value.replace("+Inf", "inf"))))
+    return families
+
+
+def _metrics_with_data():
+    m = ServeMetrics(buckets=(32, 64))
+    m.record_submit(32, n_points=20)
+    m.record_submit(64, n_points=48)
+    m.record_reject("queue_full")
+    m.record_batch(2, 0.5, [3.0, 7.5])
+    m.record_stages(32, {"device_execute": 2.0})
+    return m
+
+
+def test_prometheus_exposition_names_help_type():
+    fams = parse_prometheus(_metrics_with_data().prometheus({32: 0, 64: 1}))
+    # Every family is namespaced, typed and documented.
+    assert fams and all(name.startswith("pvraft_serve_") for name in fams)
+    for name, fam in fams.items():
+        assert fam.get("help"), f"{name} has no HELP"
+        assert fam.get("type") in ("counter", "gauge", "histogram"), name
+    assert fams["pvraft_serve_requests_total"]["samples"] == [
+        ("pvraft_serve_requests_total", {}, 3.0)]
+    assert ("pvraft_serve_rejected_total", {"reason": "queue_full"}, 1.0) \
+        in fams["pvraft_serve_rejected_total"]["samples"]
+    assert ("pvraft_serve_queue_depth", {"bucket": "64"}, 1.0) \
+        in fams["pvraft_serve_queue_depth"]["samples"]
+
+
+def test_prometheus_histograms_cumulative():
+    fams = parse_prometheus(_metrics_with_data().prometheus())
+    lat = fams["pvraft_serve_latency_ms"]["samples"]
+    buckets = [(labels["le"], v) for n, labels, v in lat
+               if n.endswith("_bucket")]
+    # le edges ascend and counts are cumulative (never decrease).
+    values = [v for _, v in buckets]
+    assert values == sorted(values)
+    assert buckets[-1][0] == "+Inf" and buckets[-1][1] == 2.0
+    count = [v for n, _, v in lat if n.endswith("_count")][0]
+    assert count == 2.0
+    total = [v for n, _, v in lat if n.endswith("_sum")][0]
+    assert total == pytest.approx(10.5)
+    # The trace-fed per-(bucket, stage) family + request-size family.
+    stage = fams["pvraft_serve_stage_latency_ms"]["samples"]
+    assert any(l.get("stage") == "device_execute" and l.get("bucket") == "32"
+               for _, l, _ in stage)
+    points = fams["pvraft_serve_request_points"]["samples"]
+    assert [v for n, _, v in points if n.endswith("_count")] == [2.0]
+
+
+def test_json_metrics_snapshot_byte_compatible():
+    """The default /metrics JSON is shape-frozen: new trace/size
+    histograms are Prometheus-only. This pins the exact serialized
+    bytes of a fixed interaction sequence — any key added, renamed or
+    reordered (under sort_keys) fails here."""
+    snap = _metrics_with_data().snapshot({32: 0, 64: 1})
+    assert json.dumps(snap, sort_keys=True) == (
+        '{"batch_fill_mean": 0.5, "batches_total": 1, "latency": '
+        '{"bucket_counts": [0, 0, 1, 1, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0], '
+        '"bucket_edges_ms": [1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, '
+        '200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0, 30000.0], '
+        '"count": 2, "max_ms": 7.5, "mean_ms": 5.25, "p50_ms": 5.0, '
+        '"p95_ms": 10.0, "p99_ms": 10.0}, "per_bucket_requests": '
+        '{"32": 1, "64": 1}, "queue_depth": {"32": 0, "64": 1}, '
+        '"rejected": {"queue_full": 1}, "requests_total": 3, '
+        '"responses_total": 2}')
