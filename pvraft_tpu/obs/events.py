@@ -29,8 +29,10 @@ Event types and their required fields:
 Serving events (``pvraft_tpu/serve``) share the stream — ONE validator
 covers training and serving telemetry:
 
-    serve_compile  bucket, batch, lower_s, compile_s  [+ memory]
-    serve_batch    bucket, batch, n, fill, latency_ms [+ queue_depth]
+    serve_compile  bucket, batch, lower_s, compile_s  [+ memory, dtype,
+                   replica, device_id]
+    serve_batch    bucket, batch, n, fill, latency_ms [+ queue_depth,
+                   replica, device_id]
     serve_reject   reason ("queue_full"|"too_large"|"too_small"|
                    "bad_request"|"shutdown"|"timeout"|"internal")
                                                       [+ bucket, queue_depth]
@@ -82,9 +84,9 @@ EVENT_TYPES: Dict[str, tuple] = {
                    ("zscore", "snapshot")),
     "snapshot": (("epoch", "step", "path", "reason"), ()),
     "serve_compile": (("bucket", "batch", "lower_s", "compile_s"),
-                      ("memory",)),
+                      ("memory", "dtype", "replica", "device_id")),
     "serve_batch": (("bucket", "batch", "n", "fill", "latency_ms"),
-                    ("queue_depth",)),
+                    ("queue_depth", "replica", "device_id")),
     "serve_reject": (("reason",), ("bucket", "queue_depth")),
     "serve_shutdown": (("served", "rejected", "drained"), ()),
     "span": (("trace_id", "span_id", "name", "start_ms", "end_ms"),
@@ -111,9 +113,10 @@ _NUMERIC_FIELDS = {
     "trace_window": ("epoch",),
     "divergence": ("epoch", "step", "loss"),
     "snapshot": ("epoch", "step"),
-    "serve_compile": ("bucket", "batch", "lower_s", "compile_s"),
+    "serve_compile": ("bucket", "batch", "lower_s", "compile_s",
+                      "replica", "device_id"),
     "serve_batch": ("bucket", "batch", "n", "fill", "latency_ms",
-                    "queue_depth"),
+                    "queue_depth", "replica", "device_id"),
     "serve_reject": ("bucket", "queue_depth"),
     "serve_shutdown": ("served", "rejected", "drained"),
     "span": ("start_ms", "end_ms"),

@@ -86,6 +86,7 @@ def build_slo_report(
     sources: Sequence[Tuple[str, Dict[str, Any], str,
                             Sequence[Dict[str, Any]]]],
     slo_p99_ms: float,
+    ratio_band: Tuple[float, float] = (0.9, 1.1),
 ) -> Dict[str, Any]:
     """Build the report from ``(load_path, load_doc, events_path,
     event_records)`` tuples — one per loadgen run. Requests join to
@@ -169,7 +170,12 @@ def build_slo_report(
                   if r["meets_slo"] and r["throughput_rps"] is not None]
     return {
         "schema": SLO_SCHEMA,
-        "slo": {"p99_ms": slo_p99_ms},
+        # ratio_band: the stage-sum honesty bar this report was held to
+        # (checked by scripts/slo_report.py --check). [0.9, 1.1] is the
+        # serialized-client bar; concurrency > 1 legitimately widens it
+        # (independent scheduler stalls land in different stages' p99s).
+        "slo": {"p99_ms": slo_p99_ms,
+                "ratio_band": [ratio_band[0], ratio_band[1]]},
         "sources": [{"load": p, "events": e}
                     for p, _, e, _ in sources],
         "totals": totals,

@@ -243,7 +243,12 @@ def _dp_sp(devices=None):
 # The exact program the serve engine AOT-compiles (masked forward, pc1
 # donated) at the certified (bucket, batch) geometries — claim-day
 # readiness covers inference, not just training. One spec per geometry,
-# enumerated from geometries.SERVE_CERTIFIED.
+# enumerated from geometries.SERVE_CERTIFIED: bf16 covers BOTH
+# geometries because it is the DEFAULT serving dtype (ISSUE 9). The
+# replica pool runs this same single-device program on every replica,
+# so certifying it once covers the pool's semantics — though each
+# replica still pays its own backend compile at startup (device-bound
+# executables; the engine compiles replica tables concurrently).
 
 def _serve_thunk(model_kwargs, bucket, bs):
     def thunk():

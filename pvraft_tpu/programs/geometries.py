@@ -93,6 +93,34 @@ SERVE_DEFAULT_BUCKETS = (2048, 4096, 8192)
 SERVE_DEFAULT_BATCH_SIZES = (1, 4)
 SERVE_DEFAULT_ITERS = 8
 
+# Serving dtypes the engine may compile, short-tag spelling included in
+# every per-dtype program name (and the SERVE_CERTIFIED variant tags).
+SERVE_DTYPES = {"float32": "fp32", "bfloat16": "bf16"}
+
+# bfloat16 is the DEFAULT serving dtype (the TPU fast path: half the HBM
+# traffic per correlation volume, MXU-native matmuls). fp32 stays one
+# flag away (`--dtype float32`), and the default is test-gated by the
+# accuracy bound below rather than taken on faith.
+SERVE_DEFAULT_DTYPE = "bfloat16"
+
+# Accuracy bound for bf16-by-default, EPE-style: mean endpoint error
+# (L2, scene units at coord_scale 1) of bf16 predictions vs the SAME
+# params served fp32 must stay below this. Measured on the CPU test
+# geometry (tiny random-init model, flow magnitude ~0.7): mean EPE
+# ~0.033, relative-to-flow-magnitude ~0.047. Pinned at ~3-4x measured
+# so toolchain noise does not flake while a real precision regression
+# (one lost mantissa bit ~= 2x) still fails; the relative bound is the
+# portable one (absolute EPE scales with flow magnitude).
+# tests/test_serve_pool.py enforces both.
+SERVE_BF16_EPE_BOUND = 0.13        # mean |flow_bf16 - flow_fp32| (units)
+SERVE_BF16_REL_EPE_BOUND = 0.15    # same, relative to mean |flow_fp32|
+
+# Replica pool size: one single-device executor per replica, data-
+# parallel across the host's local devices. 0 = one replica per local
+# device (the production default); CPU CI exercises >= 2 replicas via
+# the conftest-forced --xla_force_host_platform_device_count.
+SERVE_DEFAULT_REPLICAS = 0
+
 # pc1 is donated to every predict program: the unique input whose
 # (shape, dtype) matches the flow output, so XLA aliases instead of
 # allocating (deepcheck GJ004/GJ005 verify this on the serve.predict
@@ -102,17 +130,24 @@ SERVE_PREDICT_DONATE = (1,)
 # AOT-certified serve geometries (the aot_readiness serve leg): per
 # variant tag, the model-config overrides and the (bucket, batch_size)
 # pairs certified for the v5e topology — the latency bucket at bs 1 and
-# the throughput bucket at bs 4, fp32 plus the bf16/Pallas fast path.
+# the throughput bucket at bs 4. bf16/Pallas covers BOTH because bf16 is
+# the default serving dtype; fp32 stays certified as the flag-guarded
+# fallback.
 SERVE_CERTIFIED = (
     ("fp32", {}, ((2048, 1), (8192, 4))),
-    ("bf16_pallas", {"compute_dtype": "bfloat16"}, ((8192, 4),)),
+    ("bf16_pallas", {"compute_dtype": "bfloat16"}, ((2048, 1), (8192, 4))),
 )
 
 
-def predict_program_name(bucket: int, batch_size: int) -> str:
-    """The serve engine's per-program name ('predict_b{bucket}_bs{bs}')
-    — what /healthz, serve_compile events and profiles report."""
-    return f"predict_b{bucket}_bs{batch_size}"
+def predict_program_name(bucket: int, batch_size: int,
+                         dtype: str = "float32") -> str:
+    """The serve engine's per-program name — what /healthz,
+    serve_compile events and profiles report. fp32 keeps the historical
+    'predict_b{bucket}_bs{bs}' spelling (committed artifacts join on
+    it); other dtypes splice their short tag ('predict_bf16_b..')."""
+    short = SERVE_DTYPES[dtype]
+    prefix = "predict" if dtype == "float32" else f"predict_{short}"
+    return f"{prefix}_b{bucket}_bs{batch_size}"
 
 
 def serve_program_keys(buckets, batch_sizes):

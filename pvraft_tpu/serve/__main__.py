@@ -17,7 +17,10 @@ from pvraft_tpu import parse_int_list as _parse_ints
 from pvraft_tpu.programs.geometries import (
     SERVE_DEFAULT_BATCH_SIZES,
     SERVE_DEFAULT_BUCKETS,
+    SERVE_DEFAULT_DTYPE,
     SERVE_DEFAULT_ITERS,
+    SERVE_DEFAULT_REPLICAS,
+    SERVE_DTYPES,
 )
 
 
@@ -41,7 +44,6 @@ def _cmd_serve(args) -> int:
         truncate_k=args.truncate_k,
         corr_knn=args.corr_knn,
         graph_k=args.graph_k,
-        compute_dtype="bfloat16" if args.bf16 else "float32",
     )
     cfg = ServeConfig(
         model=model,
@@ -49,14 +51,20 @@ def _cmd_serve(args) -> int:
         batch_sizes=_parse_ints(args.batch_sizes),
         num_iters=args.iters,
         refine=args.refine,
+        dtype=args.dtype,
+        replicas=args.replicas,
     )
     telemetry = (ServeTelemetry(args.events, cfg=cfg)
                  if args.events else None)
     print(f"[serve] compiling {len(cfg.buckets) * len(cfg.batch_sizes)} "
           f"predict programs (buckets={cfg.buckets}, "
-          f"batch_sizes={cfg.batch_sizes})...", flush=True)
+          f"batch_sizes={cfg.batch_sizes}, dtype={cfg.dtype}, "
+          f"replicas={cfg.replicas or 'all'})...", flush=True)
     engine = InferenceEngine.from_checkpoint(args.ckpt, cfg,
                                              telemetry=telemetry)
+    print(f"[serve] replica pool: "
+          f"{[r.device_id for r in engine.replicas]} (device ids)",
+          flush=True)
     for rec in engine.compile_report():
         print(f"[serve]   {rec['name']}: lower {rec['lower_s']}s "
               f"compile {rec['compile_s']}s", flush=True)
@@ -124,8 +132,13 @@ def main(argv=None) -> int:
     srv.add_argument("--graph_k", type=int, default=32)
     srv.add_argument("--refine", action="store_true",
                      help="serve a stage-2 (PVRaftRefine) checkpoint")
-    srv.add_argument("--bf16", action="store_true",
-                     help="bfloat16 matmul compute (params stay float32)")
+    srv.add_argument("--dtype", default=SERVE_DEFAULT_DTYPE,
+                     choices=sorted(SERVE_DTYPES),
+                     help="serving compute dtype (params stay float32); "
+                          "bfloat16 is the default, accuracy-bound-gated "
+                          "vs float32")
+    srv.add_argument("--replicas", type=int, default=SERVE_DEFAULT_REPLICAS,
+                     help="replica pool size (0 = one per local device)")
     srv.add_argument("--max_wait_ms", type=float, default=5.0)
     srv.add_argument("--queue_depth", type=int, default=64)
     srv.add_argument("--events", default="",

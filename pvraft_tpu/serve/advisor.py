@@ -1,0 +1,199 @@
+"""Bucket-geometry advisor: learn the bucket table from live traffic.
+
+The serve engine's bucket table is a padding/compile trade: every
+request pads up to its bucket, so bucket edges far above the typical
+request size burn device FLOPs on padding, while too many buckets
+multiply AOT compile cost and HBM-resident programs. PR 7 committed the
+seed data for closing this loop — the ``pvraft_serve_request_points``
+histogram (server-side) and the loadgen artifact's ``request_points``
+mirror (client-side) record what sizes production actually sees.
+
+This module turns that histogram into a proposed bucket table:
+
+* a request whose size lands in histogram bin *i* is only known to be
+  ``<= edges[i]``, so the bin's UPPER edge is the smallest bucket that
+  provably serves it — candidate buckets are exactly the non-empty
+  bins' upper edges (anything between two edges is unsupported by the
+  data, anything above the top non-empty edge is pure waste);
+* choosing ``n_buckets`` of those candidates to minimize the expected
+  *device points per request* (``sum_i count_i * bucket_for(bin_i)``)
+  is a classic contiguous-partition DP, exact in O(bins^2 * n_buckets);
+* the same cost model scores the CURRENT table
+  (``programs/geometries.SERVE_DEFAULT_BUCKETS``) on the same
+  histogram, so the report is a cross-check, not just a proposal —
+  including the fraction of observed traffic the current table rejects.
+
+``scripts/bucket_advisor.py`` is the CLI; the proposal is advisory
+(a human promotes it into ``geometries.py``, where the registry /
+deepcheck / AOT evidence pick it up) — this tool never mutates the
+declared geometry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+ADVISOR_SCHEMA = "pvraft_bucket_advisor/v1"
+
+
+def _bins(edges: Sequence[float],
+          counts: Sequence[int]) -> List[Tuple[int, int]]:
+    """Non-empty (upper_edge, count) bins, ascending. The overflow bin
+    (counts[-1], sizes beyond the last edge) has no upper edge and is
+    reported separately — no bucket table derived from this histogram
+    can serve it."""
+    if len(counts) != len(edges) + 1:
+        raise ValueError(
+            f"histogram shape mismatch: {len(counts)} counts for "
+            f"{len(edges)} edges (want len(edges) + 1)")
+    return [(int(edges[i]), int(c))
+            for i, c in enumerate(counts[:-1]) if c]
+
+
+def propose_buckets(edges: Sequence[float], counts: Sequence[int],
+                    n_buckets: int,
+                    min_bucket: int = 0) -> Dict[str, Any]:
+    """The optimal ``n_buckets``-entry bucket table for this histogram
+    under the expected-device-points cost model (exact DP). Buckets
+    below ``min_bucket`` (the engine's ``min_points`` floor or a
+    hardware tile constraint) are disallowed; bins below it are served
+    by the smallest legal bucket."""
+    if n_buckets < 1:
+        raise ValueError("n_buckets must be >= 1")
+    bins = _bins(edges, counts)
+    overflow = int(counts[-1])
+    if not bins:
+        raise ValueError("histogram has no in-range samples")
+    # Respect the floor: candidate bucket values below min_bucket are
+    # illegal, so merge their bins into the first legal candidate.
+    candidates = sorted({max(edge, min_bucket) for edge, _ in bins})
+    weight = {c: 0 for c in candidates}
+    for edge, count in bins:
+        weight[max(edge, min_bucket)] += count
+    values = candidates
+    w = [weight[v] for v in values]
+    n = len(values)
+    k_max = min(n_buckets, n)
+    # dp[k][i]: min cost serving bins[0..i] with k buckets, the last
+    # bucket being values[i] (a bucket table must include the largest
+    # non-empty candidate or it rejects observed traffic).
+    inf = float("inf")
+    # prefix weights for O(1) range sums
+    prefix = [0]
+    for x in w:
+        prefix.append(prefix[-1] + x)
+
+    def seg(j: int, i: int) -> int:
+        """Cost of bins j..i all served by values[i]."""
+        return (prefix[i + 1] - prefix[j]) * values[i]
+
+    dp = [[inf] * n for _ in range(k_max + 1)]
+    choice = [[-1] * n for _ in range(k_max + 1)]
+    for i in range(n):
+        dp[1][i] = seg(0, i)
+    for k in range(2, k_max + 1):
+        for i in range(k - 1, n):
+            for j in range(k - 2, i):
+                cost = dp[k - 1][j] + seg(j + 1, i)
+                if cost < dp[k][i]:
+                    dp[k][i] = cost
+                    choice[k][i] = j
+    best_k = min(k_max, n)
+    cost = dp[best_k][n - 1]
+    # Walk the choices back into the bucket list.
+    buckets: List[int] = []
+    k, i = best_k, n - 1
+    while k >= 1 and i >= 0:
+        buckets.append(values[i])
+        i = choice[k][i]
+        k -= 1
+    buckets.reverse()
+    total = sum(w)
+    ideal = sum(cw * v for v, cw in zip(values, w))  # one bucket per bin
+    return {
+        "buckets": buckets,
+        "points_per_request": round(cost / total, 2),
+        "ideal_points_per_request": round(ideal / total, 2),
+        "overhead_vs_ideal": round(cost / ideal - 1.0, 4) if ideal else None,
+        "requests": total,
+        "overflow_requests": overflow,
+    }
+
+
+def score_buckets(buckets: Sequence[int], edges: Sequence[float],
+                  counts: Sequence[int]) -> Dict[str, Any]:
+    """Expected device points per request of an EXISTING bucket table on
+    this histogram (same cost model as :func:`propose_buckets`), plus
+    the fraction of observed traffic it rejects (bins whose upper edge
+    exceeds the largest bucket, and the overflow bin)."""
+    bins = _bins(edges, counts)
+    overflow = int(counts[-1])
+    table = sorted(buckets)
+    served_cost = served = rejected = 0
+    per_bucket = {int(b): 0 for b in table}
+    for edge, count in bins:
+        bucket = next((b for b in table if edge <= b), None)
+        if bucket is None:
+            rejected += count
+            continue
+        served += count
+        served_cost += count * bucket
+        per_bucket[bucket] += count
+    rejected += overflow
+    total = served + rejected
+    return {
+        "buckets": [int(b) for b in table],
+        "points_per_request": (round(served_cost / served, 2)
+                               if served else None),
+        "requests": total,
+        "served_requests": served,
+        "rejected_requests": rejected,
+        "rejected_fraction": round(rejected / total, 4) if total else None,
+        "per_bucket_requests": per_bucket,
+    }
+
+
+def build_advisor_report(edges: Sequence[float], counts: Sequence[int],
+                         current_buckets: Sequence[int],
+                         n_buckets: Optional[int] = None,
+                         min_bucket: int = 0,
+                         source: str = "<histogram>") -> Dict[str, Any]:
+    """The full advisory: proposed table (same size as the current one
+    unless ``n_buckets`` overrides), current-table score, and the
+    improvement — all from one committed histogram."""
+    k = n_buckets or len(current_buckets)
+    proposed = propose_buckets(edges, counts, k, min_bucket=min_bucket)
+    current = score_buckets(current_buckets, edges, counts)
+    improvement = None
+    if current["points_per_request"] and current["served_requests"]:
+        # Compare on the SAME population: the proposed table serves all
+        # in-range traffic while the current one may reject part of it,
+        # and per-request costs over different populations are not
+        # comparable (a more-capable table would look like a regression
+        # because it serves the big requests the current table bounces).
+        # Re-score the proposal on exactly the bins the current table
+        # serves; the extra traffic the proposal unlocks is reported as
+        # the rejected fraction next to it, not folded into the cost.
+        largest_current = max(current_buckets)
+        served_counts = [
+            c if i < len(edges) and edges[i] <= largest_current else 0
+            for i, c in enumerate(counts)]
+        proposed_on_served = score_buckets(
+            proposed["buckets"], edges, served_counts)
+        saved = (current["points_per_request"]
+                 - proposed_on_served["points_per_request"])
+        improvement = {
+            "points_per_request_saved": round(saved, 2),
+            "relative": round(saved / current["points_per_request"], 4),
+            "population": "traffic served by the current table",
+        }
+    return {
+        "schema": ADVISOR_SCHEMA,
+        "source": source,
+        "histogram": {"edges": [int(e) for e in edges],
+                      "counts": [int(c) for c in counts]},
+        "min_bucket": int(min_bucket),
+        "proposed": proposed,
+        "current": current,
+        "improvement": improvement,
+    }

@@ -1,18 +1,35 @@
-"""Bounded-queue micro-batcher with explicit backpressure.
+"""Continuous micro-batching across a replica pool, with backpressure.
 
-One worker thread per bucket pulls requests off that bucket's bounded
-queue, groups them up to ``max_batch`` (waiting at most ``max_wait_ms``
-for stragglers once the first request is in hand), and dispatches the
-group through the engine's AOT program for that (bucket, batch size).
+Three thread tiers replace PR 5's one-blocking-worker-per-bucket:
 
-Backpressure is explicit, never implicit blocking: a full queue raises
-:class:`QueueFullError` at ``submit`` time (the HTTP layer maps it to
-503) instead of stalling the caller — under sustained overload the
-client sees load-shedding immediately, and queue depth (not client
+  * per-bucket **collector** threads pull requests off their bucket's
+    bounded queue and form groups (up to ``max_batch``, straggler window
+    ``max_wait_ms``) — but never execute;
+  * one bounded **batch queue** hands formed groups to the pool
+    (capacity = replica count: batches beyond the pool's concurrency
+    stay as *requests* in their bucket queue, where ``queue_depth``
+    backpressure still governs intake);
+  * per-replica **executor** threads take the next formed group —
+    whichever bucket it came from — and run it on their replica
+    (work-stealing: a slow large-bucket batch occupies one replica
+    while the other executors keep draining the small buckets; nothing
+    head-of-line-blocks, test-gated in ``tests/test_serve_pool.py``).
+
+Continuous-batching rule: a collector waits out the straggler window
+ONLY while every replica is busy. When capacity sits idle the group
+dispatches immediately — holding work to fill a batch is a throughput
+trade that only pays when the device is the bottleneck (the measured
+CPU A/B win in BENCHMARKS.md; ``eager_when_idle=False`` restores the
+PR-7 always-wait behavior for baselines).
+
+Backpressure is explicit, never implicit blocking: a full bucket queue
+raises :class:`QueueFullError` at ``submit`` time (the HTTP layer maps
+it to 503) instead of stalling the caller — under sustained overload
+the client sees load-shedding immediately, and queue depth (not client
 sockets) bounds the in-flight work.
 
 Shutdown drains: ``shutdown(drain=True)`` stops intake, lets every
-queued request finish, then joins the workers; ``drain=False`` fails
+queued request finish, then joins the threads; ``drain=False`` fails
 queued requests with :class:`ShutdownError` instead. Both are
 test-gated under real thread concurrency (``tests/test_serve.py``).
 """
@@ -23,7 +40,7 @@ import dataclasses
 import queue
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -43,6 +60,11 @@ class BatcherConfig:
     max_batch: int = 4        # largest group per dispatch
     max_wait_ms: float = 5.0  # straggler wait once a group has a member
     queue_depth: int = 64     # per-bucket bounded queue capacity
+    # Continuous batching: dispatch a partial group immediately when a
+    # replica is idle and no formed batch is waiting (the straggler
+    # window only pays when it buys utilization). False = PR-7 baseline
+    # semantics: always wait out max_wait_ms (the A/B control leg).
+    eager_when_idle: bool = True
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -55,7 +77,7 @@ class BatcherConfig:
 
 class _Request:
     __slots__ = ("pc1", "pc2", "result", "error", "done", "t_enqueue",
-                 "abandoned", "trace", "bucket", "t_dequeue")
+                 "abandoned", "trace", "bucket", "t_dequeue", "_final")
 
     def __init__(self, pc1: np.ndarray, pc2: np.ndarray):
         self.pc1 = pc1
@@ -64,6 +86,14 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
         self.abandoned = False
+        # Outcome-recording token: exactly ONE party (the dispatch loop
+        # counting a response, or the failure path counting a reject)
+        # may record this request's ledger outcome. Without it, a
+        # waiter timing out in the window between the dispatch loop's
+        # liveness check and its accounting gets counted TWICE (a
+        # response AND a timeout), permanently skewing the in_flight
+        # gauge and the reconciliation identity.
+        self._final = threading.Lock()
         # Trace plane (obs/trace.py): the handler attaches a
         # RequestTrace for sampled requests; workers stamp dequeue /
         # dispatch times on it. None = unsampled (the common case) —
@@ -71,6 +101,11 @@ class _Request:
         self.trace = None
         self.bucket: Optional[int] = None
         self.t_dequeue: Optional[float] = None
+
+    def finalize(self) -> bool:
+        """True exactly once, for the party that gets to record this
+        request's metrics outcome (non-blocking test-and-set)."""
+        return self._final.acquire(blocking=False)
 
     def resolve(self, result: np.ndarray) -> None:
         self.result = result
@@ -83,7 +118,7 @@ class _Request:
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self.done.wait(timeout):
             # The waiter is gone (HTTP 504 already sent): mark the
-            # request so a worker that later pulls it off the queue
+            # request so an executor that later pulls its group
             # skips the dispatch instead of computing an answer nobody
             # reads. Benign race: a concurrent resolve just wastes the
             # one result.
@@ -96,7 +131,7 @@ class _Request:
 
 
 class MicroBatcher:
-    """Per-bucket bounded queues + worker threads over an engine."""
+    """Bucket collectors -> batch queue -> per-replica executors."""
 
     def __init__(self, engine: InferenceEngine, cfg: BatcherConfig,
                  telemetry=None, metrics=None):
@@ -111,9 +146,19 @@ class MicroBatcher:
         self.cfg = cfg
         self.telemetry = telemetry
         self.metrics = metrics
+        # The executor pool: the engine's replicas, or the engine itself
+        # as a single executor (test doubles without a pool).
+        self.replicas = list(getattr(engine, "replicas", ()) or ()) \
+            or [engine]
         self._queues: Dict[int, "queue.Queue[_Request]"] = {
             b: queue.Queue(maxsize=cfg.queue_depth)
             for b in engine.cfg.buckets}
+        # Formed groups awaiting an executor. Capacity = pool size:
+        # batches beyond the pool's concurrency stay as requests in the
+        # bucket queues (where queue_depth bounds intake); a collector
+        # holding a formed group blocks on put, not the submitters.
+        self._batchq: "queue.Queue[Tuple[int, List[_Request]]]" = \
+            queue.Queue(maxsize=len(self.replicas))
         self._stopping = threading.Event()
         # Serializes the submit-side {stopping check -> enqueue} against
         # shutdown setting the flag: without it a submit could pass the
@@ -125,14 +170,27 @@ class MicroBatcher:
         self._served = 0
         self._rejected = 0
         self._drained = 0
+        # Pool occupancy + per-replica accounting, all under _count_lock:
+        # _busy = executors currently inside predict (the eager-dispatch
+        # idleness signal); per-replica in-flight requests and
+        # served-batch counters feed /healthz and Prometheus.
+        self._busy = 0
+        self._replica_inflight = [0] * len(self.replicas)
+        self._replica_batches = [0] * len(self.replicas)
+        self._collectors_live = len(engine.cfg.buckets)
         self._count_lock = threading.Lock()
-        self._workers = [
-            threading.Thread(target=self._worker, args=(b,),
+        self._collectors = [
+            threading.Thread(target=self._collector, args=(b,),
                              name=f"pvraft-serve-b{b}", daemon=True)
             for b in engine.cfg.buckets
         ]
-        for w in self._workers:
-            w.start()
+        self._executors = [
+            threading.Thread(target=self._executor, args=(i,),
+                             name=f"pvraft-serve-r{i}", daemon=True)
+            for i in range(len(self.replicas))
+        ]
+        for t in (*self._collectors, *self._executors):
+            t.start()
 
     # ------------------------------------------------------------- intake --
 
@@ -208,13 +266,24 @@ class MicroBatcher:
         (504 predict timeout, 500 engine failure): already counted at
         submit, so only the outcome is recorded — otherwise /metrics
         totals never reconcile under sustained slowness and the
-        load-gen artifact's client counts contradict server_metrics."""
+        load-gen artifact's client counts contradict server_metrics.
+        Callers that hold the request handle must go through
+        :meth:`record_failure_for` so a racing dispatch cannot also
+        count it as a response."""
         with self._count_lock:
             self._rejected += 1
         if self.metrics is not None:
             self.metrics.record_failure(reason)
         if self.telemetry is not None:
             self.telemetry.emit_reject(reason)
+
+    def record_failure_for(self, req: _Request, reason: str) -> None:
+        """Record an accepted request's failure exactly once: the
+        dispatch loop may be racing to count the same request as a
+        response — whoever wins the request's finalize() token does the
+        ledger write, the loser records nothing."""
+        if req.finalize():
+            self.record_failure(reason)
 
     def _reject(self, reason: str, bucket: Optional[int] = None,
                 queue_depth: Optional[int] = None) -> None:
@@ -229,11 +298,33 @@ class MicroBatcher:
     def queue_depths(self) -> Dict[int, int]:
         return {b: q.qsize() for b, q in self._queues.items()}
 
-    # ------------------------------------------------------------- worker --
+    def batch_queue_depth(self) -> int:
+        """Formed groups awaiting an executor (Prometheus gauge)."""
+        return self._batchq.qsize()
+
+    def replica_stats(self) -> List[Dict[str, Any]]:
+        """Per-replica visibility for /healthz and Prometheus: device
+        id, requests currently executing, served-batch counter."""
+        with self._count_lock:
+            return [{"replica": i,
+                     "device_id": int(getattr(r, "device_id", i)),
+                     "in_flight": self._replica_inflight[i],
+                     "batches_total": self._replica_batches[i]}
+                    for i, r in enumerate(self.replicas)]
+
+    # -------------------------------------------------------- collectors --
+
+    def _capacity_idle(self) -> bool:
+        """True when a formed group would start executing immediately:
+        some executor is free AND no earlier group is already waiting."""
+        with self._count_lock:
+            busy = self._busy
+        return busy < len(self.replicas) and self._batchq.empty()
 
     def _collect(self, q: "queue.Queue[_Request]") -> List[_Request]:
         """One group: block briefly for a first request (so the stop flag
-        is polled), then gather up to max_batch until max_wait_ms."""
+        is polled), then gather up to max_batch. The straggler window is
+        honored only while the pool is saturated (eager_when_idle)."""
         try:
             first = q.get(timeout=0.05)
         except queue.Empty:
@@ -242,36 +333,98 @@ class MicroBatcher:
         group = [first]
         deadline = first.t_dequeue + self.cfg.max_wait_ms / 1000.0
         while len(group) < self.cfg.max_batch:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
             try:
-                req = q.get(timeout=remaining)
+                req = q.get_nowait()
             except queue.Empty:
-                break
+                if self.cfg.eager_when_idle and self._capacity_idle():
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                # Eager mode polls in short slices so the idleness
+                # check above notices an executor freeing up mid-window;
+                # baseline mode (eager off) has no such check to re-run,
+                # so it sleeps the whole window in one get — no 2 ms
+                # wakeup churn on the leg meant to reproduce PR-7.
+                wait_s = (min(remaining, 0.002)
+                          if self.cfg.eager_when_idle else remaining)
+                try:
+                    req = q.get(timeout=wait_s)
+                except queue.Empty:
+                    continue
             req.t_dequeue = time.monotonic()
             group.append(req)
         return group
 
-    def _worker(self, bucket: int) -> None:
+    def _collector(self, bucket: int) -> None:
         q = self._queues[bucket]
+        try:
+            while True:
+                group = self._collect(q)
+                if not group:
+                    if self._stopping.is_set():
+                        if not self._drain:
+                            break
+                        if q.empty():
+                            break
+                    continue
+                if self._stopping.is_set() and not self._drain:
+                    self._fail_group(group)
+                    continue
+                if not self._enqueue_batch(bucket, group):
+                    continue
+        finally:
+            # Executors poll this to know when the batch queue can no
+            # longer grow (their drain-exit condition).
+            with self._count_lock:
+                self._collectors_live -= 1
+
+    def _enqueue_batch(self, bucket: int,
+                       group: List[_Request]) -> bool:
+        """Hand a formed group to the pool; blocks while the batch
+        queue is at capacity (executors are the consumers, so this
+        resolves as replicas free up — it is NOT client-visible
+        blocking: submit already returned)."""
         while True:
-            group = self._collect(q)
-            if not group:
+            try:
+                self._batchq.put((bucket, group), timeout=0.05)
+                return True
+            except queue.Full:
+                if self._stopping.is_set() and not self._drain:
+                    self._fail_group(group)
+                    return False
+
+    def _fail_group(self, group: List[_Request]) -> None:
+        for req in group:
+            # finalize(): a request whose outcome is already recorded
+            # (waiter 504'd, or a dispatch resolved it) is skipped —
+            # failing it again would double-count the ledger and could
+            # clobber a result a waiter is reading right now.
+            if req.finalize():
+                self.record_failure("shutdown")
+                req.fail(ShutdownError("server stopped without drain"))
+
+    # --------------------------------------------------------- executors --
+
+    def _executor(self, index: int) -> None:
+        replica = self.replicas[index]
+        while True:
+            try:
+                bucket, group = self._batchq.get(timeout=0.05)
+            except queue.Empty:
                 if self._stopping.is_set():
-                    if not self._drain:
-                        break
-                    if q.empty():
+                    with self._count_lock:
+                        collectors_done = self._collectors_live == 0
+                    if collectors_done and self._batchq.empty():
                         break
                 continue
             if self._stopping.is_set() and not self._drain:
-                for req in group:
-                    self.record_failure("shutdown")
-                    req.fail(ShutdownError("server stopped without drain"))
+                self._fail_group(group)
                 continue
-            self._dispatch(bucket, group)
+            self._dispatch(index, replica, bucket, group)
 
-    def _dispatch(self, bucket: int, group: List[_Request]) -> None:
+    def _dispatch(self, index: int, replica, bucket: int,
+                  group: List[_Request]) -> None:
         # Drop requests whose waiter already timed out (504 sent): the
         # engine time would buy an answer nobody reads, and counting
         # them as served would report success for client-visible
@@ -280,22 +433,34 @@ class MicroBatcher:
         if not group:
             return
         t0 = time.monotonic()
+        with self._count_lock:
+            self._busy += 1
+            self._replica_inflight[index] += len(group)
         try:
-            flows = self.engine.predict_batch(
+            flows = replica.predict_batch(
                 [(r.pc1, r.pc2) for r in group], bucket)
-        except BaseException as e:  # noqa: BLE001 — fail the group, not the worker
+        except BaseException as e:  # noqa: BLE001 — fail the group, not the executor
             for req in group:
                 req.fail(e)
             return
+        finally:
+            with self._count_lock:
+                self._busy -= 1
+                self._replica_inflight[index] -= len(group)
         now = time.monotonic()
         # Re-check abandonment AFTER the engine call: a waiter can 504
         # while predict runs (seconds), and its request must not be
         # counted as served or have its (by-definition over-deadline)
-        # latency skew the histogram. The remaining race — a timeout
-        # between this check and the waiter reading the result — is the
-        # benign one noted in _Request.wait.
-        live = [(r, f) for r, f in zip(group, flows) if not r.abandoned]
+        # latency skew the histogram. finalize() closes the remaining
+        # race: a waiter timing out between this line and the
+        # accounting below loses the test-and-set and records nothing,
+        # so the request is counted exactly once (as the response it
+        # actually produced — the client's 504 is the one benign
+        # mismatch left, noted in _Request.wait).
+        live = [(r, f) for r, f in zip(group, flows)
+                if not r.abandoned and r.finalize()]
         bs = self.engine.batch_size_for(len(group))
+        device_id = int(getattr(replica, "device_id", index))
         for r, _ in live:
             # Re-read trace/abandoned per request: a waiter that 504'd
             # since `live` was computed is assembling its (partial) span
@@ -307,23 +472,25 @@ class MicroBatcher:
             if tr is None or r.abandoned:
                 continue
             # queue_wait: enqueue -> dequeue; batch_form: dequeue ->
-            # dispatch (straggler wait + grouping); device_execute: the
-            # AOT program incl. host fetch. For served requests the
-            # marks land before resolve() below, so the handler thread
-            # (which assembles spans after wait() returns) is
-            # ordered-after them.
+            # dispatch (straggler wait + grouping + batch-queue wait);
+            # device_execute: the AOT program incl. host fetch. For
+            # served requests the marks land before resolve() below, so
+            # the handler thread (which assembles spans after wait()
+            # returns) is ordered-after them.
             t_dq = r.t_dequeue if r.t_dequeue is not None else t0
             tr.mark("queue_wait", r.t_enqueue, t_dq)
             tr.mark("batch_form", t_dq, t0)
             tr.mark("device_execute", t0, now,
                     attrs={"bucket": bucket, "batch": bs,
-                           "n": len(group)})
+                           "n": len(group), "replica": index,
+                           "device_id": device_id})
         latencies = [(now - r.t_enqueue) * 1000.0 for r, _ in live]
         # Account BEFORE resolving: resolve() unblocks the HTTP replies,
         # and a client that immediately polls /metrics must see counts
         # covering every response it has already received.
         with self._count_lock:
             self._served += len(live)
+            self._replica_batches[index] += 1
             if self._stopping.is_set():
                 self._drained += len(live)
         # Fill reflects the dispatch itself (how full the AOT program's
@@ -336,7 +503,8 @@ class MicroBatcher:
                 bucket=bucket, batch=bs, n=len(live),
                 fill=round(fill, 4),
                 latency_ms=round((now - t0) * 1000.0, 3),
-                queue_depth=self._queues[bucket].qsize())
+                queue_depth=self._queues[bucket].qsize(),
+                replica=index, device_id=device_id)
         for req, flow in live:
             req.resolve(flow)
 
@@ -349,14 +517,23 @@ class MicroBatcher:
             already = self._stopping.is_set()
             self._drain = drain
             self._stopping.set()
-        for w in self._workers:
-            w.join(timeout)
+        for t in self._collectors:
+            t.join(timeout)
+        for t in self._executors:
+            t.join(timeout)
         if drain:
             # Defense-in-depth: _intake_lock guarantees every accepted
-            # enqueue happens-before the stop flag, and a worker only
-            # exits on (stopping AND empty), so nothing should be left.
-            # Serve any stragglers inline anyway so a drained shutdown
-            # can never strand an accepted request.
+            # enqueue happens-before the stop flag, and the thread exit
+            # conditions (collector: queue empty; executor: collectors
+            # done AND batch queue empty) mean nothing should be left.
+            # Serve any stragglers inline on replica 0 anyway so a
+            # drained shutdown can never strand an accepted request.
+            while True:
+                try:
+                    bucket, group = self._batchq.get_nowait()
+                except queue.Empty:
+                    break
+                self._dispatch(0, self.replicas[0], bucket, group)
             for bucket, q in self._queues.items():
                 while True:
                     group: List[_Request] = []
@@ -367,17 +544,22 @@ class MicroBatcher:
                             break
                     if not group:
                         break
-                    self._dispatch(bucket, group)
+                    self._dispatch(0, self.replicas[0], bucket, group)
         if not drain:
-            # Fail anything the workers didn't pick up.
+            # Fail anything the threads didn't pick up.
+            while True:
+                try:
+                    _, group = self._batchq.get_nowait()
+                except queue.Empty:
+                    break
+                self._fail_group(group)
             for q in self._queues.values():
                 while True:
                     try:
                         req = q.get_nowait()
                     except queue.Empty:
                         break
-                    self.record_failure("shutdown")
-                    req.fail(ShutdownError("server stopped without drain"))
+                    self._fail_group([req])
         if self.telemetry is not None and not already:
             with self._count_lock:
                 self.telemetry.emit_shutdown(

@@ -30,6 +30,22 @@ request) cannot perturb real slots.
 (shape, dtype) matches the flow output, so XLA aliases instead of
 allocating (deepcheck GJ004/GJ005 verify exactly this via the
 ``serve.predict`` audit entries).
+
+Replica pool: the engine is data-parallel across local devices. Each
+:class:`Replica` is a single-device executor — its own device-resident
+copy of the params and its own per-(bucket, batch) compiled program
+table. An XLA executable is bound to its device assignment, so every
+replica pays a REAL backend compile per program (only the lowering is
+cached — the committed ``serve_compile`` evidence shows replica > 0 at
+``lower_s`` ~3 ms but full ``compile_s``); replica tables therefore
+compile CONCURRENTLY at startup — wall-clock is one fail-fast first
+program plus the slowest remaining table, not replicas x table. The batcher dispatches formed batches
+to whichever replica is idle (work-stealing), so a slow large-bucket
+batch occupies one replica while the others keep draining small
+buckets. Serving dtype defaults to bfloat16
+(``geometries.SERVE_DEFAULT_DTYPE``), gated by the pinned accuracy
+bound vs fp32 (``tests/test_serve_pool.py``); fp32 is one ``--dtype
+float32`` away.
 """
 
 from __future__ import annotations
@@ -44,7 +60,10 @@ from pvraft_tpu.config import ModelConfig
 from pvraft_tpu.programs.geometries import (
     SERVE_DEFAULT_BATCH_SIZES,
     SERVE_DEFAULT_BUCKETS,
+    SERVE_DEFAULT_DTYPE,
     SERVE_DEFAULT_ITERS,
+    SERVE_DEFAULT_REPLICAS,
+    SERVE_DTYPES,
     SERVE_PREDICT_DONATE,
     predict_program_name,
     serve_program_keys,
@@ -91,6 +110,15 @@ class ServeConfig:
     # sit on a diagonal ray starting at 100 * coord_limit, so no padding
     # point can ever enter a real point's kNN neighborhood.
     coord_limit: float = 100.0
+    # Serving compute dtype: bfloat16 by default (the TPU fast path),
+    # test-gated by the pinned EPE bound vs fp32
+    # (geometries.SERVE_BF16_EPE_BOUND); "float32" is the fallback flag.
+    # Overrides the model config's compute_dtype — the serving dtype is
+    # a serve decision, declared here, not a checkpoint property.
+    dtype: str = SERVE_DEFAULT_DTYPE
+    # Replica pool size: one single-device executor per replica. 0 = one
+    # replica per local device; n > local devices is rejected at build.
+    replicas: int = SERVE_DEFAULT_REPLICAS
 
     def __post_init__(self):
         if not self.buckets:
@@ -110,6 +138,12 @@ class ServeConfig:
                 f"({self.min_points}): it could never hold a valid request")
         if self.coord_limit <= 0:
             raise ValueError("coord_limit must be positive")
+        if self.dtype not in SERVE_DTYPES:
+            raise ValueError(
+                f"dtype must be one of {tuple(SERVE_DTYPES)}, "
+                f"got {self.dtype!r}")
+        if self.replicas < 0:
+            raise ValueError("replicas must be >= 0 (0 = all local devices)")
 
     @property
     def min_points(self) -> int:
@@ -157,39 +191,164 @@ def build_predict_fn(model, num_iters: int, refine: bool = False):
     return serve_predict
 
 
-class InferenceEngine:
-    """Checkpoint -> a table of AOT-compiled bucketed predict programs.
+class Replica:
+    """One single-device executor: device-local params + its own
+    compiled (bucket, batch) program table.
 
-    Construction compiles every (bucket, batch) program up front and
-    records per-program compile seconds + XLA memory analysis
-    (``compile_report()``); a telemetry sink receives one
-    ``serve_compile`` event per program, so the startup cost is in the
-    event log before the first request."""
+    XLA executables are bound to their device assignment, so each
+    replica compiles its own table — a full backend compile per program
+    (only lowering is cached across replicas); the engine compiles the
+    tables concurrently and the per-replica cost is on the record
+    (``serve_compile`` events carry replica/device_id). ``predict_batch``
+    is the only hot method; everything batch-agnostic (validation,
+    bucket routing) stays on the engine."""
+
+    def __init__(self, index: int, device, params, engine):
+        self.index = index
+        self.device = device
+        self.device_id = int(device.id)
+        self.params = params
+        self.engine = engine
+        self.programs: Dict[Tuple[int, int], AotProgram] = {}
+
+    def predict_batch(
+        self,
+        requests: Sequence[Tuple[np.ndarray, np.ndarray]],
+        bucket: int,
+    ) -> List[np.ndarray]:
+        """Run a group of validated same-bucket requests through this
+        replica's compiled program; returns each request's un-padded
+        (n1, 3) flow. Unused batch slots repeat request 0 (exact:
+        batch-parallel ops)."""
+        if not requests:
+            return []
+        cfg = self.engine.cfg
+        bs = self.engine.batch_size_for(len(requests))
+        if len(requests) > bs:
+            raise ValueError(
+                f"{len(requests)} requests exceed the largest compiled "
+                f"batch size {bs}; the batcher must split first")
+        cl = cfg.coord_limit
+        rows1, rows2, v1, v2 = [], [], [], []
+        for pc1, pc2 in requests:
+            rows1.append(pad_points(np.asarray(pc1, np.float32), bucket, cl))
+            rows2.append(pad_points(np.asarray(pc2, np.float32), bucket, cl))
+            m1 = np.zeros(bucket, bool)
+            m1[: pc1.shape[0]] = True
+            m2 = np.zeros(bucket, bool)
+            m2[: pc2.shape[0]] = True
+            v1.append(m1)
+            v2.append(m2)
+        for _ in range(bs - len(requests)):          # fill: repeat slot 0
+            rows1.append(rows1[0])
+            rows2.append(rows2[0])
+            v1.append(v1[0])
+            v2.append(v2[0])
+        prog = self.programs[(bucket, bs)]
+        import jax
+
+        # The annotation brackets execute + host fetch (np.asarray is
+        # the sync), so the trace plane's device_execute span lines up
+        # with this named region in an XLA profile captured via
+        # /debug/trace (one region per replica: device id in the name).
+        with jax.profiler.TraceAnnotation(
+                f"serve_device_execute_b{bucket}_bs{bs}_d{self.device_id}"):
+            flow = np.asarray(prog(
+                self.params,
+                np.stack(rows1), np.stack(rows2),
+                np.stack(v1), np.stack(v2)))
+        return [flow[i, : requests[i][0].shape[0]]
+                for i in range(len(requests))]
+
+
+class InferenceEngine:
+    """Checkpoint -> a replica pool of AOT-compiled bucketed predict
+    programs.
+
+    Construction compiles every (bucket, batch) program up front on
+    every replica's device and records per-program compile seconds +
+    XLA memory analysis (``compile_report()``); a telemetry sink
+    receives one ``serve_compile`` event per (replica, program), so the
+    startup cost is in the event log before the first request. The
+    serving dtype (``cfg.dtype``, bf16 by default) overrides the model
+    config's ``compute_dtype`` — one declared serving decision instead
+    of a per-checkpoint accident."""
 
     def __init__(self, params, cfg: ServeConfig, telemetry=None):
         import jax
+        from jax.sharding import SingleDeviceSharding
 
         self.cfg = cfg
         from pvraft_tpu.models.raft import PVRaft, PVRaftRefine
 
-        self.model = (PVRaftRefine if cfg.refine else PVRaft)(cfg.model)
+        model_cfg = dataclasses.replace(cfg.model, compute_dtype=cfg.dtype)
+        self.model = (PVRaftRefine if cfg.refine else PVRaft)(model_cfg)
         self._predict_fn = build_predict_fn(
             self.model, cfg.num_iters, refine=cfg.refine)
-        # Commit params to device once; every program call reuses them.
-        self.params = jax.device_put(params)
-        # The (bucket, batch) program table is the registry's enumeration
-        # (programs/geometries.serve_program_keys) — the same iteration
-        # order aot_readiness certifies and /healthz reports.
-        self._programs: Dict[Tuple[int, int], AotProgram] = {}
-        for bucket, bs in serve_program_keys(cfg.buckets, cfg.batch_sizes):
-            prog = self._compile(bucket, bs)
-            self._programs[(bucket, bs)] = prog
+        devices = jax.local_devices()
+        n = cfg.replicas or len(devices)
+        if n > len(devices):
+            raise ValueError(
+                f"replicas={n} exceeds the {len(devices)} local devices "
+                f"(one single-device executor per replica)")
+        # Commit params to every replica device once; each program call
+        # reuses its replica's copy (no cross-device traffic per request).
+        self.replicas: List[Replica] = [
+            Replica(idx, devices[idx],
+                    jax.device_put(params, devices[idx]), self)
+            for idx in range(n)]
+        # The (bucket, batch) program table is the registry's
+        # enumeration (programs/geometries.serve_program_keys) — the
+        # same iteration order aot_readiness certifies and /healthz
+        # reports.
+        keys = list(serve_program_keys(cfg.buckets, cfg.batch_sizes))
+
+        def build_one(replica: Replica, sharding, bucket: int,
+                      bs: int) -> None:
+            prog = self._compile(bucket, bs, replica, sharding)
+            replica.programs[(bucket, bs)] = prog
             if telemetry is not None:
                 telemetry.emit_compile(
                     bucket=bucket, batch=bs,
                     lower_s=round(prog.lower_s, 3),
                     compile_s=round(prog.compile_s, 3),
-                    memory=prog.memory)
+                    memory=prog.memory,
+                    dtype=cfg.dtype, replica=replica.index,
+                    device_id=replica.device_id)
+
+        def build_table(replica: Replica, skip_first: bool) -> None:
+            sharding = SingleDeviceSharding(replica.device)
+            for bucket, bs in (keys[1:] if skip_first else keys):
+                build_one(replica, sharding, bucket, bs)
+
+        # Replica 0's FIRST program compiles alone: a broken program
+        # fails fast with one clean traceback before any threads exist.
+        # Everything else — the rest of replica 0's table and every
+        # other replica's full table — compiles CONCURRENTLY: XLA
+        # rebuilds the executable per device assignment (a full backend
+        # compile each; only lowering is cached), so threading is what
+        # keeps pool startup at ~one table of wall-clock (first program
+        # + the slowest remaining table) instead of replicas x table.
+        # Compiles release the GIL; telemetry emits are lock-serialized
+        # (events interleave across replicas, each record carries its
+        # replica id).
+        build_one(self.replicas[0],
+                  SingleDeviceSharding(self.replicas[0].device),
+                  *keys[0])
+        if len(self.replicas) == 1:
+            build_table(self.replicas[0], skip_first=True)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=len(self.replicas),
+                    thread_name_prefix="pvraft-serve-compile") as pool:
+                futures = [pool.submit(build_table, r, r.index == 0)
+                           for r in self.replicas]
+                for f in futures:
+                    f.result()          # propagate the first failure
+        self.params = self.replicas[0].params
+        self._programs = self.replicas[0].programs
 
     @classmethod
     def from_checkpoint(cls, path: str, cfg: ServeConfig, telemetry=None):
@@ -200,19 +359,24 @@ class InferenceEngine:
         variables, _ = load_params(path)
         return cls(variables, cfg, telemetry=telemetry)
 
-    def _compile(self, bucket: int, bs: int) -> AotProgram:
+    def _compile(self, bucket: int, bs: int, replica: Replica,
+                 sharding) -> AotProgram:
         import jax
 
-        f32 = jax.ShapeDtypeStruct((bs, bucket, 3), "float32")
-        vmask = jax.ShapeDtypeStruct((bs, bucket), "bool")
+        f32 = jax.ShapeDtypeStruct((bs, bucket, 3), "float32",
+                                   sharding=sharding)
+        vmask = jax.ShapeDtypeStruct((bs, bucket), "bool",
+                                     sharding=sharding)
         params_sds = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.params)
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=sharding),
+            replica.params)
         # Donate pc1 only: it is the unique input aliasing the (bs,
         # bucket, 3) f32 output; donating pc2/masks too would just be
         # silent copies (GJ004). The donation intent and program naming
         # are registry declarations (programs/geometries.py).
         return aot_compile(
-            predict_program_name(bucket, bs),
+            predict_program_name(bucket, bs, self.cfg.dtype),
             self._predict_fn,
             (params_sds, f32, f32, vmask, vmask),
             donate_argnums=SERVE_PREDICT_DONATE,
@@ -276,47 +440,10 @@ class InferenceEngine:
         requests: Sequence[Tuple[np.ndarray, np.ndarray]],
         bucket: int,
     ) -> List[np.ndarray]:
-        """Run a group of validated same-bucket requests through one
-        compiled program; returns each request's un-padded (n1, 3) flow.
-        Unused batch slots repeat request 0 (exact: batch-parallel ops)."""
-        if not requests:
-            return []
-        bs = self.batch_size_for(len(requests))
-        if len(requests) > bs:
-            raise ValueError(
-                f"{len(requests)} requests exceed the largest compiled "
-                f"batch size {bs}; the batcher must split first")
-        cl = self.cfg.coord_limit
-        rows1, rows2, v1, v2 = [], [], [], []
-        for pc1, pc2 in requests:
-            rows1.append(pad_points(np.asarray(pc1, np.float32), bucket, cl))
-            rows2.append(pad_points(np.asarray(pc2, np.float32), bucket, cl))
-            m1 = np.zeros(bucket, bool)
-            m1[: pc1.shape[0]] = True
-            m2 = np.zeros(bucket, bool)
-            m2[: pc2.shape[0]] = True
-            v1.append(m1)
-            v2.append(m2)
-        for _ in range(bs - len(requests)):          # fill: repeat slot 0
-            rows1.append(rows1[0])
-            rows2.append(rows2[0])
-            v1.append(v1[0])
-            v2.append(v2[0])
-        prog = self._programs[(bucket, bs)]
-        import jax
-
-        # The annotation brackets execute + host fetch (np.asarray is
-        # the sync), so the trace plane's device_execute span lines up
-        # with this named region in an XLA profile captured via
-        # /debug/trace.
-        with jax.profiler.TraceAnnotation(
-                f"serve_device_execute_b{bucket}_bs{bs}"):
-            flow = np.asarray(prog(
-                self.params,
-                np.stack(rows1), np.stack(rows2),
-                np.stack(v1), np.stack(v2)))
-        return [flow[i, : requests[i][0].shape[0]]
-                for i in range(len(requests))]
+        """Run a group of validated same-bucket requests on replica 0
+        (the direct API path; the batcher work-steals across the whole
+        pool). Returns each request's un-padded (n1, 3) flow."""
+        return self.replicas[0].predict_batch(requests, bucket)
 
     @shapecheck("N 3", "M 3", out="N 3")
     def predict(self, pc1: np.ndarray, pc2: np.ndarray) -> np.ndarray:

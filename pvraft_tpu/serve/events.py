@@ -33,23 +33,40 @@ class ServeTelemetry:
 
     def emit_compile(self, bucket: int, batch: int, lower_s: float,
                      compile_s: float,
-                     memory: Optional[Dict[str, Any]] = None) -> None:
+                     memory: Optional[Dict[str, Any]] = None,
+                     dtype: Optional[str] = None,
+                     replica: Optional[int] = None,
+                     device_id: Optional[int] = None) -> None:
         fields: Dict[str, Any] = {
             "bucket": bucket, "batch": batch,
             "lower_s": lower_s, "compile_s": compile_s}
         if memory is not None:
             fields["memory"] = memory
+        # Replica-pool provenance (optional, schema-additive): which
+        # dtype the program serves and which replica/device compiled it.
+        if dtype is not None:
+            fields["dtype"] = dtype
+        if replica is not None:
+            fields["replica"] = replica
+        if device_id is not None:
+            fields["device_id"] = device_id
         with self._lock:
             self.events.emit("serve_compile", **fields)
 
     def emit_batch(self, bucket: int, batch: int, n: int, fill: float,
                    latency_ms: float,
-                   queue_depth: Optional[int] = None) -> None:
+                   queue_depth: Optional[int] = None,
+                   replica: Optional[int] = None,
+                   device_id: Optional[int] = None) -> None:
         fields: Dict[str, Any] = {
             "bucket": bucket, "batch": batch, "n": n,
             "fill": fill, "latency_ms": latency_ms}
         if queue_depth is not None:
             fields["queue_depth"] = queue_depth
+        if replica is not None:
+            fields["replica"] = replica
+        if device_id is not None:
+            fields["device_id"] = device_id
         with self._lock:
             self.events.emit("serve_batch", **fields)
 

@@ -30,13 +30,66 @@ exactly that join. Both fields are optional for older artifacts."""
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 SCHEMA_VERSION = "pvraft_serve_load/v1"
+
+
+def force_host_device_count(n: int) -> None:
+    """Arrange ``n`` virtual host CPU devices for the replica pool —
+    must run BEFORE the jax backend initializes (the flag is read at
+    backend init, not jax import). Shared by the loadgen and A/B CLIs;
+    a caller-set count in XLA_FLAGS wins."""
+    if not n:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}").strip()
+
+
+def write_load_and_trace(out_path: str, artifact: Dict[str, Any],
+                         events_path: str,
+                         log_prefix: str = "loadgen"
+                         ) -> Tuple[str, Dict[str, Any]]:
+    """Validate + write one ``pvraft_serve_load/v1`` artifact and its
+    ``pvraft_trace/v1`` sibling (span trees grouped from the run's
+    events stream). The ONE write path for committed serve evidence —
+    ``scripts/serve_loadgen.py`` and ``scripts/serve_ab.py`` both call
+    it, so a schema change cannot drift between them. Returns
+    ``(trace_path, trace_doc)``; raises SystemExit(1) on any schema
+    problem (the caller is a CLI)."""
+    import sys
+
+    from pvraft_tpu.obs.trace import collect_traces, validate_trace_artifact
+
+    problems = validate_load_artifact(artifact, path=out_path)
+    if problems:
+        for p in problems:
+            print(f"[{log_prefix}] SCHEMA PROBLEM: {p}", file=sys.stderr)
+        raise SystemExit(1)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+
+    with open(events_path, "r", encoding="utf-8") as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    trace_doc = collect_traces(records, source=events_path)
+    trace_path = os.path.splitext(out_path)[0] + ".trace.json"
+    trace_problems = validate_trace_artifact(trace_doc, path=trace_path)
+    if trace_problems:
+        for p in trace_problems:
+            print(f"[{log_prefix}] TRACE SCHEMA PROBLEM: {p}",
+                  file=sys.stderr)
+        raise SystemExit(1)
+    with open(trace_path, "w") as f:
+        json.dump(trace_doc, f, indent=2)
+    return trace_path, trace_doc
 
 _REQUIRED = ("schema", "config", "compile", "requests", "latency_ms",
              "throughput_rps", "duration_s", "server_metrics")
@@ -134,6 +187,53 @@ def validate_load_artifact_file(path: str) -> List[str]:
     except (OSError, ValueError) as e:
         return [f"{path}: unreadable: {e}"]
     return validate_load_artifact(doc, path=path)
+
+
+def merge_measurements(rounds: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold several :func:`run_load` measurements of ONE server into a
+    single artifact-shaped measurement — the interleaved-A/B path
+    (``scripts/serve_ab.py``): each leg's rounds alternate with the
+    other leg's on the same host, then merge per leg. Latency quantiles
+    are recomputed from the concatenated per-request samples (exact,
+    same estimator); ``server_metrics`` is the LAST round's snapshot
+    (the server's counters are cumulative across its rounds)."""
+    if not rounds:
+        raise ValueError("no measurements to merge")
+    from pvraft_tpu.obs.slo import exact_quantile
+
+    per_request = [r for m in rounds for r in m["per_request"]]
+    lat = sorted(r["ms"] for r in per_request
+                 if r["status"] == 200 and r["ms"] is not None)
+    duration = sum(m["duration_s"] for m in rounds)
+    requests = {
+        key: sum(m["requests"][key] for m in rounds)
+        for key in ("total", "ok", "rejected", "errors")}
+    edges = rounds[0]["request_points"]["edges"]
+    counts = [0] * len(rounds[0]["request_points"]["counts"])
+    for m in rounds:
+        if m["request_points"]["edges"] != edges:
+            raise ValueError("rounds use different histogram edges")
+        counts = [a + b for a, b in
+                  zip(counts, m["request_points"]["counts"])]
+
+    def pct(q: float) -> Optional[float]:
+        v = exact_quantile(lat, q)
+        return None if v is None else round(v, 3)
+
+    return {
+        "requests": requests,
+        "latency_ms": {
+            "p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
+            "mean": (round(sum(lat) / len(lat), 3) if lat else None),
+            "max": round(lat[-1], 3) if lat else None,
+        },
+        "throughput_rps": (round(requests["ok"] / duration, 3)
+                           if duration else 0.0),
+        "duration_s": round(duration, 3),
+        "per_request": per_request,
+        "request_points": {"edges": edges, "counts": counts},
+        "server_metrics": rounds[-1]["server_metrics"],
+    }
 
 
 def _post_json(host: str, port: int, path: str, doc: Dict[str, Any],

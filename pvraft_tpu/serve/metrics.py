@@ -97,6 +97,12 @@ class ServeMetrics:
         self._lock = threading.Lock()
         self.requests_total = 0
         self.responses_total = 0
+        # Accepted requests whose outcome is not yet recorded. Updated
+        # under the same lock as every counter, so the reconciliation
+        # identity `requests_total == responses_total + sum(rejected) +
+        # in_flight` holds at EVERY snapshot, not just at quiescence.
+        # Prometheus/healthz-only (the JSON snapshot shape is frozen).
+        self.in_flight = 0
         self.rejected: Dict[str, int] = {}
         self.batches_total = 0
         self.batch_fill_sum = 0.0
@@ -113,6 +119,7 @@ class ServeMetrics:
                       n_points: Optional[int] = None) -> None:
         with self._lock:
             self.requests_total += 1
+            self.in_flight += 1
             self.per_bucket_requests[int(bucket)] = (
                 self.per_bucket_requests.get(int(bucket), 0) + 1)
             if n_points is not None:
@@ -143,6 +150,7 @@ class ServeMetrics:
         sum(rejected) + in_flight`` without double-counting the request."""
         with self._lock:
             self.rejected[reason] = self.rejected.get(reason, 0) + 1
+            self.in_flight -= 1
 
     def record_batch(self, n: int, fill: float,
                      latencies_ms: List[float]) -> None:
@@ -150,6 +158,7 @@ class ServeMetrics:
             self.batches_total += 1
             self.batch_fill_sum += fill
             self.responses_total += n
+            self.in_flight -= n
             for ms in latencies_ms:
                 self.latency.observe(ms)
 
@@ -172,14 +181,19 @@ class ServeMetrics:
             snap["queue_depth"] = {str(k): v for k, v in queue_depths.items()}
         return snap
 
-    def prometheus(self, queue_depths: Optional[Dict[int, int]] = None
-                   ) -> str:
+    def prometheus(self, queue_depths: Optional[Dict[int, int]] = None,
+                   replica_stats: Optional[List[Dict[str, Any]]] = None,
+                   batch_queue_depth: Optional[int] = None) -> str:
         """Prometheus text exposition 0.0.4 of every counter, gauge and
         histogram — serve with ``Content-Type: text/plain;
         version=0.0.4``. Rendered under the one metrics lock so the
-        scrape is as consistent as the JSON snapshot."""
+        scrape is as consistent as the JSON snapshot. ``replica_stats``
+        (``MicroBatcher.replica_stats()``) and ``batch_queue_depth`` are
+        live pool gauges sampled by the caller, like ``queue_depths``."""
         with self._lock:
-            return render_prometheus(self, queue_depths)
+            return render_prometheus(self, queue_depths,
+                                     replica_stats=replica_stats,
+                                     batch_queue_depth=batch_queue_depth)
 
 
 # ------------------------------------------------ Prometheus exposition --
@@ -244,7 +258,9 @@ class _PromDoc:
 
 
 def render_prometheus(metrics: "ServeMetrics",
-                      queue_depths: Optional[Dict[int, int]] = None) -> str:
+                      queue_depths: Optional[Dict[int, int]] = None,
+                      replica_stats: Optional[List[Dict[str, Any]]] = None,
+                      batch_queue_depth: Optional[int] = None) -> str:
     """The ``pvraft_serve_*`` exposition. Caller must hold the metrics
     lock (use :meth:`ServeMetrics.prometheus`)."""
     doc = _PromDoc()
@@ -254,6 +270,10 @@ def render_prometheus(metrics: "ServeMetrics",
     doc.family("pvraft_serve_responses_total", "counter",
                "Successful predict responses.")
     doc.sample("pvraft_serve_responses_total", metrics.responses_total)
+    doc.family("pvraft_serve_in_flight", "gauge",
+               "Accepted requests whose outcome is not yet recorded "
+               "(requests_total == responses_total + rejected + this).")
+    doc.sample("pvraft_serve_in_flight", metrics.in_flight)
     doc.family("pvraft_serve_rejected_total", "counter",
                "Rejected or failed requests by serve_reject reason.")
     for reason, count in sorted(metrics.rejected.items()):
@@ -278,6 +298,26 @@ def render_prometheus(metrics: "ServeMetrics",
         for bucket, depth in sorted(queue_depths.items()):
             doc.sample("pvraft_serve_queue_depth", depth,
                        {"bucket": bucket})
+    if batch_queue_depth is not None:
+        doc.family("pvraft_serve_batch_queue_depth", "gauge",
+                   "Formed micro-batches awaiting a replica executor.")
+        doc.sample("pvraft_serve_batch_queue_depth", batch_queue_depth)
+    if replica_stats:
+        doc.family("pvraft_serve_replica_in_flight", "gauge",
+                   "Requests currently executing per replica.")
+        for row in replica_stats:
+            doc.sample("pvraft_serve_replica_in_flight",
+                       row["in_flight"],
+                       {"replica": row["replica"],
+                        "device": row["device_id"]})
+        doc.family("pvraft_serve_replica_batches_total", "counter",
+                   "Micro-batches served per replica (work-stealing "
+                   "balance check).")
+        for row in replica_stats:
+            doc.sample("pvraft_serve_replica_batches_total",
+                       row["batches_total"],
+                       {"replica": row["replica"],
+                        "device": row["device_id"]})
     doc.family("pvraft_serve_latency_ms", "histogram",
                "End-to-end request latency (enqueue to resolve), ms.")
     doc.histogram("pvraft_serve_latency_ms", metrics.latency)

@@ -160,6 +160,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "buckets": list(self.batcher.engine.cfg.buckets),
                 "batch_sizes": list(self.batcher.engine.cfg.batch_sizes),
                 "min_points": self.batcher.engine.cfg.min_points,
+                "dtype": getattr(self.batcher.engine.cfg, "dtype",
+                                 "float32"),
+                # Per-replica visibility (ISSUE 9 satellite): device id,
+                # in-flight count, served-batch counter per replica.
+                "replicas": self.batcher.replica_stats(),
+                "in_flight": (self.metrics.in_flight
+                              if self.metrics is not None else None),
                 "programs": self.batcher.engine.compile_report(),
                 "telemetry": {
                     "events_path": self.events_path or None,
@@ -174,8 +181,11 @@ class _Handler(BaseHTTPRequestHandler):
             fmt = urllib.parse.parse_qs(query).get("format", ["json"])[0]
             depths = self.batcher.queue_depths()
             if fmt == "prometheus":
-                text = (self.metrics.prometheus(depths)
-                        if self.metrics is not None else "")
+                text = (self.metrics.prometheus(
+                    depths,
+                    replica_stats=self.batcher.replica_stats(),
+                    batch_queue_depth=self.batcher.batch_queue_depth())
+                    if self.metrics is not None else "")
                 self._reply(200, text.encode("utf-8"), PROM_CONTENT_TYPE)
             elif fmt == "json":
                 snap = (self.metrics.snapshot(depths)
@@ -327,6 +337,7 @@ class _Handler(BaseHTTPRequestHandler):
                               "msgpack": use_msgpack,
                               "n1": int(pc1.shape[0]),
                               "n2": int(pc2.shape[0])})
+        req = None
         try:
             req = self.batcher.submit(pc1, pc2, trace=trace)
             flow = req.wait(self.predict_timeout_s)
@@ -346,12 +357,21 @@ class _Handler(BaseHTTPRequestHandler):
         except TimeoutError as e:
             # Accepted-then-failed: counted at submit, so record the
             # outcome (not a fresh request) to keep /metrics reconciled.
-            self.batcher.record_failure("timeout")
+            # record_failure_for: if the dispatch loop resolved the
+            # request in the same instant, IT already counted the
+            # response — recording a timeout too would double-book.
+            self.batcher.record_failure_for(req, "timeout")
             self._reply_error(504, "timeout", str(e))
             self._finish_trace(trace, 504)
             return
         except Exception as e:  # noqa: BLE001 — a handler must answer, not die
-            self.batcher.record_failure("internal")
+            if req is not None:
+                self.batcher.record_failure_for(req, "internal")
+            else:
+                # submit itself blew up before accepting the request:
+                # nothing was counted yet, so this is a fresh reject,
+                # not an accepted-request outcome.
+                self.batcher.record_reject("internal")
             self._reply_error(500, "internal", f"{type(e).__name__}: {e}")
             self._finish_trace(trace, 500)
             return
@@ -437,7 +457,8 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
                   port: int = 0, telemetry=None,
                   predict_timeout_s: float = 60.0,
                   quiet: bool = True, trace_sample_every: int = 16,
-                  trace_dir: str = "") -> ServeHTTPServer:
+                  trace_dir: str = "",
+                  eager_when_idle: bool = True) -> ServeHTTPServer:
     """The one canonical engine -> metrics -> batcher -> HTTP assembly,
     shared by ``python -m pvraft_tpu.serve`` and the load generator so
     the two serving surfaces cannot drift: ``max_batch`` is always the
@@ -445,13 +466,15 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
     both the batcher and the HTTP layer. ``trace_sample_every`` traces
     1-in-N requests (1 = every request — what loadgen uses; 0 = off);
     sampled spans go to ``telemetry`` when present and always feed the
-    per-stage Prometheus histograms. Returns an unstarted server
-    (``.start()`` / ``.shutdown()``)."""
+    per-stage Prometheus histograms. ``eager_when_idle=False`` restores
+    the PR-7 always-wait straggler window (the A/B baseline leg).
+    Returns an unstarted server (``.start()`` / ``.shutdown()``)."""
     metrics = ServeMetrics(engine.cfg.buckets)
     batcher = MicroBatcher(
         engine,
         BatcherConfig(max_batch=max(engine.cfg.batch_sizes),
-                      max_wait_ms=max_wait_ms, queue_depth=queue_depth),
+                      max_wait_ms=max_wait_ms, queue_depth=queue_depth,
+                      eager_when_idle=eager_when_idle),
         telemetry=telemetry, metrics=metrics)
     tracer = Tracer(
         sample_every=trace_sample_every,

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Propose a serve bucket geometry from the committed request-size
+histogram — the adaptive-bucket loop PR 7's ``pvraft_serve_request_points``
+histogram was committed to seed (ROADMAP item 3).
+
+Reads the ``request_points`` histogram of one or more
+``pvraft_serve_load/v1`` artifacts (what sizes were actually driven /
+seen), runs the exact partition DP in ``pvraft_tpu/serve/advisor.py``,
+and prints the proposed bucket table next to the score of the declared
+production table (``pvraft_tpu/programs/geometries.SERVE_DEFAULT_BUCKETS``)
+on the same traffic:
+
+    python scripts/bucket_advisor.py --load artifacts/serve_cpu_synthetic.json
+    python scripts/bucket_advisor.py --load ... --n-buckets 4 \
+        --out artifacts/bucket_advisor.json
+
+The proposal is ADVISORY: promoting it means editing ``geometries.py``
+(the single source the engine, registry, deepcheck and AOT evidence all
+read) — this script never mutates the declared geometry, it argues with
+numbers. jax is never imported (pure host-side arithmetic).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pvraft_tpu.programs.geometries import (  # noqa: E402 — needs the path hack
+    SERVE_DEFAULT_BUCKETS,
+)
+from pvraft_tpu.serve.advisor import build_advisor_report  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--load", action="append", required=True,
+                    help="pvraft_serve_load/v1 artifact carrying a "
+                         "request_points histogram (repeatable; "
+                         "histograms are summed)")
+    ap.add_argument("--n-buckets", type=int, default=0,
+                    help="proposed table size (default: match the "
+                         "current production table)")
+    ap.add_argument("--min-bucket", type=int, default=0,
+                    help="smallest legal bucket (e.g. the model's "
+                         "min_points floor)")
+    ap.add_argument("--out", default="",
+                    help="also write the report as JSON")
+    args = ap.parse_args()
+
+    edges, counts = None, None
+    for path in args.load:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        rp = doc.get("request_points")
+        if not rp:
+            print(f"[bucket_advisor] {path} has no request_points "
+                  f"histogram (pre-trace artifact?)", file=sys.stderr)
+            return 2
+        if edges is None:
+            edges = rp["edges"]
+            counts = list(rp["counts"])
+        elif rp["edges"] != edges:
+            print(f"[bucket_advisor] {path} uses different histogram "
+                  f"edges; cannot sum", file=sys.stderr)
+            return 2
+        else:
+            counts = [a + b for a, b in zip(counts, rp["counts"])]
+
+    report = build_advisor_report(
+        edges, counts, SERVE_DEFAULT_BUCKETS,
+        n_buckets=args.n_buckets or None,
+        min_bucket=args.min_bucket,
+        source=",".join(args.load))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"[bucket_advisor] wrote {args.out}")
+    print(json.dumps(report, indent=2))
+    cur = report["current"]
+    prop = report["proposed"]
+    print(f"[bucket_advisor] current {cur['buckets']} -> "
+          f"{cur['points_per_request']} device points/request "
+          f"(rejects {cur['rejected_fraction']}); proposed "
+          f"{prop['buckets']} -> {prop['points_per_request']} "
+          f"points/request")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

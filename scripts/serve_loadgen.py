@@ -57,7 +57,28 @@ def main() -> int:
     ap.add_argument("--max_wait_ms", type=float, default=10.0)
     ap.add_argument("--queue_depth", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="replica pool size (0 = one per local device)")
+    ap.add_argument("--dtype", default="float32",
+                    choices=("float32", "bfloat16"),
+                    help="serving dtype; CPU evidence defaults to fp32 "
+                         "(bf16 is the TPU fast path — emulated and "
+                         "slower on CPU, it would mis-measure the "
+                         "machinery)")
+    ap.add_argument("--device_count", type=int, default=0,
+                    help="force N virtual host CPU devices "
+                         "(--xla_force_host_platform_device_count) so "
+                         "the pool has devices to spread over")
+    ap.add_argument("--no-eager", action="store_true",
+                    help="PR-7 baseline batching: always wait out "
+                         "max_wait_ms (the A/B control leg)")
     args = ap.parse_args()
+
+    # Virtual device count must land before the backend initializes
+    # (loadgen.py is jax-free at import time, so this is safe here).
+    from pvraft_tpu.serve.loadgen import force_host_device_count
+
+    force_host_device_count(args.device_count)
 
     # CPU pin before the backend commits (tooling must not grab a TPU).
     import jax
@@ -76,14 +97,15 @@ def main() -> int:
     from pvraft_tpu.serve.loadgen import (
         SCHEMA_VERSION,
         run_load,
-        validate_load_artifact,
+        write_load_and_trace,
     )
 
     model = ModelConfig(truncate_k=args.truncate_k, graph_k=args.graph_k,
                         corr_knn=args.corr_knn)
     cfg = ServeConfig(model=model, buckets=_parse_ints(args.buckets),
                       batch_sizes=_parse_ints(args.batch_sizes),
-                      num_iters=args.iters)
+                      num_iters=args.iters, dtype=args.dtype,
+                      replicas=args.replicas)
     events_path = args.events or (
         os.path.splitext(args.out)[0] + ".events.jsonl")
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
@@ -113,9 +135,12 @@ def main() -> int:
     # request's span tree must exist for the slo_report join.
     server = build_service(engine, max_wait_ms=args.max_wait_ms,
                            queue_depth=args.queue_depth,
-                           telemetry=telemetry, trace_sample_every=1)
+                           telemetry=telemetry, trace_sample_every=1,
+                           eager_when_idle=not args.no_eager)
     server.start()
-    print(f"[loadgen] serving on port {server.port}; "
+    print(f"[loadgen] serving on port {server.port} "
+          f"({len(engine.replicas)} replicas, dtype {cfg.dtype}, "
+          f"{'baseline' if args.no_eager else 'continuous'} batching); "
           f"{args.requests} requests x {args.concurrency} clients",
           flush=True)
 
@@ -146,7 +171,7 @@ def main() -> int:
             "truncate_k": model.truncate_k,
             "graph_k": model.graph_k,
             "corr_knn": model.corr_knn,
-            "compute_dtype": model.compute_dtype,
+            "compute_dtype": cfg.dtype,
             "requests": args.requests,
             "concurrency": args.concurrency,
             "max_wait_ms": args.max_wait_ms,
@@ -154,33 +179,16 @@ def main() -> int:
             "point_counts": counts,
             "weights": args.ckpt or "random_init",
             "platform": jax.devices()[0].platform,
+            "replicas": len(engine.replicas),
+            "eager_when_idle": not args.no_eager,
         },
         "compile": engine.compile_report(),
         **measurement,
     }
-    problems = validate_load_artifact(artifact, path=args.out)
-    if problems:
-        for p in problems:
-            print(f"[loadgen] SCHEMA PROBLEM: {p}", file=sys.stderr)
-        return 1
-    with open(args.out, "w") as f:
-        json.dump(artifact, f, indent=2)
-
-    # Group the run's span events into the committed pvraft_trace/v1
-    # artifact (the per-request span trees, completeness pre-checked).
-    from pvraft_tpu.obs.trace import collect_traces, validate_trace_artifact
-
-    with open(events_path, "r", encoding="utf-8") as f:
-        records = [json.loads(line) for line in f if line.strip()]
-    trace_doc = collect_traces(records, source=events_path)
-    trace_path = os.path.splitext(args.out)[0] + ".trace.json"
-    trace_problems = validate_trace_artifact(trace_doc, path=trace_path)
-    if trace_problems:
-        for p in trace_problems:
-            print(f"[loadgen] TRACE SCHEMA PROBLEM: {p}", file=sys.stderr)
-        return 1
-    with open(trace_path, "w") as f:
-        json.dump(trace_doc, f, indent=2)
+    # Validate + write the load artifact and its trace sibling (the one
+    # shared write path — serve_ab.py commits through it too).
+    trace_path, trace_doc = write_load_and_trace(args.out, artifact,
+                                                 events_path)
 
     print(f"[loadgen] wrote {args.out}, {events_path} and {trace_path}")
     print(f"[loadgen] traces: {trace_doc['counts']}")

@@ -13,9 +13,16 @@ the configured p99 SLO:
 
 ``--check`` enforces the evidence bar the report exists for: every ok
 request traced with a COMPLETE span tree (ingress through respond, no
-orphans), and the per-stage p99 sum within 10% of the end-to-end p99
-(``stage_sum_ratio`` in [0.9, 1.1]) — exits non-zero otherwise, so the
-committed artifact cannot silently degrade.
+orphans), and the per-stage p99 sum within a declared band of the
+end-to-end p99 (``stage_sum_ratio``, default [0.9, 1.1]) — exits
+non-zero otherwise, so the committed artifact cannot silently degrade.
+``--ratio-min/--ratio-max`` widen the band for measurements where the
+decomposition honestly cannot telescope: at client concurrency > 1,
+independent scheduler stalls land in DIFFERENT stages' p99s, so the
+stage-p99 sum legitimately exceeds the e2e p99 (measured 1.2-1.55 at
+concurrency 4-8 on the shared CPU host, BENCHMARKS.md "SLO evidence") —
+the widened bound is recorded in the report itself (``slo.ratio_band``)
+so a reader sees which bar the artifact was held to.
 
 ``--emit-event`` appends an ``slo_report`` record to the (first) events
 stream, pointing at the written report — the run's own ledger records
@@ -50,8 +57,14 @@ def main() -> int:
     ap.add_argument("--out", default="artifacts/serve_cpu_synthetic.slo.json")
     ap.add_argument("--check", action="store_true",
                     help="fail unless every ok request has a complete "
-                         "span tree and stage p99s sum to within 10%% of "
-                         "the e2e p99")
+                         "span tree and stage p99s sum to within the "
+                         "declared band of the e2e p99")
+    ap.add_argument("--ratio-min", type=float, default=0.9,
+                    help="lower stage_sum_ratio bound for --check")
+    ap.add_argument("--ratio-max", type=float, default=1.1,
+                    help="upper stage_sum_ratio bound for --check "
+                         "(widen deliberately at concurrency > 1; the "
+                         "band is recorded in the report)")
     ap.add_argument("--emit-event", action="store_true",
                     help="append an slo_report event to the first events "
                          "stream")
@@ -73,7 +86,8 @@ def main() -> int:
             records = [json.loads(line) for line in f if line.strip()]
         sources.append((load_path, load_doc, events_path, records))
 
-    report = build_slo_report(sources, slo_p99_ms=args.slo_p99_ms)
+    report = build_slo_report(sources, slo_p99_ms=args.slo_p99_ms,
+                              ratio_band=(args.ratio_min, args.ratio_max))
     problems = validate_slo_report(report, path=args.out)
     if problems:
         for p in problems:
@@ -94,11 +108,11 @@ def main() -> int:
         failures.append(f"{totals['orphan_spans']} orphan spans")
     for row in report["programs"]:
         ratio = row["stage_sum_ratio"]
-        if ratio is None or not 0.9 <= ratio <= 1.1:
+        if ratio is None or not args.ratio_min <= ratio <= args.ratio_max:
             failures.append(
                 f"bucket {row['bucket']} bs {row['batch']} "
                 f"{row['dtype']}: stage p99 sum / e2e p99 = {ratio} "
-                f"(outside [0.9, 1.1])")
+                f"(outside [{args.ratio_min}, {args.ratio_max}])")
     for msg in failures:
         print(f"[slo_report] EVIDENCE GAP: {msg}",
               file=sys.stderr if args.check else sys.stdout)

@@ -273,7 +273,14 @@ def test_profile_ladder_mirrors_registry():
 
 def test_serve_program_key_enumeration():
     assert list(g.serve_program_keys((32, 64), (2,))) == [(32, 2), (64, 2)]
+    # fp32 keeps the historical spelling (committed artifacts join on
+    # it); other dtypes splice their short tag.
     assert g.predict_program_name(32, 2) == "predict_b32_bs2"
+    assert g.predict_program_name(32, 2, "float32") == "predict_b32_bs2"
+    assert g.predict_program_name(32, 2, "bfloat16") == \
+        "predict_bf16_b32_bs2"
+    with pytest.raises(KeyError):
+        g.predict_program_name(32, 2, "float64")
     # ServeConfig defaults are the registry-declared production table.
     from pvraft_tpu.serve.engine import ServeConfig
 

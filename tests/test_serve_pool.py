@@ -1,0 +1,531 @@
+"""Replica-pool serving gate (ISSUE 9): multi-device data parallelism,
+continuous batching, the bf16-by-default accuracy bound, per-replica
+observability, and the bucket advisor.
+
+Multi-replica tests rely on the virtual CPU device pool pinned by
+``tests/conftest.py`` (``--xla_force_host_platform_device_count=8`` set
+BEFORE the backend initializes) — the first test asserts that pin so a
+conftest regression fails loudly here instead of silently collapsing
+every pool test to one device.
+
+The real-engine fixture compiles 2 tiny programs x 2 replicas once per
+module (replica > 0 compiles hit the in-process executable cache);
+deterministic concurrency properties (work-stealing, no head-of-line
+blocking, live in-flight accounting) use gated fake replicas — real
+thread interleavings, no XLA in the loop.
+"""
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.config import ModelConfig
+from pvraft_tpu.models import PVRaft
+from pvraft_tpu.programs import geometries as g
+from pvraft_tpu.serve import (
+    BatcherConfig,
+    InferenceEngine,
+    MicroBatcher,
+    ServeConfig,
+    ServeHTTPServer,
+    ServeMetrics,
+    ServeTelemetry,
+)
+
+TINY_MODEL = ModelConfig(truncate_k=16, corr_knn=8, graph_k=4)
+POOL_SERVE = ServeConfig(model=TINY_MODEL, buckets=(32,),
+                         batch_sizes=(1, 2), num_iters=2,
+                         dtype="float32", replicas=2)
+ITERS = POOL_SERVE.num_iters
+
+
+def _cloud(rng, n):
+    return rng.uniform(-1, 1, (n, 3)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def pool():
+    """(engine, params): one 2-replica fp32 engine for the module."""
+    rng = np.random.default_rng(0)
+    model = PVRaft(TINY_MODEL)
+    pc = jnp.asarray(_cloud(rng, 24)[None])
+    params = model.init(jax.random.key(0), pc, pc, ITERS)
+    engine = InferenceEngine(params, POOL_SERVE)
+    return engine, params
+
+
+def test_forced_device_count_pin():
+    """The multi-replica tests need >= 2 devices; conftest.py pins the
+    virtual CPU pool (XLA_FLAGS, before backend init). If this fails,
+    every pool test below is running degenerate — fix conftest first."""
+    import os
+
+    assert "xla_force_host_platform_device_count" in \
+        os.environ.get("XLA_FLAGS", "")
+    assert jax.device_count() >= 2
+
+
+# ------------------------------------------------------------- replicas --
+
+
+def test_replica_pool_devices_and_parity(pool):
+    """Each replica executes on its own device and produces bit-identical
+    flows (same program, same params, different device)."""
+    engine, _ = pool
+    assert len(engine.replicas) == 2
+    ids = [r.device_id for r in engine.replicas]
+    assert len(set(ids)) == 2
+    rng = np.random.default_rng(1)
+    req = (_cloud(rng, 20), _cloud(rng, 20))
+    flows = [r.predict_batch([req], 32)[0] for r in engine.replicas]
+    np.testing.assert_array_equal(flows[0], flows[1])
+    assert flows[0].shape == (20, 3)
+
+
+def test_replicas_exceeding_devices_rejected():
+    with pytest.raises(ValueError):
+        # jax.device_count() is 8 under conftest; 99 can never fit.
+        cfg = ServeConfig(model=TINY_MODEL, buckets=(32,),
+                          batch_sizes=(1,), num_iters=2,
+                          dtype="float32", replicas=99)
+        InferenceEngine({"params": {}}, cfg)
+
+
+def test_pool_batcher_serves_exactly(pool):
+    """Concurrent requests through the pool batcher come back as the
+    exact single-path flows, and the per-replica served-batch counters
+    account for every dispatch."""
+    engine, _ = pool
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=5, queue_depth=32),
+        metrics=metrics)
+    rng = np.random.default_rng(2)
+    reqs = [(_cloud(rng, 16 + i), _cloud(rng, 16 + i)) for i in range(8)]
+    want = [engine.predict(pc1, pc2) for pc1, pc2 in reqs]
+    handles = [None] * len(reqs)
+
+    def client(i):
+        handles[i] = batcher.submit(*reqs[i])
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, h in enumerate(handles):
+        np.testing.assert_array_equal(h.wait(60), want[i])
+    batcher.shutdown(drain=True)
+    stats = batcher.replica_stats()
+    assert [s["replica"] for s in stats] == [0, 1]
+    assert len({s["device_id"] for s in stats}) == 2
+    assert sum(s["batches_total"] for s in stats) == len(reqs)
+    assert all(s["in_flight"] == 0 for s in stats)
+    assert metrics.in_flight == 0
+    snap = metrics.snapshot()
+    assert snap["requests_total"] == len(reqs)
+    assert snap["responses_total"] + sum(snap["rejected"].values()) \
+        == snap["requests_total"]
+
+
+# -------------------------------------------------- bf16 accuracy bound --
+
+
+def test_bf16_default_within_pinned_accuracy_bound(pool):
+    """The bf16-by-default serving dtype is held to a pinned EPE-style
+    bound vs fp32 on the SAME params — the gate the default rides on
+    (geometries.SERVE_BF16_EPE_BOUND). Measured on this geometry: mean
+    EPE ~0.03 at mean flow magnitude ~0.7 (relative ~0.05); the pins
+    leave ~3x headroom for toolchain drift while a real precision
+    regression (a lost mantissa bit ~= 2x) still fails."""
+    engine, params = pool
+    bf16 = InferenceEngine(params, ServeConfig(
+        model=TINY_MODEL, buckets=(32,), batch_sizes=(1,),
+        num_iters=ITERS, dtype="bfloat16", replicas=1))
+    assert bf16.cfg.dtype == "bfloat16"
+    rng = np.random.default_rng(3)
+    epe, mag = [], []
+    for n in (18, 24, 32):
+        pc1, pc2 = _cloud(rng, n), _cloud(rng, n)
+        f32 = engine.predict(pc1, pc2)
+        f16 = bf16.predict(pc1, pc2)
+        assert f16.dtype == np.float32        # output stays f32
+        epe.append(np.linalg.norm(f16 - f32, axis=1).mean())
+        mag.append(np.linalg.norm(f32, axis=1).mean())
+    mean_epe = float(np.mean(epe))
+    rel = mean_epe / float(np.mean(mag))
+    assert mean_epe <= g.SERVE_BF16_EPE_BOUND, (mean_epe, epe)
+    assert rel <= g.SERVE_BF16_REL_EPE_BOUND, (rel, mean_epe, mag)
+
+
+def test_bf16_program_names_are_dtype_qualified(pool):
+    _, params = pool
+    bf16 = InferenceEngine(params, ServeConfig(
+        model=TINY_MODEL, buckets=(32,), batch_sizes=(1,),
+        num_iters=ITERS, dtype="bfloat16", replicas=1))
+    assert [r["name"] for r in bf16.compile_report()] == \
+        ["predict_bf16_b32_bs1"]
+
+
+# ------------------------------------- fake pool (deterministic threads) --
+
+
+class _GateReplica:
+    """Fake single-device executor: instant flows, per-bucket gates so a
+    test can hold a chosen bucket's batch in flight deterministically."""
+
+    def __init__(self, engine, index):
+        self.engine = engine
+        self.index = index
+        self.device_id = index
+        self.started = {b: threading.Event() for b in engine.cfg.buckets}
+
+    def predict_batch(self, requests, bucket):
+        self.started[bucket].set()
+        self.engine.gates[bucket].wait(30)
+        return [np.asarray(pc2[: pc1.shape[0]] - pc1, np.float32)
+                for pc1, pc2 in requests]
+
+
+class _PoolFakeEngine:
+    """Pool-shaped engine double: real routing, gated fake replicas."""
+
+    def __init__(self, buckets=(32, 64), batch_sizes=(1, 2), n_replicas=2):
+        self.cfg = SimpleNamespace(
+            buckets=buckets, batch_sizes=batch_sizes, min_points=4,
+            coord_limit=100.0, dtype="float32")
+        self.gates = {b: threading.Event() for b in buckets}
+        for gate in self.gates.values():
+            gate.set()
+        self.replicas = [_GateReplica(self, i) for i in range(n_replicas)]
+
+    def validate_request(self, pc1, pc2):
+        from pvraft_tpu.serve.engine import RequestError
+
+        n = max(pc1.shape[0], pc2.shape[0])
+        for b in self.cfg.buckets:
+            if n <= b:
+                return b
+        raise RequestError("too_large", "too large")
+
+    def batch_size_for(self, n):
+        for bs in self.cfg.batch_sizes:
+            if n <= bs:
+                return bs
+        return self.cfg.batch_sizes[-1]
+
+    def compile_report(self):
+        return []
+
+
+def _pc(n, seed=0):
+    return np.random.default_rng(seed).uniform(
+        -1, 1, (n, 3)).astype(np.float32)
+
+
+def _poll(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_no_head_of_line_blocking():
+    """ISSUE 9 satellite: a deliberately slow large-bucket batch in
+    flight must not stall small-bucket requests — they keep completing
+    through the other replica under a latency bound."""
+    engine = _PoolFakeEngine(n_replicas=2)
+    engine.gates[64].clear()               # large bucket: blocked
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=2, max_wait_ms=2, queue_depth=32))
+    try:
+        slow = batcher.submit(_pc(60), _pc(60))
+        # Wait until some replica is actually inside the slow dispatch.
+        assert _poll(lambda: any(r.started[64].is_set()
+                                 for r in engine.replicas))
+        t0 = time.monotonic()
+        for seed in range(5):
+            h = batcher.submit(_pc(20, seed), _pc(20, seed))
+            assert h.wait(5).shape == (20, 3)
+        elapsed = time.monotonic() - t0
+        # 5 sequential instant dispatches through the free replica:
+        # generous bound, but orders of magnitude under the 30 s the
+        # blocked replica would impose if small requests queued behind it.
+        assert elapsed < 2.0, elapsed
+        assert not slow.done.is_set()      # the slow batch is STILL going
+        stats = batcher.replica_stats()
+        assert sum(s["in_flight"] for s in stats) == 1   # the slow one
+    finally:
+        engine.gates[64].set()
+    assert slow.wait(30).shape == (60, 3)
+    batcher.shutdown(drain=True)
+    assert batcher.counts["served"] == 6
+
+
+def test_eager_dispatch_vs_baseline_straggler_wait():
+    """Continuous batching: with idle capacity a lone request dispatches
+    immediately; the PR-7 baseline mode waits out the full straggler
+    window first. The latency gap IS the A/B mechanism (BENCHMARKS.md)."""
+    for eager, bound in ((True, lambda ms: ms < 150.0),
+                         (False, lambda ms: ms >= 250.0)):
+        engine = _PoolFakeEngine(n_replicas=1)
+        batcher = MicroBatcher(
+            engine, BatcherConfig(max_batch=2, max_wait_ms=300,
+                                  queue_depth=8, eager_when_idle=eager))
+        t0 = time.monotonic()
+        h = batcher.submit(_pc(20), _pc(20))
+        h.wait(10)
+        ms = (time.monotonic() - t0) * 1000.0
+        batcher.shutdown(drain=True)
+        assert bound(ms), (eager, ms)
+
+
+def test_live_in_flight_reconciliation_and_prometheus():
+    """While a request is mid-execute the /metrics identity holds with
+    the live gauge: requests_total == responses_total + rejected +
+    in_flight — and Prometheus exposes the per-replica decomposition."""
+    engine = _PoolFakeEngine(n_replicas=2)
+    engine.gates[32].clear()
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8),
+        metrics=metrics)
+    h = batcher.submit(_pc(20), _pc(20))
+    assert _poll(lambda: any(r.started[32].is_set()
+                             for r in engine.replicas))
+    snap = metrics.snapshot()
+    assert snap["requests_total"] == 1
+    assert snap["responses_total"] == 0
+    assert metrics.in_flight == 1
+    text = metrics.prometheus(
+        batcher.queue_depths(),
+        replica_stats=batcher.replica_stats(),
+        batch_queue_depth=batcher.batch_queue_depth())
+    assert "pvraft_serve_in_flight 1" in text
+    assert "pvraft_serve_replica_in_flight" in text
+    assert "pvraft_serve_replica_batches_total" in text
+    assert "pvraft_serve_batch_queue_depth" in text
+    stats = batcher.replica_stats()
+    assert sum(s["in_flight"] for s in stats) == 1
+    engine.gates[32].set()
+    h.wait(10)
+    batcher.shutdown(drain=True)
+    assert metrics.in_flight == 0
+    text = metrics.prometheus(replica_stats=batcher.replica_stats())
+    assert "pvraft_serve_in_flight 0" in text
+
+
+def test_outcome_recorded_exactly_once_under_timeout_race():
+    """The 504-vs-resolve race cannot double-book the ledger: whoever
+    wins the request's finalize() token records the outcome, the loser
+    records nothing — so in_flight returns to exactly 0 instead of
+    drifting negative (the every-snapshot identity's regression test)."""
+    engine = _PoolFakeEngine(n_replicas=1)
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8),
+        metrics=metrics)
+    # Case 1: dispatch wins (request served), then the handler-side
+    # failure path fires anyway (simulating a waiter that timed out in
+    # the instant the result landed): it must be a no-op.
+    h = batcher.submit(_pc(20), _pc(20))
+    h.wait(10)
+    assert metrics.in_flight == 0
+    batcher.record_failure_for(h, "timeout")
+    snap = metrics.snapshot()
+    assert snap["rejected"] == {}              # loser recorded nothing
+    assert metrics.in_flight == 0
+    assert snap["requests_total"] == snap["responses_total"] == 1
+    # Case 2: the failure path wins (waiter gone before dispatch): the
+    # request is counted once, as a timeout.
+    engine.gates[32].clear()
+    h2 = batcher.submit(_pc(20, 1), _pc(20, 1))
+    with pytest.raises(TimeoutError):
+        h2.wait(0.05)
+    batcher.record_failure_for(h2, "timeout")
+    engine.gates[32].set()
+    batcher.shutdown(drain=True)
+    snap = metrics.snapshot()
+    assert snap["rejected"] == {"timeout": 1}
+    assert metrics.in_flight == 0
+    assert snap["requests_total"] == snap["responses_total"] + \
+        sum(snap["rejected"].values())
+
+
+def test_healthz_reports_replicas(tmp_path):
+    """/healthz per-replica visibility (ISSUE 9 satellite): device id,
+    in-flight, served-batch counter per replica, plus the serving dtype
+    — while the JSON /metrics shape stays frozen."""
+    import http.client
+
+    engine = _PoolFakeEngine(n_replicas=2)
+    telemetry = ServeTelemetry(str(tmp_path / "serve.events.jsonl"))
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=2, max_wait_ms=2, queue_depth=8),
+        telemetry=telemetry, metrics=metrics)
+    server = ServeHTTPServer(batcher, port=0, metrics=metrics)
+    server.start()
+    try:
+        conn = http.client.HTTPConnection(server.host, server.port,
+                                          timeout=30)
+        conn.request("POST", "/predict", body=json.dumps(
+            {"pc1": _pc(20).tolist(), "pc2": _pc(20, 1).tolist()}),
+            headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+        conn.close()
+        conn = http.client.HTTPConnection(server.host, server.port,
+                                          timeout=30)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        conn.close()
+        assert health["dtype"] == "float32"
+        assert health["in_flight"] == 0
+        assert [r["replica"] for r in health["replicas"]] == [0, 1]
+        assert all(set(r) == {"replica", "device_id", "in_flight",
+                              "batches_total"}
+                   for r in health["replicas"])
+        assert sum(r["batches_total"] for r in health["replicas"]) == 1
+        conn = http.client.HTTPConnection(server.host, server.port,
+                                          timeout=30)
+        conn.request("GET", "/metrics")
+        snap = json.loads(conn.getresponse().read())
+        conn.close()
+        assert set(snap) == {
+            "requests_total", "responses_total", "rejected",
+            "batches_total", "batch_fill_mean", "per_bucket_requests",
+            "latency", "queue_depth"}          # frozen pre-pool shape
+    finally:
+        server.shutdown(drain=True)
+        telemetry.close()
+
+
+# -------------------------------------------------------- bucket advisor --
+
+
+def test_advisor_partition_dp_exact():
+    from pvraft_tpu.serve.advisor import propose_buckets, score_buckets
+
+    # 3 bins; with 2 buckets the DP must merge the two cheap-to-merge
+    # small bins, not the expensive large one: candidates (128, 256,
+    # 8192), counts (100, 100, 10). Merging 128->256 costs 100*128
+    # extra; merging 256->8192 costs 100*7936. Optimal: [256, 8192].
+    edges = [128.0, 256.0, 8192.0]
+    counts = [100, 100, 10, 0]
+    out = propose_buckets(edges, counts, 2)
+    assert out["buckets"] == [256, 8192]
+    assert out["requests"] == 210
+    assert out["overflow_requests"] == 0
+    expect = (200 * 256 + 10 * 8192) / 210
+    assert out["points_per_request"] == pytest.approx(expect, abs=0.01)
+    # One bucket: everything pads to the max.
+    assert propose_buckets(edges, counts, 1)["buckets"] == [8192]
+    # min_bucket floor folds small bins upward.
+    assert propose_buckets(edges, counts, 2, min_bucket=200)["buckets"] \
+        == [256, 8192]
+    # Scoring an existing table reports rejection honestly.
+    score = score_buckets([128], edges, counts)
+    assert score["rejected_requests"] == 110
+    assert score["served_requests"] == 100
+    assert score["points_per_request"] == 128.0
+
+
+def test_advisor_improvement_compares_same_population():
+    """A strictly-more-capable proposal must not read as a regression:
+    when the current table rejects part of the traffic, the improvement
+    is computed on the traffic the CURRENT table serves (the extra
+    capability shows up as the reject-fraction delta, not as cost)."""
+    from pvraft_tpu.serve.advisor import build_advisor_report
+
+    edges = [1024.0, 8192.0]
+    counts = [100, 100, 0]
+    report = build_advisor_report(edges, counts, current_buckets=[1024],
+                                  n_buckets=2)
+    # Proposed [1024, 8192] serves everything; on the shared population
+    # (the <=1024 bin) it costs exactly what the current table costs.
+    assert report["proposed"]["buckets"] == [1024, 8192]
+    assert report["current"]["rejected_requests"] == 100
+    assert report["improvement"]["points_per_request_saved"] == 0.0
+    assert report["improvement"]["population"] == \
+        "traffic served by the current table"
+
+
+def test_advisor_report_on_committed_histogram():
+    """The committed loadgen histogram (PR 7's adaptive-bucket seed
+    data) produces a valid advisory whose proposal is no worse than the
+    declared production table on the same traffic — the cross-check
+    against geometries.py the ISSUE names."""
+    import os
+
+    from pvraft_tpu.serve.advisor import build_advisor_report
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    art = os.path.join(root, "artifacts", "serve_cpu_synthetic.json")
+    doc = json.load(open(art, encoding="utf-8"))
+    rp = doc["request_points"]
+    report = build_advisor_report(rp["edges"], rp["counts"],
+                                  g.SERVE_DEFAULT_BUCKETS, source=art)
+    assert report["schema"] == "pvraft_bucket_advisor/v1"
+    assert len(report["proposed"]["buckets"]) <= len(g.SERVE_DEFAULT_BUCKETS)
+    assert report["current"]["buckets"] == sorted(g.SERVE_DEFAULT_BUCKETS)
+    if report["current"]["points_per_request"] is not None:
+        assert report["proposed"]["points_per_request"] <= \
+            report["current"]["points_per_request"]
+
+
+# ------------------------------------------------- committed A/B evidence --
+
+
+def test_committed_ab_evidence():
+    """The committed interleaved A/B (ISSUE 9 acceptance): both legs
+    validate, the joint SLO report validates, the pool leg raises max
+    QPS under the p99 SLO vs the baseline leg, and every leg's server
+    metrics reconcile (requests == responses + rejected at quiescence)."""
+    import os
+
+    from pvraft_tpu.obs.events import validate_events_file
+    from pvraft_tpu.obs.slo import validate_slo_report_file
+    from pvraft_tpu.obs.trace import validate_trace_artifact_file
+    from pvraft_tpu.serve.loadgen import validate_load_artifact_file
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prefix = os.path.join(root, "artifacts", "serve_ab")
+    legs = {}
+    for leg in ("baseline", "pool"):
+        load = f"{prefix}_{leg}.json"
+        assert validate_load_artifact_file(load) == []
+        assert validate_events_file(f"{prefix}_{leg}.events.jsonl") == []
+        assert validate_trace_artifact_file(
+            f"{prefix}_{leg}.trace.json") == []
+        doc = json.load(open(load, encoding="utf-8"))
+        legs[leg] = doc
+        sm = doc["server_metrics"]
+        assert sm["requests_total"] == sm["responses_total"] + \
+            sum(sm["rejected"].values())
+    assert legs["baseline"]["config"]["replicas"] == 1
+    assert legs["baseline"]["config"]["eager_when_idle"] is False
+    assert legs["pool"]["config"]["replicas"] >= 2
+    assert legs["pool"]["config"]["eager_when_idle"] is True
+
+    slo = f"{prefix}.slo.json"
+    assert validate_slo_report_file(slo) == []
+    report = json.load(open(slo, encoding="utf-8"))
+    rps = {}
+    for run in report["runs"]:
+        leg = "pool" if "pool" in run["load"] else "baseline"
+        rps[leg] = (run["throughput_rps"], run["meets_slo"])
+    # The tentpole claim: the pool sustains more QPS under the SLO.
+    assert rps["pool"][1], "pool leg must meet the SLO"
+    assert rps["baseline"][1], "baseline leg must meet the SLO"
+    assert rps["pool"][0] > rps["baseline"][0]
+    assert report["max_qps_under_slo"] == pytest.approx(rps["pool"][0])
