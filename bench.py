@@ -493,15 +493,28 @@ def _remaining() -> float:
     return DEADLINE - time.monotonic()
 
 
-def _emit(value: float, extra: dict, comparable: bool = True) -> None:
-    """``comparable=False`` when the measured config is not the flagship
-    one (shrunk CPU fallback): a rate from half the GRU iters and a
-    quarter of the points must not be ratioed against the full-config
-    baseline — report 0.0 there rather than an inflated headline."""
+def _emit(value: float, extra: dict, comparable: bool = True,
+          platform: str = None) -> None:
+    """One ``pvraft_bench/v1`` line (schema + validator:
+    ``pvraft_tpu/obs/bench.py``; regression gate:
+    ``scripts/bench_compare.py``).
+
+    ``platform`` and ``comparable`` are first-class, validated fields —
+    never note strings. ``comparable`` means "may be ratioed against the
+    reference per-GPU baseline": it requires BOTH the flagship measured
+    config (a rate from half the GRU iters and a quarter of the points
+    must not be ratioed against the full-config baseline) AND the tpu
+    platform (a CPU-fallback run must never read a nonzero vs_baseline —
+    the BENCH_r05.json failure mode). Incomparable runs report 0.0."""
+    platform = platform or extra.get("platform") or "unknown"
+    comparable = bool(comparable) and platform == "tpu"
     out = {
+        "schema": "pvraft_bench/v1",
         "metric": "train_point_pairs_per_sec_per_chip",
         "value": round(value, 1),
         "unit": _unit(),
+        "platform": platform,
+        "comparable": comparable,
         "vs_baseline": (
             round(value / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3)
             if comparable else 0.0

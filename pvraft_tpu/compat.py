@@ -131,6 +131,53 @@ def checkpoint_name(x, name: str):
     return fn(x, name)
 
 
+def jit_cache_size(jitted) -> int:
+    """Entries in a ``jax.jit`` wrapper's compiled-program cache, or -1
+    when the running jax no longer exposes the counter.
+
+    The retrace watchdog (``pvraft_tpu/obs/retrace.py``) counts these
+    per registered program after warmup: growth means a silent retrace
+    (new shapes/dtypes/static args), the runtime complement of
+    deepcheck's static GJ007. ``_cache_size`` is private-but-stable
+    (jax's own tests use it) — routed here so a rename degrades the
+    watchdog to "unavailable" instead of crashing the train loop."""
+    fn = getattr(jitted, "_cache_size", None)
+    if fn is None:  # pragma: no cover - exercised only on future jax
+        return -1
+    try:
+        return int(fn())
+    except Exception:  # pragma: no cover - exercised only on future jax
+        return -1
+
+
+def register_compile_listener(callback) -> bool:
+    """Register ``callback(event_name, duration_s)`` for jax's
+    ``/jax/core/compile/backend_compile_duration`` monitoring events —
+    the serve-side retrace watchdog's "anything compiled at all" signal
+    (after AOT startup no compile is ever legitimate). Returns False
+    when the monitoring API is unavailable (the watchdog reports itself
+    disarmed instead of silently watching nothing)."""
+    register = getattr(getattr(jax, "monitoring", None),
+                       "register_event_duration_secs_listener", None)
+    if register is None:  # pragma: no cover - exercised only on future jax
+        return False
+    register(callback)
+    return True
+
+
+def unregister_compile_listener(callback) -> None:
+    """Best-effort removal of a :func:`register_compile_listener`
+    callback (tests arm and disarm watchdogs repeatedly; the public
+    monitoring API has no unregister yet)."""
+    try:
+        from jax._src import monitoring as _monitoring
+
+        _monitoring._unregister_event_duration_listener_by_callback(
+            callback)
+    except Exception:  # pragma: no cover - listener leak is benign
+        pass
+
+
 def eqn_user_frame(source_info):
     """``(file_name, line)`` of the first non-jax frame that issued a
     jaxpr equation, or ``None``.

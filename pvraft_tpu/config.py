@@ -229,6 +229,14 @@ class TrainConfig:
     # Stop training (raise) after the first snapshot instead of running
     # on with corrupt state; off reproduces let-it-run behavior.
     halt_on_divergence: bool = False
+    # Retrace watchdog strictness (obs/retrace.py). The watchdog is
+    # always armed (one int compare per dispatch, pure host-side): a
+    # train-loop program whose jit cache grows after warmup emits a
+    # `recompile` event naming the program + arg signature. strict mode
+    # additionally raises RetraceError — a silent retrace recompiles a
+    # multi-minute program per occurrence, so perf runs should fail
+    # loudly rather than record a corrupted measurement.
+    strict_retrace: bool = False
 
     def __post_init__(self):
         # Fail before training, not at the end-of-epoch save.

@@ -52,7 +52,9 @@ from pvraft_tpu.engine.steps import (
 )
 from pvraft_tpu.models import PVRaft, PVRaftRefine
 from pvraft_tpu.obs import DivergenceDetector, RunTelemetry, dump_snapshot
+from pvraft_tpu.obs.device_memory import sample_device_memory
 from pvraft_tpu.obs.divergence import DivergenceHalt
+from pvraft_tpu.obs.retrace import RetraceWatchdog, args_signature
 from pvraft_tpu.parallel.mesh import (
     device_batch,
     eval_scene_shard,
@@ -310,6 +312,23 @@ class Trainer:
 
         self.ckpt_dir = os.path.join(cfg.exp_path, "checkpoints")
 
+        # Retrace watchdog (obs/retrace.py): every train-loop program is
+        # watched by jit-cache entry count — growth after warmup means a
+        # silent retrace (the runtime complement of deepcheck GJ007) and
+        # becomes a `recompile` event; cfg.train.strict_retrace raises.
+        # eval_step is deliberately NOT watched: eval loaders run
+        # drop_last=False, so a smaller tail batch legitimately compiles
+        # a second entry every epoch.
+        self.retrace = RetraceWatchdog(
+            emit=self.telemetry.emit_recompile,
+            strict=cfg.train.strict_retrace, context="train")
+        step_name = "refine_train_step" if refine else "train_step"
+        self.retrace.watch(step_name, self.train_step)
+        if self.packed:
+            self.retrace.watch("packed_train_step", self.packed_step)
+            if cfg.parallel.steps_per_dispatch > 1:
+                self.retrace.watch("multistep_train_step", self.multi_step)
+
     def _repack(self) -> None:
         """Refresh the packed train state after self.params/opt_state were
         replaced outside the train loop (weight load / resume)."""
@@ -480,11 +499,15 @@ class Trainer:
                         pending = []
                         self.flat, m = self.multi_step(self.flat, batches)
                         dev_metrics.append(m)
+                        self.retrace.check(
+                            signature=lambda b=batches: args_signature(b))
                         if tel_on:
                             observe(m, None, None)
                 for b in pending:
                     self.flat, m = self.packed_step(self.flat, b)
                     dev_metrics.append(m)
+                    self.retrace.check(
+                        signature=lambda b=b: args_signature(b))
                     if tel_on:
                         observe(m, None, None)
             else:
@@ -508,6 +531,10 @@ class Trainer:
                             self.params, self.opt_state, b
                         )
                     dev_metrics.append(m)
+                    # One int compare per watched program; the signature
+                    # is only rendered if something actually tripped.
+                    self.retrace.check(
+                        signature=lambda b=b: args_signature(b))
                     if tel_on:
                         observe(m, hb, prev_state)
         except DivergenceHalt as e:
@@ -589,6 +616,13 @@ class Trainer:
                 epoch, self.step_count + i + 1, l, e, telemetry=t
             )
         self.step_count += n_steps
+        # Per-epoch device-memory watermark (obs/device_memory.py): one
+        # memory_stats() sample per local device onto the event stream.
+        # CPU backends report no stats and emit nothing — zero noise in
+        # CPU CI, real HBM occupancy in TPU runs.
+        devmem = sample_device_memory()
+        if devmem:
+            self.telemetry.emit_device_memory(devmem, context="train")
         if halt is not None:
             # The step events above (the run's trajectory INTO the trip)
             # are flushed; no epoch summary or checkpoint for a halted

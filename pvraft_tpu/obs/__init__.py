@@ -16,9 +16,25 @@ Five pillars (ISSUEs 3 + 7 / ROADMAP "run-health telemetry"):
   profiled train steps, riding the event stream as ``span`` records;
 * :mod:`pvraft_tpu.obs.slo` — the ``pvraft_slo/v1`` evidence report
   joining loadgen artifacts with trace spans (per-(bucket, batch,
-  dtype) stage quantiles, max QPS under a p99 SLO).
+  dtype) stage quantiles, max QPS under a p99 SLO);
+* the performance plane (ISSUE 10): :mod:`pvraft_tpu.obs.retrace`
+  (recompile watchdog — ``recompile`` events, ``--strict_retrace``),
+  :mod:`pvraft_tpu.obs.device_memory` (``device_memory`` events +
+  ``pvraft_device_hbm_bytes`` gauge), and :mod:`pvraft_tpu.obs.bench`
+  (the ``pvraft_bench/v1`` schema behind ``scripts/bench_compare.py``;
+  the cost/HBM inventory lives with the registry in
+  ``pvraft_tpu/programs/costs.py``).
 """
 
+from pvraft_tpu.obs.bench import (  # noqa: F401
+    BENCH_SCHEMA,
+    validate_bench,
+    validate_bench_file,
+)
+from pvraft_tpu.obs.device_memory import (  # noqa: F401
+    DeviceMemoryMonitor,
+    sample_device_memory,
+)
 from pvraft_tpu.obs.divergence import (  # noqa: F401
     SNAPSHOT_SCHEMA,
     DivergenceDetector,
@@ -43,6 +59,11 @@ from pvraft_tpu.obs.monitors import (  # noqa: F401
     global_norm,
     nonfinite_count,
     telemetry_leaves,
+)
+from pvraft_tpu.obs.retrace import (  # noqa: F401
+    RetraceError,
+    RetraceWatchdog,
+    args_signature,
 )
 from pvraft_tpu.obs.slo import (  # noqa: F401
     SLO_SCHEMA,

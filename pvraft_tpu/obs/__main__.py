@@ -3,11 +3,12 @@
     python -m pvraft_tpu.obs validate artifacts/*.events.jsonl
     python -m pvraft_tpu.obs validate-trace artifacts/*.trace.json
     python -m pvraft_tpu.obs validate-slo artifacts/*.slo.json
+    python -m pvraft_tpu.obs validate-bench artifacts/bench_baseline.json
 
-Each subcommand exits non-zero on any schema problem — all three are
+Each subcommand exits non-zero on any schema problem — all four are
 wired into ``scripts/lint.sh`` so a malformed committed event log,
-trace artifact or SLO report fails the standing gate, same as a lint
-finding.
+trace artifact, SLO report or bench artifact fails the standing gate,
+same as a lint finding.
 """
 
 from __future__ import annotations
@@ -15,6 +16,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from pvraft_tpu.obs.bench import validate_bench_file
 from pvraft_tpu.obs.events import validate_events_file
 from pvraft_tpu.obs.slo import validate_slo_report_file
 from pvraft_tpu.obs.trace import validate_trace_artifact_file
@@ -51,6 +53,10 @@ def main(argv=None) -> int:
         "validate-slo", help="validate pvraft_slo/v1 reports")
     slo.add_argument("paths", nargs="+", help="SLO reports")
     slo.set_defaults(validate=validate_slo_report_file)
+    bench = sub.add_parser(
+        "validate-bench", help="validate pvraft_bench/v1 artifacts")
+    bench.add_argument("paths", nargs="+", help="bench artifacts")
+    bench.set_defaults(validate=validate_bench_file)
     args = parser.parse_args(argv)
     return _run(args.paths, args.validate)
 

@@ -1,0 +1,209 @@
+"""``pvraft_bench/v1``: schema + validator + comparison for bench.py.
+
+``bench.py`` prints ONE JSON line; until now it was schema-less, the
+platform lived inside a free-text ``note``, and nothing stopped a
+CPU-fallback run from being ratioed against a TPU baseline — the
+``BENCH_r05.json`` failure mode: ``vs_baseline: 0.0`` with the only
+explanation buried in ``"note": "accelerator unreachable … cpu
+fallback"``. This module makes the contract machine-checkable:
+
+* ``platform`` and ``comparable`` are REQUIRED, first-class fields;
+* ``comparable: false`` forces ``vs_baseline == 0.0`` (an incomparable
+  run may never carry a ratio), and any non-TPU platform forces
+  ``comparable: false`` (the baseline is the reference per-GPU rate —
+  only a TPU chip measurement may be ratioed against it);
+* :func:`compare` refuses cross-platform / config-mismatched pairs
+  outright and applies an explicit noise band before calling anything a
+  regression — ``scripts/bench_compare.py`` is the CLI, wired into
+  ``scripts/lint.sh`` and CI over the committed baseline artifact.
+
+The module itself is pure stdlib (no jax, no numpy); note that
+importing it through the ``pvraft_tpu.obs`` package pays the package's
+jax import — ``bench.py``'s jax-free parent doesn't import it at all
+(it only WRITES the fields), and the consumers
+(``scripts/bench_compare.py``, ``python -m pvraft_tpu.obs
+validate-bench``) are separate processes where that import is fine.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Tuple
+
+BENCH_SCHEMA = "pvraft_bench/v1"
+
+REQUIRED_FIELDS = ("schema", "metric", "value", "unit", "vs_baseline",
+                   "platform", "comparable")
+OPTIONAL_FIELDS = (
+    "variant", "step_strategy", "ab_flags", "dt_reps", "dt_spread",
+    "timing_reps", "steps_per_rep", "eval_scenes_per_sec",
+    "eval_scenes_per_sec_scanned", "eval_strategy", "eval_detail",
+    "note", "baseline_note",
+)
+
+# Fields that must match between two artifacts for a comparison to mean
+# anything: same chip family, same measured configuration (the unit
+# string encodes points/iters/bs), same model variant, same armed A/B
+# levers. ("step_strategy" is deliberately NOT here: the bench reports
+# its best honest training loop, and a strategy change is a legitimate
+# speedup/regression, not an apples/oranges error.)
+COMPARE_KEYS = ("platform", "unit", "variant", "ab_flags")
+
+# Noise floor for the regression band when neither artifact recorded a
+# run-to-run spread: the CPU fallback's observed round-over-round drift
+# was ~10% (round-3 verdict), and TPU runs carry dt_spread explicitly.
+DEFAULT_NOISE = 0.10
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_bench(doc: Any, path: str = "<bench>") -> List[str]:
+    """Schema problems of one bench artifact ([] = valid)."""
+    if not isinstance(doc, dict):
+        return [f"{path}: artifact is {type(doc).__name__}, not an object"]
+    problems: List[str] = []
+    for key in REQUIRED_FIELDS:
+        if key not in doc:
+            problems.append(f"{path}: missing required field {key!r}")
+    if problems:
+        return problems
+    if doc["schema"] != BENCH_SCHEMA:
+        problems.append(
+            f"{path}: schema {doc['schema']!r} != {BENCH_SCHEMA!r}")
+    if not isinstance(doc["metric"], str) or not doc["metric"]:
+        problems.append(f"{path}: metric must be a non-empty string")
+    if not _is_num(doc["value"]) or doc["value"] < 0:
+        problems.append(
+            f"{path}: value {doc['value']!r} must be a number >= 0")
+    if not isinstance(doc["unit"], str) or not doc["unit"]:
+        problems.append(f"{path}: unit must be a non-empty string")
+    if not _is_num(doc["vs_baseline"]):
+        problems.append(
+            f"{path}: vs_baseline {doc['vs_baseline']!r} must be a number")
+    if not isinstance(doc["platform"], str) or not doc["platform"]:
+        problems.append(
+            f"{path}: platform must be a non-empty string "
+            "(the BENCH_r05 failure mode: a CPU fallback identifiable "
+            "only by grepping a note)")
+    if not isinstance(doc["comparable"], bool):
+        problems.append(f"{path}: comparable must be a bool")
+        return problems
+    if not doc["comparable"] and _is_num(doc["vs_baseline"]) \
+            and doc["vs_baseline"] != 0.0:
+        problems.append(
+            f"{path}: comparable=false but vs_baseline="
+            f"{doc['vs_baseline']} — an incomparable run may never carry "
+            "a baseline ratio")
+    if doc["comparable"] and doc.get("platform") != "tpu":
+        problems.append(
+            f"{path}: comparable=true on platform "
+            f"{doc.get('platform')!r} — the baseline is the reference "
+            "per-GPU rate; only TPU measurements are ratioed against it")
+    known = set(REQUIRED_FIELDS) | set(OPTIONAL_FIELDS)
+    for key in doc:
+        if key not in known:
+            problems.append(f"{path}: unknown field {key!r}")
+    if "dt_reps" in doc and (
+            not isinstance(doc["dt_reps"], list)
+            or not all(_is_num(v) and v > 0 for v in doc["dt_reps"])):
+        problems.append(
+            f"{path}: dt_reps must be a list of positive numbers")
+    if "dt_spread" in doc and (
+            not _is_num(doc["dt_spread"]) or doc["dt_spread"] < 0):
+        problems.append(f"{path}: dt_spread must be a number >= 0")
+    return problems
+
+
+def load_bench_file(path: str):
+    """``(doc, problems)``: the ONE-JSON-line file contract, in one
+    place — ``validate_bench_file`` and ``scripts/bench_compare.py``
+    must agree on what parses, so they share this loader. ``doc`` is
+    None when ``problems`` is non-empty; schema validation is separate
+    (``validate_bench``)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read().strip()
+    except OSError as e:
+        return None, [f"{path}: unreadable: {e}"]
+    # bench.py prints ONE JSON line; an artifact file holds exactly it.
+    lines = [l for l in text.splitlines() if l.strip()]
+    if len(lines) != 1:
+        return None, [
+            f"{path}: expected exactly one JSON line, got {len(lines)}"]
+    try:
+        return json.loads(lines[0]), []
+    except ValueError as e:
+        return None, [f"{path}: not valid JSON: {e}"]
+
+
+def validate_bench_file(path: str) -> List[str]:
+    doc, problems = load_bench_file(path)
+    if problems:
+        return problems
+    return validate_bench(doc, path=path)
+
+
+def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
+            noise: float = DEFAULT_NOISE,
+            baseline_path: str = "<baseline>",
+            candidate_path: str = "<candidate>"
+            ) -> Tuple[str, List[str]]:
+    """Regression verdict for candidate-vs-baseline.
+
+    Returns ``(verdict, messages)`` with verdict one of:
+
+    * ``"refused"`` — the pair is not comparable (schema problems,
+      platform/config/variant/lever mismatch, or a zero measurement);
+      comparing would manufacture a conclusion, so the gate fails;
+    * ``"regression"`` — candidate is below baseline by more than the
+      noise band;
+    * ``"ok"`` — within the band (or better).
+
+    The band is ``max(noise, dt_spread of either artifact)``: a run
+    whose own repeat spread exceeds the configured band widens the band
+    honestly rather than flagging its own jitter as a regression."""
+    messages: List[str] = []
+    problems = (validate_bench(baseline, baseline_path)
+                + validate_bench(candidate, candidate_path))
+    if problems:
+        return "refused", problems
+    for key in COMPARE_KEYS:
+        bval, cval = baseline.get(key), candidate.get(key)
+        if bval != cval:
+            messages.append(
+                f"refusing to compare: {key} mismatch "
+                f"({baseline_path}: {bval!r} vs {candidate_path}: {cval!r})"
+                + (" — a CPU-fallback run must never be ratioed against "
+                   "a TPU measurement" if key == "platform" else ""))
+    if baseline["metric"] != candidate["metric"]:
+        messages.append(
+            f"refusing to compare: metric mismatch "
+            f"({baseline['metric']!r} vs {candidate['metric']!r})")
+    if messages:
+        return "refused", messages
+    if baseline["value"] <= 0 or candidate["value"] <= 0:
+        return "refused", [
+            "refusing to compare: a zero/failed measurement "
+            f"(baseline {baseline['value']}, candidate "
+            f"{candidate['value']}) carries no information"]
+    band = max(float(noise),
+               float(baseline.get("dt_spread") or 0.0),
+               float(candidate.get("dt_spread") or 0.0))
+    ratio = candidate["value"] / baseline["value"]
+    detail = (f"candidate/baseline = {ratio:.4f} "
+              f"(band ±{band:.2%}, platform {candidate['platform']}, "
+              f"variant {candidate.get('variant')!r})")
+    if ratio < 1.0 - band:
+        return "regression", [
+            f"REGRESSION: {detail} — candidate "
+            f"{candidate['value']:.1f} fell more than {band:.2%} below "
+            f"baseline {baseline['value']:.1f}"]
+    if ratio > 1.0 + band:
+        messages.append(
+            f"improvement beyond the noise band: {detail} — consider "
+            "promoting the candidate to the committed baseline")
+    else:
+        messages.append(f"within the noise band: {detail}")
+    return "ok", messages

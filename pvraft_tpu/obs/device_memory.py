@@ -1,0 +1,139 @@
+"""Device-memory telemetry: periodic ``device.memory_stats()`` samples.
+
+The compile-time HBM story is covered (XLA memory analysis in the AOT
+evidence and the ``pvraft_costs/v1`` inventory); this module covers the
+*runtime* side: what is actually resident on each device right now, as
+``device_memory`` events on the ``pvraft_events/v1`` stream and as the
+``pvraft_device_hbm_bytes{device}`` Prometheus gauge
+(``serve/metrics.py``).
+
+Backends without allocator stats (CPU returns ``None``) sample to an
+empty list and emit nothing — the telemetry is zero-noise where it is
+meaningless and automatic where it matters (TPU/GPU). Keys differ per
+runtime, so rows normalize to the schema's vocabulary: ``bytes_in_use``
+(required), ``peak_bytes_in_use``/``bytes_limit`` when the allocator
+reports them.
+
+Consumers:
+
+* the Trainer emits one sample per epoch (``context="train"``);
+* the serve pool runs a :class:`DeviceMemoryMonitor` thread
+  (``--devmem_interval``) that feeds both the event stream and the
+  Prometheus gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+# memory_stats() key -> schema key (first match wins; runtimes disagree
+# on spelling).
+_STAT_KEYS = (
+    ("bytes_in_use", "bytes_in_use"),
+    ("peak_bytes_in_use", "peak_bytes_in_use"),
+    ("bytes_limit", "bytes_limit"),
+    ("bytes_reservable_limit", "bytes_limit"),
+)
+
+
+def device_memory_row(device) -> Optional[Dict[str, Any]]:
+    """One device's normalized sample row, or None when the backend has
+    no allocator stats (CPU) or the probe fails (never raises — a
+    telemetry sampler must not take down the run it observes)."""
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — absent API == no stats
+        return None
+    if not stats:
+        return None
+    row: Dict[str, Any] = {
+        "device_id": int(device.id),
+        "platform": str(getattr(device, "platform", "unknown")),
+    }
+    for src, dst in _STAT_KEYS:
+        if dst in row:
+            continue
+        value = stats.get(src)
+        if value is not None:
+            row[dst] = int(value)
+    if "bytes_in_use" not in row:
+        return None
+    return row
+
+
+def sample_device_memory(devices=None) -> List[Dict[str, Any]]:
+    """Normalized rows for every local device that reports stats
+    (possibly empty — CPU backends)."""
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    rows = []
+    for device in devices:
+        row = device_memory_row(device)
+        if row is not None:
+            rows.append(row)
+    return rows
+
+
+class DeviceMemoryMonitor:
+    """Background sampler for the serve pool: every ``interval_s``,
+    sample all (or the given) devices, emit one ``device_memory`` event
+    and push the gauge rows into ``metrics.record_device_memory``.
+
+    ``interval_s <= 0`` disables without branching at the call sites
+    (``start()`` becomes a no-op). The thread is a daemon and also
+    samples once at ``stop()`` so even a short-lived service records a
+    final watermark."""
+
+    def __init__(self, emit: Optional[Callable[..., Any]] = None,
+                 metrics=None, interval_s: float = 10.0,
+                 devices=None, context: str = "serve"):
+        self.emit = emit
+        self.metrics = metrics
+        self.interval_s = float(interval_s)
+        self.devices = devices
+        self.context = context
+        self.samples = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample_once(self) -> List[Dict[str, Any]]:
+        rows = sample_device_memory(self.devices)
+        if rows:
+            self.samples += 1
+            if self.metrics is not None:
+                self.metrics.record_device_memory(rows)
+            if self.emit is not None:
+                self.emit(rows, context=self.context)
+        return rows
+
+    def start(self) -> None:
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._stop.clear()  # restartable: stop() leaves the flag set
+        # First sample happens on the thread (jax device probing can
+        # block briefly; startup must not).
+        self._thread = threading.Thread(
+            target=self._run, name="pvraft-devmem", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 — observe, never crash serving
+                pass
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(5.0)
+        self._thread = None
+        try:
+            self.sample_once()  # final watermark
+        except Exception:  # noqa: BLE001 — shutdown must complete
+            pass

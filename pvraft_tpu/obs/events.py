@@ -47,6 +47,22 @@ Tracing events (``pvraft_tpu/obs/trace.py``) ride the same stream:
     slo_report  path, slo_p99_ms    [+ max_qps_under_slo, programs,
                 requests] — pointer to a written pvraft_slo/v1 report
 
+Performance-plane events (``pvraft_tpu/obs/retrace.py``,
+``pvraft_tpu/obs/device_memory.py``) ride the same stream:
+
+    recompile   program, count     [+ baseline, signature, context] —
+                the retrace watchdog saw a registered program's jit
+                cache grow past its post-warmup baseline (or, in the
+                sealed serve mode, ANY backend compile after AOT
+                startup); ``signature`` is the triggering call's
+                abstract arg shapes/dtypes when known
+    device_memory  devices         [+ context] — one periodic
+                ``device.memory_stats()`` sample: a list of per-device
+                rows, each ``{device_id, bytes_in_use[,
+                peak_bytes_in_use, bytes_limit, platform]}``; byte
+                counts must be >= 0 and ``device_id`` a non-negative
+                integer (an unknown device is a writer bug, not data)
+
 Non-finite floats are encoded as the strings ``"NaN"``/``"Infinity"``/
 ``"-Infinity"`` (JSON has no spelling for them; a diverging run's whole
 point is to record them faithfully). ``validate_events`` accepts those
@@ -93,6 +109,9 @@ EVENT_TYPES: Dict[str, tuple] = {
              ("parent_id", "attrs")),
     "slo_report": (("path", "slo_p99_ms"),
                    ("max_qps_under_slo", "programs", "requests")),
+    "recompile": (("program", "count"),
+                  ("baseline", "signature", "context")),
+    "device_memory": (("devices",), ("context",)),
 }
 
 # serve_reject.reason vocabulary (validated like divergence.reason).
@@ -122,7 +141,15 @@ _NUMERIC_FIELDS = {
     "span": ("start_ms", "end_ms"),
     "slo_report": ("slo_p99_ms", "max_qps_under_slo", "programs",
                    "requests"),
+    "recompile": ("count", "baseline"),
 }
+
+# device_memory per-device row shape: required/optional keys and which
+# of them are byte counts (>= 0 enforced — a negative watermark is a
+# writer bug, not data).
+DEVICE_MEMORY_REQUIRED = ("device_id", "bytes_in_use")
+DEVICE_MEMORY_OPTIONAL = ("peak_bytes_in_use", "bytes_limit", "platform")
+_DEVICE_MEMORY_BYTES = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
 
 _NONFINITE_STRINGS = ("NaN", "Infinity", "-Infinity")
 
@@ -204,6 +231,54 @@ def validate_event(record: Any, seq: Optional[int] = None) -> List[str]:
         problems.append(
             f"serve_reject: reason {record.get('reason')!r} must be one "
             f"of {SERVE_REJECT_REASONS}")
+    if etype == "recompile":
+        if not isinstance(record.get("program"), str) or not record.get(
+                "program"):
+            problems.append(
+                "recompile: program must name the offending program")
+        count = record.get("count")
+        if _is_number(count) and isinstance(count, (int, float)) \
+                and count < 0:
+            problems.append(
+                f"recompile: count {count} must be >= 0")
+    if etype == "device_memory":
+        rows = record.get("devices")
+        if not isinstance(rows, list) or not rows:
+            problems.append(
+                "device_memory: devices must be a non-empty list of "
+                "per-device rows")
+        else:
+            for i, row in enumerate(rows):
+                if not isinstance(row, dict):
+                    problems.append(
+                        f"device_memory: devices[{i}] is not an object")
+                    continue
+                dev = row.get("device_id")
+                if not isinstance(dev, int) or isinstance(dev, bool) \
+                        or dev < 0:
+                    problems.append(
+                        f"device_memory: devices[{i}].device_id {dev!r} "
+                        "is not a known device (non-negative integer id)")
+                for key in DEVICE_MEMORY_REQUIRED[1:]:
+                    if key not in row:
+                        problems.append(
+                            f"device_memory: devices[{i}] missing {key!r}")
+                known = set(DEVICE_MEMORY_REQUIRED) | set(
+                    DEVICE_MEMORY_OPTIONAL)
+                for key in row:
+                    if key not in known:
+                        problems.append(
+                            f"device_memory: devices[{i}] unknown field "
+                            f"{key!r}")
+                for key in _DEVICE_MEMORY_BYTES:
+                    v = row.get(key)
+                    if v is None:
+                        continue
+                    if not isinstance(v, (int, float)) \
+                            or isinstance(v, bool) or v < 0:
+                        problems.append(
+                            f"device_memory: devices[{i}].{key}={v!r} "
+                            "must be a number >= 0")
     if etype == "span":
         start, end = record.get("start_ms"), record.get("end_ms")
         if (isinstance(start, (int, float)) and isinstance(end, (int, float))
@@ -468,6 +543,34 @@ class RunTelemetry:
         twin of ``ServeTelemetry.emit_span``; the step profiler's stage
         boundaries arrive here via ``obs.trace.trace_from_step_profile``."""
         self.events.emit("span", **span)
+
+    def emit_recompile(self, program: str, count: int,
+                       baseline: Optional[int] = None,
+                       signature: Optional[str] = None,
+                       context: Optional[str] = None) -> None:
+        """The retrace watchdog (obs/retrace.py) caught a registered
+        program's jit cache growing past its post-warmup baseline."""
+        fields: Dict[str, Any] = {"program": program, "count": count}
+        if baseline is not None:
+            fields["baseline"] = baseline
+        if signature is not None:
+            fields["signature"] = signature
+        if context is not None:
+            fields["context"] = context
+        self.events.emit("recompile", **fields)
+        self.log.info(
+            f"RECOMPILE: {program} jit cache grew to {count}"
+            + (f" (baseline {baseline})" if baseline is not None else "")
+            + (f" on {signature}" if signature else ""))
+
+    def emit_device_memory(self, devices: list,
+                           context: Optional[str] = None) -> None:
+        """One periodic ``device.memory_stats()`` sample
+        (obs/device_memory.py builds the per-device rows)."""
+        fields: Dict[str, Any] = {"devices": devices}
+        if context is not None:
+            fields["context"] = context
+        self.events.emit("device_memory", **fields)
 
     def close(self) -> None:
         self.events.close()

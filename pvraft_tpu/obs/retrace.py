@@ -1,0 +1,210 @@
+"""Retrace watchdog: the runtime complement of deepcheck's static GJ007.
+
+GJ007 proves a program's *build* is retrace-deterministic; nothing so
+far observed retraces actually happening at runtime — a shape-polymorphic
+batch, a python-scalar weak-type flip, or a config mutation mid-run each
+silently recompile a multi-minute program and the only symptom is a
+mysterious slow step. The watchdog makes that a first-class event:
+
+* **Per-program jit-cache counting** (the Trainer step loop): every
+  registered step program (``train_step``, ``packed_train_step``,
+  ``multistep_train_step``, ``eval_step`` — the same pjit names the
+  program registry audits) is watched via its jit cache entry count
+  (``compat.jit_cache_size``). The first entry is warmup; any growth
+  past the learned baseline emits a ``recompile`` event on the
+  ``pvraft_events/v1`` stream with the offending program and the
+  triggering call's abstract arg signature, and raises
+  :class:`RetraceError` under ``--strict_retrace``.
+
+* **Sealed mode** (the serve replica executors): after AOT startup the
+  program set is closed — no compile is ever legitimate. ``seal()``
+  registers a process-wide backend-compile listener
+  (``compat.register_compile_listener``); any compile observed after the
+  seal trips the next ``check()``. The listener only counts (no I/O, no
+  locks beyond one int) — trips are reported from the calling thread so
+  strict mode raises somewhere an executor can fail the batch loudly.
+
+Cost when armed: one integer compare per watched program per check —
+host-side only, no jaxpr anywhere changes (the
+``engine.train_step[telemetry_off_jaxpr]`` guarantee is untouched).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from pvraft_tpu.compat import (
+    jit_cache_size,
+    register_compile_listener,
+    unregister_compile_listener,
+)
+
+
+class RetraceError(RuntimeError):
+    """A watched program recompiled after warmup under strict mode."""
+
+
+def args_signature(args: Any) -> str:
+    """Compact ``dtype[shape]`` rendering of a call's arg pytree — what
+    the ``recompile`` event records so the offending geometry is on the
+    stream, not lost to a log grep."""
+    import jax
+    import numpy as np
+
+    def one(x) -> str:
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return (f"{np.dtype(x.dtype).name}"
+                    f"[{','.join(map(str, x.shape))}]")
+        return type(x).__name__
+    leaves = jax.tree_util.tree_leaves(args)
+    sig = ",".join(one(x) for x in leaves[:16])
+    if len(leaves) > 16:
+        sig += f",...(+{len(leaves) - 16} leaves)"
+    return sig
+
+
+class RetraceWatchdog:
+    """Counts compiles after warmup; emits ``recompile`` events and
+    (in strict mode) raises :class:`RetraceError` from ``check()``.
+
+    ``emit`` is the event sink — ``RunTelemetry.emit_recompile`` or
+    ``ServeTelemetry.emit_recompile`` (both lock-serialized), or None
+    for count-only operation. Thread-safe: ``check`` may be called from
+    batcher executors concurrently."""
+
+    def __init__(self, emit: Optional[Callable[..., Any]] = None,
+                 strict: bool = False, context: str = "train"):
+        self.emit = emit
+        self.strict = strict
+        self.context = context
+        self.trips = 0
+        self._lock = threading.Lock()
+        # name -> [jitted, baseline or None]; baseline None = warmup not
+        # seen yet (the program's first cache entry is legitimate).
+        self._watched: Dict[str, List[Any]] = {}
+        self._sealed = False
+        self._global_compiles = 0
+        self._global_baseline = 0
+        self._listener = None
+
+    # ---------------------------------------------------------- watching --
+
+    def watch(self, name: str, jitted) -> None:
+        """Track one jitted program by name. Programs whose jax no
+        longer exposes a cache counter are skipped (the watchdog must
+        never break training over an introspection API)."""
+        if jit_cache_size(jitted) < 0:
+            return
+        with self._lock:
+            self._watched[name] = [jitted, None]
+
+    def seal(self) -> bool:
+        """Close the program set (serve: after AOT startup). From here
+        on ANY backend compile in the process is a trip. Returns False
+        when the monitoring API is unavailable (caller logs that the
+        watchdog is disarmed)."""
+        def on_event(name: str, *args: Any, **kw: Any) -> None:
+            if name.endswith("backend_compile_duration"):
+                with self._lock:
+                    self._global_compiles += 1
+
+        if not register_compile_listener(on_event):
+            return False
+        self._listener = on_event
+        with self._lock:
+            self._sealed = True
+            self._global_baseline = self._global_compiles
+        return True
+
+    def close(self) -> None:
+        """Unhook the global listener (tests arm/disarm repeatedly)."""
+        if self._listener is not None:
+            unregister_compile_listener(self._listener)
+            self._listener = None
+        with self._lock:
+            self._sealed = False
+
+    def global_compiles(self) -> int:
+        """Current process-wide compile count (sealed mode). Dispatchers
+        read this BEFORE running a program and pass it to ``check`` as
+        ``window_start``, so only compiles that land DURING the dispatch
+        window trip — a co-resident engine AOT-compiling its own table
+        in the same process (the serve_ab.py two-leg pattern) must not
+        false-trip an idle service's next dispatch."""
+        with self._lock:
+            return self._global_compiles
+
+    # ---------------------------------------------------------- checking --
+
+    def check(self, signature: Any = None,
+              program: Optional[str] = None,
+              window_start: Optional[int] = None) -> List[Dict[str, Any]]:
+        """One watchdog pass: compare every watched program's cache size
+        against its baseline (learning the baseline at first sight), and
+        in sealed mode compare the global compile counter. Returns the
+        trip records (after emitting them); raises :class:`RetraceError`
+        in strict mode when anything tripped. ``signature`` may be a
+        string or a zero-arg callable (resolved only on a trip, so the
+        hot-loop cost of a no-trip check stays one int compare).
+
+        ``window_start`` (sealed mode): a :meth:`global_compiles` value
+        read before the dispatch — only compiles landing AFTER it trip,
+        so a co-resident engine compiling its own startup table between
+        dispatches is not pinned on the next request. Without it, the
+        baseline is the previous check (every compile since then trips)."""
+        trips: List[Dict[str, Any]] = []
+        with self._lock:
+            for name, slot in self._watched.items():
+                size = jit_cache_size(slot[0])
+                if size < 0:
+                    continue
+                if slot[1] is None:
+                    if size > 0:
+                        slot[1] = size  # warmup: first compile is the program
+                    continue
+                if size > slot[1]:
+                    trips.append({"program": name, "count": size,
+                                  "baseline": slot[1]})
+                    # One growth = one event; the new size becomes the
+                    # baseline so a persistently re-tracing program does
+                    # not flood the stream with one event per step.
+                    slot[1] = size
+            if self._sealed:
+                # max() with the ratchet: two concurrent dispatches that
+                # both captured a window BEFORE one compile landed must
+                # not both trip on it — the first reporter ratchets the
+                # baseline past the compile, disarming the second's
+                # stale window.
+                start = (max(window_start, self._global_baseline)
+                         if window_start is not None
+                         else self._global_baseline)
+                if self._global_compiles > start:
+                    trips.append({
+                        "program": program or "<sealed>",
+                        "count": self._global_compiles,
+                        "baseline": start,
+                    })
+                # Ratchet past everything seen either way: already-
+                # reported (or out-of-window) compiles must not re-trip
+                # a later default-baseline check.
+                self._global_baseline = self._global_compiles
+            self.trips += len(trips)
+        if trips and callable(signature):
+            signature = signature()
+        for trip in trips:
+            if self.emit is not None:
+                self.emit(program=trip["program"], count=trip["count"],
+                          baseline=trip["baseline"], signature=signature,
+                          context=self.context)
+        if trips and self.strict:
+            worst = trips[0]
+            raise RetraceError(
+                f"program {worst['program']!r} recompiled after warmup "
+                f"(jit cache {worst['baseline']} -> {worst['count']}"
+                + (f", args {signature}" if signature else "")
+                + ") — a retrace on the hot path recompiles a multi-"
+                "minute program per occurrence; find the varying "
+                "shape/dtype/static-arg (deepcheck GJ007 probes the "
+                "static cases) or drop --strict_retrace to observe only")
+        return trips

@@ -1,4 +1,4 @@
-"""CLI: ``python -m pvraft_tpu.programs {list,describe,verify,compile}``.
+"""CLI: ``python -m pvraft_tpu.programs {list,describe,verify,compile,costs}``.
 
 ``list`` renders the program inventory (no tracing — safe anywhere,
 golden-pinned by ``tests/test_programs.py`` against the committed
@@ -9,6 +9,8 @@ registered spec — the registry-wide superset of the old
 ``compile`` runs the deviceless topology compile gate over tag-selected
 specs; ``--tag kernel`` lowers every Pallas entry point through the
 real Mosaic pipeline so toolchain drift fails the gate loudly.
+``costs`` builds (or, with ``--check``, validates) the registry-wide
+``pvraft_costs/v1`` cost/HBM inventory (``programs/costs.py``).
 """
 
 from __future__ import annotations
@@ -166,6 +168,55 @@ def _cmd_compile(args) -> int:
     return 0 if rec["ok"] else 1
 
 
+def _cmd_costs(args) -> int:
+    from pvraft_tpu.programs.costs import validate_costs_file
+
+    if args.check:
+        problems = validate_costs_file(args.check, coverage=True)
+        for p in problems:
+            print(p, file=sys.stderr)
+        if not problems:
+            print(f"{args.check}: OK (schema + registry coverage)")
+        return 1 if problems else 0
+
+    from pvraft_tpu.programs.compile import (
+        ToolchainUnavailable,
+        pin_cpu_host,
+    )
+    from pvraft_tpu.programs.costs import run_costs
+
+    pin_cpu_host()
+    sel = _selected(args)
+    try:
+        rec = run_costs(sel, topology=args.topology,
+                        cache_dir=args.cache_dir)
+    except ToolchainUnavailable as e:
+        # Same loud-skip semantics as the kernel-compile leg: a host
+        # with no libtpu may skip; a present-but-broken toolchain fails.
+        print(f"programs costs: {e}", file=sys.stderr)
+        if args.allow_missing_toolchain and e.libtpu_missing:
+            print("programs costs: SKIPPED (no libtpu installed on this "
+                  "host; the inventory regenerates where the compile "
+                  "toolchain is present)", file=sys.stderr)
+            return 0
+        if args.allow_missing_toolchain:
+            print("programs costs: libtpu is installed but the topology "
+                  "failed to build — failing (not skipping)",
+                  file=sys.stderr)
+        return 1
+    if args.out:
+        import os
+
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps({"ok": rec["ok"], "total_s": rec["total_s"],
+                      "programs": [(r["name"], r["ok"])
+                                   for r in rec["programs"]]}))
+    return 0 if rec["ok"] else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pvraft_tpu.programs",
@@ -214,6 +265,23 @@ def main(argv=None) -> int:
                         help="exit 0 (loudly) when libtpu cannot provide "
                              "the compile topology")
     p_comp.set_defaults(fn=_cmd_compile)
+
+    p_costs = sub.add_parser(
+        "costs",
+        help="registry-wide pvraft_costs/v1 cost/HBM inventory "
+             "(or --check a committed artifact)")
+    _common(p_costs)
+    p_costs.add_argument("--topology", default=TOPOLOGY)
+    p_costs.add_argument("--out", default="",
+                         help="write the inventory artifact (JSON)")
+    p_costs.add_argument("--check", default="", metavar="ARTIFACT",
+                         help="validate a committed artifact (schema + "
+                              "registry coverage) instead of compiling")
+    p_costs.add_argument("--cache-dir", default="artifacts/xla_cache")
+    p_costs.add_argument("--allow-missing-toolchain", action="store_true",
+                         help="exit 0 (loudly) when libtpu cannot provide "
+                              "the compile topology")
+    p_costs.set_defaults(fn=_cmd_costs)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,326 @@
+"""Registry-wide cost/HBM inventory: the ``pvraft_costs/v1`` artifact.
+
+Every compilable :class:`~pvraft_tpu.programs.spec.ProgramSpec` gets a
+machine-checkable cost record — XLA ``cost_analysis()`` (flops, bytes
+accessed) and ``memory_analysis()`` (argument/output/temp/peak HBM with
+the fits-16GiB verdict) from a REAL compile of the program — so perf
+claims ("the fused kernel halves bytes accessed", "bf16 serving fits
+two buckets per chip") cite a validated committed artifact instead of a
+free-text note, and drift is test-pinned the same way
+``artifacts/programs_list.txt`` is (``tests/test_costs.py``).
+
+Two compile targets, chosen per spec by its own declaration:
+
+* **topology specs** (``spec.topology`` set — the AOT-certified
+  flagship/serve/kernel programs) compile against the deviceless v5e
+  topology through the same ``serve/aot.aot_compile`` path as
+  ``programs compile``, so the recorded HBM numbers are the numbers a
+  real chip claim sees;
+* **host-trace-only specs** (the audit + profiler corpus,
+  ``topology=None``) compile on the host CPU backend at their trace
+  dims — their records inventory *shape*-level cost (flops scale with
+  the declared dims) and are labeled ``target: "host"`` so nobody
+  mistakes a CPU-backend byte count for an HBM certification. Pallas
+  audit entries compile in interpreter mode on the host leg (the
+  Mosaic-certified numbers live in the ``kernel``-tagged topology
+  records).
+
+``expect_failure`` specs are excluded: ``flagship_train_step_fp32``
+exists to document the single-chip HBM OOM, which the compile gate
+records; a cost inventory of a program that cannot compile would be
+fiction.
+
+CLI::
+
+    python -m pvraft_tpu.programs costs --out artifacts/programs_costs.json
+    python -m pvraft_tpu.programs costs --check artifacts/programs_costs.json
+
+``--check`` validates a committed artifact (schema + full-registry
+coverage) with no toolchain and no compiles — the ``scripts/lint.sh``
+stage; regeneration needs the libtpu compile toolchain and reuses the
+persistent XLA cache (``artifacts/xla_cache``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from pvraft_tpu.programs.geometries import HBM_BYTES, TOPOLOGY
+from pvraft_tpu.programs.spec import ProgramSpec
+
+COSTS_SCHEMA = "pvraft_costs/v1"
+
+# Per-record memory keys (the serve/aot.memory_analysis dict with the
+# artifact's historical fits key; all byte counts must be >= 0).
+_MEMORY_BYTE_KEYS = (
+    "argument_size_in_bytes", "output_size_in_bytes", "temp_size_in_bytes",
+    "generated_code_size_in_bytes", "alias_size_in_bytes",
+)
+
+
+def summarize_cost_analysis(analysis: Any) -> Dict[str, Any]:
+    """Flatten XLA ``compiled.cost_analysis()`` output (a list of
+    per-computation property dicts, or one dict) into the inventory's
+    cost fields: total flops, total bytes accessed, and the optimal-
+    seconds estimate when the backend reports one."""
+    if isinstance(analysis, dict):
+        analysis = [analysis]
+    flops = 0.0
+    bytes_accessed = 0.0
+    optimal_s: Optional[float] = None
+    for props in analysis or ():
+        if not isinstance(props, dict):
+            continue
+        flops += float(props.get("flops", 0.0) or 0.0)
+        bytes_accessed += float(props.get("bytes accessed", 0.0) or 0.0)
+        if "optimal_seconds" in props:
+            optimal_s = (optimal_s or 0.0) + float(props["optimal_seconds"])
+    out: Dict[str, Any] = {
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+    }
+    if optimal_s is not None:
+        out["optimal_seconds"] = optimal_s
+    return out
+
+
+def cost_record(spec: ProgramSpec, devs, target: str,
+                hbm_limit_bytes: int = HBM_BYTES) -> Dict[str, Any]:
+    """Compile one spec and return its ``pvraft_costs/v1`` record.
+    Failures are recorded (``ok: false`` + error), never raised — one
+    broken program must not hide the rest of the inventory."""
+    from pvraft_tpu.programs.compile import _ensure_sharded
+    from pvraft_tpu.serve.aot import aot_compile
+
+    rec: Dict[str, Any] = {
+        "name": spec.name,
+        "target": target,
+        "tags": list(spec.tags),
+    }
+    try:
+        fn, args = spec.build(devices=devs)
+        if devs is not None:
+            args = _ensure_sharded(args, devs)
+        prog = aot_compile(spec.name, fn, tuple(args),
+                           donate_argnums=spec.donate_argnums,
+                           hbm_limit_bytes=hbm_limit_bytes)
+        rec["lower_s"] = round(prog.lower_s, 2)
+        rec["compile_s"] = round(prog.compile_s, 2)
+        try:
+            rec.update(summarize_cost_analysis(prog.compiled.cost_analysis()))
+        except Exception as e:  # noqa: BLE001 — memory can still be recorded
+            rec["cost_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+        mem = prog.memory
+        if mem is not None and "fits_hbm" in mem:
+            mem = dict(mem)
+            mem["fits_16GiB_hbm"] = mem.pop("fits_hbm")
+        rec["memory"] = mem
+        rec["ok"] = "flops" in rec and isinstance(mem, dict) \
+            and "error" not in (mem or {})
+        if not rec["ok"]:
+            rec.setdefault(
+                "error", "compile succeeded but cost/memory analysis "
+                "is incomplete")
+    except Exception as e:  # noqa: BLE001 — record, keep sweeping
+        rec["ok"] = False
+        rec["error"] = f"{type(e).__name__}: {str(e)[:800]}"
+    return rec
+
+
+def run_costs(specs: Sequence[ProgramSpec],
+              topology: str = TOPOLOGY,
+              cache_dir: Optional[str] = None) -> Dict[str, Any]:
+    """The full inventory sweep: topology specs against the deviceless
+    TPU slice, host-trace-only specs on the CPU backend. Caller pins the
+    host platform first (``programs.compile.pin_cpu_host``)."""
+    import jax
+
+    from pvraft_tpu.programs.compile import topology_devices
+
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    topo_specs = [s for s in specs if s.topology and not s.expect_failure]
+    host_specs = [s for s in specs if not s.topology and not s.expect_failure]
+    skipped = [s.name for s in specs if s.expect_failure]
+
+    t0 = time.monotonic()
+    results: List[Dict[str, Any]] = []
+    rec: Dict[str, Any] = {
+        "schema": COSTS_SCHEMA,
+        "topology": topology,
+        "hbm_limit_bytes": HBM_BYTES,
+        "host_platform": jax.devices()[0].platform,
+        "versions": {"jax": jax.__version__},
+        "excluded_expect_failure": sorted(skipped),
+        "programs": results,
+    }
+    try:
+        import importlib.metadata as md
+
+        rec["versions"]["libtpu"] = md.version("libtpu")
+    except Exception:
+        pass
+
+    if topo_specs:
+        devs = topology_devices(topology)  # raises ToolchainUnavailable
+        # The lowering TARGET is the TPU slice: Pallas goes through the
+        # real Mosaic pipeline, exactly like `programs compile`.
+        prev = os.environ.get("PVRAFT_PALLAS_INTERPRET")
+        os.environ["PVRAFT_PALLAS_INTERPRET"] = "0"
+        try:
+            for spec in topo_specs:
+                r = cost_record(spec, devs, target=topology)
+                results.append(r)
+                _progress(r)
+        finally:
+            _restore_env("PVRAFT_PALLAS_INTERPRET", prev)
+    if host_specs:
+        # Host leg: the thunks build their own (CPU) meshes/devices, so
+        # no topology devices are injected. Pallas audit entries must
+        # run the interpreter here — pin_cpu_host() pins compiled
+        # (Mosaic) mode for the topology leg, which cannot target the
+        # cpu backend; the Mosaic-certified kernel numbers live in the
+        # `kernel`-tagged topology records above.
+        prev = os.environ.get("PVRAFT_PALLAS_INTERPRET")
+        os.environ["PVRAFT_PALLAS_INTERPRET"] = "1"
+        try:
+            for spec in host_specs:
+                r = cost_record(spec, None, target="host")
+                results.append(r)
+                _progress(r)
+        finally:
+            _restore_env("PVRAFT_PALLAS_INTERPRET", prev)
+
+    rec["total_s"] = round(time.monotonic() - t0, 1)
+    rec["ok"] = all(r["ok"] for r in results)
+    return rec
+
+
+def _progress(r: Dict[str, Any]) -> None:
+    if r.get("ok"):
+        mem = r.get("memory") or {}
+        print(f"[costs] {r['name']} ({r['target']}): "
+              f"{r.get('flops', 0):.3g} flops, "
+              f"{r.get('bytes_accessed', 0):.3g} B accessed, "
+              f"peak {mem.get('live_bytes_estimate', 0):.3g} B "
+              f"(compile {r.get('compile_s')}s)", flush=True)
+    else:
+        print(f"[costs] {r['name']} ({r['target']}): FAIL "
+              f"{r.get('error', '')[:200]}", flush=True)
+
+
+def _restore_env(key: str, prev: Optional[str]) -> None:
+    if prev is None:
+        os.environ.pop(key, None)
+    else:
+        os.environ[key] = prev
+
+
+# ---------------------------------------------------------------- validate --
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_costs(doc: Any, path: str = "<costs>") -> List[str]:
+    """Schema problems of a ``pvraft_costs/v1`` artifact ([] = valid):
+    per-record cost/memory fields present and sane — negative byte
+    counts, missing verdicts, or a failed record all fail the gate."""
+    if not isinstance(doc, dict):
+        return [f"{path}: artifact is {type(doc).__name__}, not an object"]
+    problems: List[str] = []
+    if doc.get("schema") != COSTS_SCHEMA:
+        problems.append(
+            f"{path}: schema {doc.get('schema')!r} != {COSTS_SCHEMA!r}")
+    for key in ("topology", "hbm_limit_bytes", "programs"):
+        if key not in doc:
+            problems.append(f"{path}: missing field {key!r}")
+    if problems:
+        return problems
+    if not isinstance(doc["programs"], list):
+        problems.append(f"{path}: programs must be a list")
+        return problems
+    seen = set()
+    for i, r in enumerate(doc["programs"]):
+        where = f"{path}: programs[{i}]"
+        if not isinstance(r, dict) or not isinstance(r.get("name"), str):
+            problems.append(f"{where}: not an object with a 'name'")
+            continue
+        where = f"{path}: {r['name']}"
+        if r["name"] in seen:
+            problems.append(f"{where}: duplicate record")
+        seen.add(r["name"])
+        if not isinstance(r.get("target"), str) or not r.get("target"):
+            problems.append(f"{where}: missing/empty 'target'")
+        if not r.get("ok"):
+            problems.append(
+                f"{where}: record is not ok "
+                f"({r.get('error', 'no error recorded')[:200]})")
+            continue
+        for key in ("flops", "bytes_accessed"):
+            if not _is_num(r.get(key)) or r[key] < 0:
+                problems.append(
+                    f"{where}: {key}={r.get(key)!r} must be a number >= 0")
+        mem = r.get("memory")
+        if not isinstance(mem, dict):
+            problems.append(f"{where}: missing memory analysis")
+            continue
+        for key in _MEMORY_BYTE_KEYS:
+            if key in mem and (not _is_num(mem[key]) or mem[key] < 0):
+                problems.append(
+                    f"{where}: memory.{key}={mem[key]!r} must be a "
+                    "number >= 0")
+        if not _is_num(mem.get("live_bytes_estimate")):
+            problems.append(
+                f"{where}: memory.live_bytes_estimate missing — the peak-"
+                "HBM estimate is the record's point")
+        if not isinstance(mem.get("fits_16GiB_hbm"), bool):
+            problems.append(
+                f"{where}: memory.fits_16GiB_hbm must be a bool verdict")
+    return problems
+
+
+def check_coverage(doc: Dict[str, Any],
+                   specs: Sequence[ProgramSpec],
+                   path: str = "<costs>") -> List[str]:
+    """Registry-coverage problems: every non-``expect_failure`` spec must
+    have a record and every record must name a live spec — the same
+    both-directions drift pin ``programs_list.txt`` has."""
+    problems: List[str] = []
+    want = {s.name for s in specs if not s.expect_failure}
+    have = {r.get("name") for r in doc.get("programs", ())
+            if isinstance(r, dict)}
+    for name in sorted(want - have):
+        problems.append(
+            f"{path}: registry spec {name!r} has no cost record — "
+            "regenerate with `python -m pvraft_tpu.programs costs --out "
+            f"{path}`")
+    for name in sorted(have - want):
+        problems.append(
+            f"{path}: record {name!r} names no live registry spec "
+            "(stale artifact) — regenerate")
+    return problems
+
+
+def validate_costs_file(path: str,
+                        coverage: bool = False) -> List[str]:
+    import json
+
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable: {e}"]
+    problems = validate_costs(doc, path=path)
+    if coverage and not problems:
+        from pvraft_tpu.programs import load_catalog, specs as registry
+
+        load_catalog()
+        problems = check_coverage(doc, list(registry().values()), path=path)
+    return problems

@@ -73,7 +73,9 @@ def _cmd_serve(args) -> int:
                            port=args.port, telemetry=telemetry,
                            quiet=not args.verbose,
                            trace_sample_every=args.trace_sample,
-                           trace_dir=args.trace_dir)
+                           trace_dir=args.trace_dir,
+                           strict_retrace=args.strict_retrace,
+                           devmem_interval_s=args.devmem_interval)
     server.start()
     print(f"[serve] listening on http://{server.host}:{server.port} "
           f"(/predict /healthz /metrics /debug/trace); tracing "
@@ -149,6 +151,17 @@ def main(argv=None) -> int:
     srv.add_argument("--trace_dir", default="",
                      help="base directory for /debug/trace XLA profile "
                           "windows (default: a temp dir)")
+    srv.add_argument("--strict_retrace", "--strict-retrace",
+                     dest="strict_retrace", action="store_true",
+                     help="fail a dispatch (HTTP 500) when any backend "
+                          "compile is observed after AOT startup sealed "
+                          "the program set; without it the retrace "
+                          "watchdog only emits `recompile` events + the "
+                          "pvraft_serve_recompiles_total counter")
+    srv.add_argument("--devmem_interval", type=float, default=10.0,
+                     help="seconds between device.memory_stats() samples "
+                          "(device_memory events + "
+                          "pvraft_device_hbm_bytes gauge; 0 disables)")
     srv.add_argument("--platform", default="",
                      help="force a jax platform (e.g. cpu)")
     srv.add_argument("--verbose", action="store_true",

@@ -134,7 +134,7 @@ class MicroBatcher:
     """Bucket collectors -> batch queue -> per-replica executors."""
 
     def __init__(self, engine: InferenceEngine, cfg: BatcherConfig,
-                 telemetry=None, metrics=None):
+                 telemetry=None, metrics=None, watchdog=None):
         largest = max(engine.cfg.batch_sizes)
         if cfg.max_batch > largest:
             raise ValueError(
@@ -146,6 +146,12 @@ class MicroBatcher:
         self.cfg = cfg
         self.telemetry = telemetry
         self.metrics = metrics
+        # Retrace watchdog (obs/retrace.py), sealed by build_service
+        # after AOT startup: the executors check it per dispatch — in
+        # strict mode a post-seal compile raises inside the dispatch try
+        # and fails the batch loudly (HTTP 500) instead of silently
+        # paying a compile stall per request.
+        self.watchdog = watchdog
         # The executor pool: the engine's replicas, or the engine itself
         # as a single executor (test doubles without a pool).
         self.replicas = list(getattr(engine, "replicas", ()) or ()) \
@@ -433,12 +439,19 @@ class MicroBatcher:
         if not group:
             return
         t0 = time.monotonic()
+        # Sealed-mode window: only compiles landing DURING this dispatch
+        # trip (a co-resident engine compiling its startup table between
+        # requests — the serve_ab two-leg pattern — is not ours to flag).
+        compile_window = (self.watchdog.global_compiles()
+                          if self.watchdog is not None else 0)
         with self._count_lock:
             self._busy += 1
             self._replica_inflight[index] += len(group)
         try:
             flows = replica.predict_batch(
                 [(r.pc1, r.pc2) for r in group], bucket)
+            if self.watchdog is not None:
+                self._watchdog_check(bucket, len(group), compile_window)
         except BaseException as e:  # noqa: BLE001 — fail the group, not the executor
             for req in group:
                 req.fail(e)
@@ -507,6 +520,26 @@ class MicroBatcher:
                 replica=index, device_id=device_id)
         for req, flow in live:
             req.resolve(flow)
+
+    def _watchdog_check(self, bucket: int, n: int,
+                        compile_window: int) -> None:
+        """Per-dispatch retrace check. The Prometheus counter bumps for
+        every trip whether or not strict mode then raises (a strict
+        failure must still be visible on /metrics)."""
+        from pvraft_tpu.obs.retrace import RetraceError
+
+        try:
+            trips = self.watchdog.check(
+                signature=f"bucket={bucket} n={n}",
+                program=f"serve_dispatch_b{bucket}",
+                window_start=compile_window)
+        except RetraceError:
+            if self.metrics is not None:
+                self.metrics.record_recompile()
+            raise
+        if self.metrics is not None:
+            for _ in trips:
+                self.metrics.record_recompile()
 
     # ----------------------------------------------------------- shutdown --
 

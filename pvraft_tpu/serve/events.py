@@ -96,6 +96,34 @@ class ServeTelemetry:
         with self._lock:
             self.events.emit("span", **span)
 
+    def emit_recompile(self, program: str, count: int,
+                       baseline: Optional[int] = None,
+                       signature: Optional[str] = None,
+                       context: Optional[str] = None) -> None:
+        """The serve retrace watchdog saw a backend compile AFTER the
+        AOT startup sealed the program set (obs/retrace.py) — on the
+        serving path every compile is a latency cliff, so it rides the
+        event stream whether or not --strict-retrace is armed."""
+        fields: Dict[str, Any] = {"program": program, "count": count}
+        if baseline is not None:
+            fields["baseline"] = baseline
+        if signature is not None:
+            fields["signature"] = signature
+        if context is not None:
+            fields["context"] = context
+        with self._lock:
+            self.events.emit("recompile", **fields)
+
+    def emit_device_memory(self, devices: list,
+                           context: Optional[str] = None) -> None:
+        """One periodic device-memory sample from the serve pool's
+        monitor thread (obs/device_memory.py)."""
+        fields: Dict[str, Any] = {"devices": devices}
+        if context is not None:
+            fields["context"] = context
+        with self._lock:
+            self.events.emit("device_memory", **fields)
+
     def emit_shutdown(self, served: int, rejected: int,
                       drained: int) -> None:
         with self._lock:

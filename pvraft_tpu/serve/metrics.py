@@ -114,6 +114,11 @@ class ServeMetrics:
         # latency fed from traced requests (obs/trace.py).
         self.request_points = LatencyHistogram(edges=POINT_EDGES)
         self.stage_latency: Dict[Tuple[int, str], LatencyHistogram] = {}
+        # Latest device-memory sample rows (obs/device_memory.py) and
+        # the recompile-trip counter (obs/retrace.py) — both
+        # Prometheus-only, fed by the serve pool's monitor/watchdog.
+        self.device_memory: List[Dict[str, Any]] = []
+        self.recompiles_total = 0
 
     def record_submit(self, bucket: int,
                       n_points: Optional[int] = None) -> None:
@@ -136,6 +141,17 @@ class ServeMetrics:
                     hist = LatencyHistogram()
                     self.stage_latency[(int(bucket), stage)] = hist
                 hist.observe(ms)
+
+    def record_device_memory(self, rows: List[Dict[str, Any]]) -> None:
+        """Latest per-device memory sample (gauge semantics: the newest
+        sample wins; history lives on the event stream, not here)."""
+        with self._lock:
+            self.device_memory = [dict(r) for r in rows]
+
+    def record_recompile(self) -> None:
+        """One retrace-watchdog trip (obs/retrace.py)."""
+        with self._lock:
+            self.recompiles_total += 1
 
     def record_reject(self, reason: str) -> None:
         with self._lock:
@@ -318,6 +334,27 @@ def render_prometheus(metrics: "ServeMetrics",
                        row["batches_total"],
                        {"replica": row["replica"],
                         "device": row["device_id"]})
+    if metrics.device_memory:
+        doc.family("pvraft_device_hbm_bytes", "gauge",
+                   "Device bytes in use, latest device.memory_stats() "
+                   "sample (obs/device_memory.py).")
+        for row in metrics.device_memory:
+            doc.sample("pvraft_device_hbm_bytes", row["bytes_in_use"],
+                       {"device": row["device_id"]})
+        if any("peak_bytes_in_use" in r for r in metrics.device_memory):
+            doc.family("pvraft_device_hbm_peak_bytes", "gauge",
+                       "Peak device bytes in use since process start "
+                       "(allocator watermark).")
+            for row in metrics.device_memory:
+                if "peak_bytes_in_use" in row:
+                    doc.sample("pvraft_device_hbm_peak_bytes",
+                               row["peak_bytes_in_use"],
+                               {"device": row["device_id"]})
+    doc.family("pvraft_serve_recompiles_total", "counter",
+               "Retrace-watchdog trips: backend compiles observed after "
+               "the AOT program set sealed (each also rides the event "
+               "stream as a `recompile` record).")
+    doc.sample("pvraft_serve_recompiles_total", metrics.recompiles_total)
     doc.family("pvraft_serve_latency_ms", "histogram",
                "End-to-end request latency (enqueue to resolve), ms.")
     doc.histogram("pvraft_serve_latency_ms", metrics.latency)

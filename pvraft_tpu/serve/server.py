@@ -410,9 +410,13 @@ class ServeHTTPServer:
                  port: int = 8000, metrics=None,
                  predict_timeout_s: float = 60.0, quiet: bool = True,
                  tracer: Optional[Tracer] = None, telemetry=None,
-                 trace_dir: str = ""):
+                 trace_dir: str = "", devmem_monitor=None):
         self.batcher = batcher
         self.tracer = tracer
+        # Performance-plane hooks (build_service wires them): the
+        # device-memory sampler thread and — via the batcher — the
+        # sealed retrace watchdog; shutdown() releases both.
+        self.devmem_monitor = devmem_monitor
         # 64 B/coordinate bounds any JSON float spelling (msgpack raw f32
         # is 4 B); anything past this cannot fit the largest bucket and
         # would only be buffered to be 413'd after parsing.
@@ -443,9 +447,18 @@ class ServeHTTPServer:
             target=self.httpd.serve_forever, name="pvraft-serve-http",
             daemon=True)
         self._thread.start()
+        if self.devmem_monitor is not None:
+            self.devmem_monitor.start()
 
     def shutdown(self, drain: bool = True) -> None:
         self.batcher.shutdown(drain=drain)
+        if self.devmem_monitor is not None:
+            self.devmem_monitor.stop()
+        if self.batcher.watchdog is not None:
+            # Unhook the process-wide compile listener: tests (and
+            # embedded servers) build services repeatedly in one
+            # process, and a dead server must not keep watching.
+            self.batcher.watchdog.close()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
@@ -458,7 +471,9 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
                   predict_timeout_s: float = 60.0,
                   quiet: bool = True, trace_sample_every: int = 16,
                   trace_dir: str = "",
-                  eager_when_idle: bool = True) -> ServeHTTPServer:
+                  eager_when_idle: bool = True,
+                  strict_retrace: bool = False,
+                  devmem_interval_s: float = 10.0) -> ServeHTTPServer:
     """The one canonical engine -> metrics -> batcher -> HTTP assembly,
     shared by ``python -m pvraft_tpu.serve`` and the load generator so
     the two serving surfaces cannot drift: ``max_batch`` is always the
@@ -468,18 +483,48 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
     sampled spans go to ``telemetry`` when present and always feed the
     per-stage Prometheus histograms. ``eager_when_idle=False`` restores
     the PR-7 always-wait straggler window (the A/B baseline leg).
+
+    Performance plane: the retrace watchdog seals the AOT program set
+    here — any later backend compile becomes a ``recompile`` event +
+    ``pvraft_serve_recompiles_total`` bump, and ``strict_retrace`` makes
+    it fail the dispatch (HTTP 500) instead; a
+    :class:`~pvraft_tpu.obs.device_memory.DeviceMemoryMonitor` samples
+    ``device.memory_stats()`` every ``devmem_interval_s`` seconds into
+    ``device_memory`` events and the ``pvraft_device_hbm_bytes{device}``
+    gauge (0 disables; CPU backends sample to nothing either way).
     Returns an unstarted server (``.start()`` / ``.shutdown()``)."""
+    from pvraft_tpu.obs.device_memory import DeviceMemoryMonitor
+    from pvraft_tpu.obs.retrace import RetraceWatchdog
+
     metrics = ServeMetrics(engine.cfg.buckets)
+    watchdog = RetraceWatchdog(
+        emit=telemetry.emit_recompile if telemetry is not None else None,
+        strict=strict_retrace, context="serve")
+    # Seal BEFORE the batcher's executors exist: every AOT program is
+    # already compiled (engine construction), so from here on a compile
+    # DURING a dispatch is always a bug worth an event (the executors
+    # scope each check to its dispatch window via global_compiles()).
+    if not watchdog.seal():
+        # No monitoring API on this jax: the watchdog cannot observe
+        # compiles at all. Say so — especially under strict_retrace,
+        # where the operator believes recompiles fail loudly.
+        print("[serve] retrace watchdog DISARMED: this jax exposes no "
+              "compile-monitoring API (compat.register_compile_listener)"
+              + (" — --strict_retrace will never fire"
+                 if strict_retrace else ""), flush=True)
     batcher = MicroBatcher(
         engine,
         BatcherConfig(max_batch=max(engine.cfg.batch_sizes),
                       max_wait_ms=max_wait_ms, queue_depth=queue_depth,
                       eager_when_idle=eager_when_idle),
-        telemetry=telemetry, metrics=metrics)
+        telemetry=telemetry, metrics=metrics, watchdog=watchdog)
     tracer = Tracer(
         sample_every=trace_sample_every,
         emit=telemetry.emit_span if telemetry is not None else None)
+    devmem = DeviceMemoryMonitor(
+        emit=telemetry.emit_device_memory if telemetry is not None else None,
+        metrics=metrics, interval_s=devmem_interval_s, context="serve")
     return ServeHTTPServer(batcher, host=host, port=port, metrics=metrics,
                            predict_timeout_s=predict_timeout_s, quiet=quiet,
                            tracer=tracer, telemetry=telemetry,
-                           trace_dir=trace_dir)
+                           trace_dir=trace_dir, devmem_monitor=devmem)

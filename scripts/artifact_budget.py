@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Artifact size budget: per-glob byte caps over committed evidence.
+
+    python scripts/artifact_budget.py          # check (lint.sh stage)
+    python scripts/artifact_budget.py --list   # show usage per file
+
+Committed evidence artifacts were growing without bound — the serve
+A/B trace files hit 11k+ lines each — and nothing pushed back until a
+reviewer noticed. This gate enumerates GIT-TRACKED files under
+``artifacts/`` (untracked scratch like ``xla_cache/`` is exempt by
+construction), matches each against the budget table below (first
+match wins), and exits non-zero when any file exceeds its cap.
+
+Shrinking an over-budget artifact honestly:
+
+* ``*.trace.json`` — ``scripts/downsample_trace.py --keep N`` (evenly
+  sampled trace trees, counts recomputed, ``downsampled`` marker);
+* ``*.events.jsonl`` — regenerate with a smaller loadgen request count
+  or a sparser ``--trace_sample``;
+* anything else — regenerate smaller, or (when a bigger artifact is
+  genuinely the right call) raise the cap HERE, in a reviewed diff.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (glob, max bytes) — first match wins; globs are repo-relative paths.
+BUDGETS = (
+    # Per-request span detail: sampled via downsample_trace.py; the
+    # aggregate claims live in the loadgen/SLO artifacts.
+    ("artifacts/*.trace.json", 128 * 1024),
+    # Event streams: one line per step/batch/span; the flagship serve
+    # capture (256 requests, 100% sampled) sits near 600 KiB.
+    ("artifacts/*.events.jsonl", 768 * 1024),
+    ("artifacts/*.jsonl", 128 * 1024),
+    # Structured reports (costs inventory, SLO, loadgen, convergence).
+    ("artifacts/*.json", 128 * 1024),
+    ("artifacts/*.log", 64 * 1024),
+    ("artifacts/*.txt", 64 * 1024),
+    ("artifacts/*.md", 64 * 1024),
+    # Catch-all: anything new under artifacts/ gets a cap by default
+    # rather than growing until someone notices.
+    ("artifacts/*", 128 * 1024),
+)
+
+
+def tracked_artifacts() -> list:
+    out = subprocess.run(
+        ["git", "ls-files", "artifacts"], cwd=REPO,
+        capture_output=True, text=True, check=True)
+    return [l for l in out.stdout.splitlines() if l.strip()]
+
+
+def budget_for(path: str):
+    for glob, cap in BUDGETS:
+        if fnmatch.fnmatch(path, glob):
+            return glob, cap
+    return None, None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--list", action="store_true",
+                        help="print every file's usage vs its cap")
+    args = parser.parse_args(argv)
+
+    violations = []
+    rows = []
+    for rel in tracked_artifacts():
+        full = os.path.join(REPO, rel)
+        if not os.path.exists(full):  # staged deletion
+            continue
+        size = os.path.getsize(full)
+        glob, cap = budget_for(rel)
+        rows.append((rel, size, glob, cap))
+        if cap is not None and size > cap:
+            violations.append((rel, size, glob, cap))
+    if args.list:
+        for rel, size, glob, cap in sorted(rows, key=lambda r: -r[1]):
+            pct = f"{100.0 * size / cap:5.1f}%" if cap else "  n/a"
+            print(f"{size:>9} B  {pct} of {cap:>8} ({glob})  {rel}")
+    for rel, size, glob, cap in violations:
+        print(f"OVER BUDGET: {rel} is {size} B, cap {cap} B "
+              f"(glob {glob!r}) — downsample/regenerate it or raise the "
+              "cap in scripts/artifact_budget.py in a reviewed diff",
+              file=sys.stderr)
+    if not violations and not args.list:
+        print(f"artifact budget: {len(rows)} tracked artifact(s) within "
+              "caps")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

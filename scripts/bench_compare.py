@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare a candidate ``pvraft_bench/v1``
+artifact against a committed baseline.
+
+    python scripts/bench_compare.py artifacts/bench_baseline.json BENCH.json
+    python scripts/bench_compare.py BASE CAND --noise 0.15
+
+Exit codes (CI semantics):
+
+    0  within the noise band (or an improvement — printed so a better
+       number can be promoted to the committed baseline deliberately)
+    1  regression: candidate fell below baseline by more than the band
+    2  refused: the pair is not comparable — schema problems, a
+       platform mismatch (a CPU-fallback run ratioed against a TPU
+       baseline is the BENCH_r05 failure mode this gate exists to
+       kill), a config/variant/A-B-lever mismatch, or a zero
+       measurement
+
+The noise band is ``max(--noise, dt_spread of either artifact)``: a
+run whose own recorded repeat spread exceeds the configured band widens
+the band honestly instead of flagging its own jitter as a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from pvraft_tpu.obs.bench import (  # noqa: E402
+    DEFAULT_NOISE,
+    compare,
+    load_bench_file,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("baseline", help="committed baseline artifact")
+    parser.add_argument("candidate", help="candidate bench output")
+    parser.add_argument("--noise", type=float, default=DEFAULT_NOISE,
+                        help="relative noise band floor "
+                             f"(default {DEFAULT_NOISE:.2f}; the band is "
+                             "max(this, either artifact's dt_spread))")
+    args = parser.parse_args(argv)
+
+    baseline, bproblems = load_bench_file(args.baseline)
+    candidate, cproblems = load_bench_file(args.candidate)
+    if bproblems or cproblems:
+        for p in (*bproblems, *cproblems):
+            print(p, file=sys.stderr)
+        return 2
+    verdict, messages = compare(
+        baseline, candidate, noise=args.noise,
+        baseline_path=args.baseline, candidate_path=args.candidate)
+    stream = sys.stderr if verdict != "ok" else sys.stdout
+    for m in messages:
+        print(m, file=stream)
+    print(f"bench_compare: {verdict} "
+          f"(baseline {baseline.get('value')}, "
+          f"candidate {candidate.get('value')})",
+          file=stream)
+    return {"ok": 0, "regression": 1, "refused": 2}[verdict]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Downsample a committed ``pvraft_trace/v1`` artifact to N trace trees.
+
+    python scripts/downsample_trace.py artifacts/foo.trace.json --keep 48
+
+Committed trace artifacts grew unbounded with loadgen request counts
+(11k+ lines each by PR 8); the artifact-size budget
+(``scripts/artifact_budget.py``, a ``lint.sh`` stage) caps them, and
+this tool shrinks an over-budget artifact honestly:
+
+* keeps an EVENLY-SPACED sample of the trace trees (trace ids are
+  sorted in the artifact, so even spacing samples across the whole run,
+  not just its warm-up);
+* recomputes ``counts`` from the kept spans with the same
+  ``trace_shape`` definition the validator uses — the result still
+  passes ``python -m pvraft_tpu.obs validate-trace`` with zero special
+  cases;
+* records what happened in a ``downsampled: {kept, of}`` field so the
+  artifact can never masquerade as the full capture. Aggregate claims
+  (QPS, stage quantiles) live in the loadgen/SLO artifacts, which keep
+  EVERY request — only the per-request span detail is sampled here.
+
+In-place by default; ``--out`` writes elsewhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from pvraft_tpu.obs.trace import (  # noqa: E402
+    trace_shape,
+    validate_trace_artifact,
+)
+
+
+def downsample(doc: dict, keep: int) -> dict:
+    traces = doc["traces"]
+    total = len(traces)
+    if keep >= total:
+        return doc
+    # Evenly spaced over the sorted trace list.
+    idx = sorted({round(i * (total - 1) / max(1, keep - 1))
+                  for i in range(keep)})
+    kept = [traces[i] for i in idx]
+    expected = doc["expected_stages"]
+    n_complete = n_orphans = n_spans = 0
+    for trace in kept:
+        _, orphans, _, complete = trace_shape(trace["spans"], expected)
+        n_complete += complete
+        n_orphans += len(orphans)
+        n_spans += len(trace["spans"])
+    out = dict(doc)
+    out["traces"] = kept
+    out["counts"] = {"traces": len(kept), "spans": n_spans,
+                     "complete": n_complete, "orphan_spans": n_orphans}
+    prior = doc.get("downsampled") or {}
+    out["downsampled"] = {"kept": len(kept),
+                          "of": prior.get("of", total)}
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("path", help="pvraft_trace/v1 artifact")
+    parser.add_argument("--keep", type=int, required=True,
+                        help="trace trees to keep (evenly spaced)")
+    parser.add_argument("--out", default="",
+                        help="output path (default: in place)")
+    args = parser.parse_args(argv)
+    if args.keep < 1:
+        print("--keep must be >= 1", file=sys.stderr)
+        return 2
+    with open(args.path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    problems = validate_trace_artifact(doc, path=args.path)
+    if problems:
+        for p in problems:
+            print(p, file=sys.stderr)
+        print("refusing to downsample an invalid artifact",
+              file=sys.stderr)
+        return 1
+    out_doc = downsample(doc, args.keep)
+    if out_doc is doc:
+        print(f"{args.path}: already has <= {args.keep} traces "
+              f"({len(doc['traces'])}) — nothing to do")
+        return 0
+    problems = validate_trace_artifact(out_doc, path=args.path)
+    if problems:  # pragma: no cover — downsampling preserves validity
+        for p in problems:
+            print(p, file=sys.stderr)
+        return 1
+    out_path = args.out or args.path
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(out_doc, f, indent=2)  # the loadgen writer's format
+        f.write("\n")
+    ds = out_doc.get("downsampled", {})
+    print(f"{out_path}: kept {ds.get('kept', len(out_doc['traces']))} of "
+          f"{ds.get('of')} traces "
+          f"({os.path.getsize(out_path)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,41 @@ echo "== programs: deviceless Mosaic compile of every Pallas kernel entry point"
 JAX_PLATFORMS=cpu \
   python -m pvraft_tpu.programs compile --tag kernel --allow-missing-toolchain
 
+echo "== programs: pvraft_costs/v1 smoke (cost/HBM analysis of the kernel tag)"
+# The cost-inventory machinery runs end-to-end over the Pallas kernel
+# specs (same deviceless Mosaic topology as the compile gate above; the
+# shared artifacts/xla_cache makes the second pass cheap) — so a
+# cost_analysis()/memory_analysis() API drift fails HERE, not at the
+# next full regeneration. Same loud-skip semantics as the kernel leg
+# when the runner has no libtpu.
+JAX_PLATFORMS=cpu \
+  python -m pvraft_tpu.programs costs --tag kernel --allow-missing-toolchain
+
+echo "== programs: committed cost inventory validates + covers the registry"
+# artifacts/programs_costs.json must be schema-valid AND cover every
+# non-expect_failure ProgramSpec, both directions (the programs_list
+# drift discipline). Pure validation — no toolchain, no compiles.
+JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
+  python -m pvraft_tpu.programs costs --check artifacts/programs_costs.json
+
+echo "== pvraft_bench/v1: committed bench artifacts validate + the gate wires"
+# The bench baseline must parse against the schema (platform/comparable
+# first-class — a CPU fallback can never masquerade as a TPU number),
+# and bench_compare must accept a self-comparison (end-to-end wiring:
+# schema -> comparability checks -> noise band -> exit code).
+bench_artifacts=$(ls artifacts/bench_*.json 2>/dev/null || true)
+if [ -n "$bench_artifacts" ]; then
+  # shellcheck disable=SC2086 -- word splitting over the file list is intended
+  python -m pvraft_tpu.obs validate-bench $bench_artifacts
+  python scripts/bench_compare.py artifacts/bench_baseline.json \
+    artifacts/bench_baseline.json
+else
+  echo "(no committed bench artifacts)"
+fi
+
+echo "== artifact size budget (per-glob byte caps over committed evidence)"
+python scripts/artifact_budget.py
+
 echo "== pvraft_events/v1: committed event logs validate"
 # Any event log shipped as evidence (artifacts/) plus the golden test
 # fixture must parse against the schema — a drifted writer fails the

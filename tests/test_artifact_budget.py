@@ -1,0 +1,69 @@
+"""Artifact size budget + trace downsampler: the evidence files stay
+bounded, and shrinking them preserves validator-clean artifacts."""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+def test_budget_table_first_match_wins():
+    from artifact_budget import budget_for
+
+    glob, cap = budget_for("artifacts/serve_ab_pool.trace.json")
+    assert glob == "artifacts/*.trace.json"
+    glob2, cap2 = budget_for("artifacts/serve_ab_pool.json")
+    assert glob2 == "artifacts/*.json" and cap2 >= cap / 2
+    glob3, _ = budget_for("artifacts/train_cpu_synthetic.events.jsonl")
+    assert glob3 == "artifacts/*.events.jsonl"
+    # Anything new under artifacts/ falls into the catch-all.
+    glob4, cap4 = budget_for("artifacts/whatever.bin")
+    assert glob4 == "artifacts/*" and cap4 > 0
+
+
+def test_committed_artifacts_within_budget(capsys):
+    """The lint.sh invariant as a test: every git-tracked artifact fits
+    its cap (if this fails, downsample/regenerate — see the script's
+    docstring — rather than raising caps casually)."""
+    from artifact_budget import main
+
+    assert main([]) == 0, capsys.readouterr().err
+
+
+def test_downsample_preserves_validity(tmp_path):
+    from downsample_trace import downsample, main
+
+    from pvraft_tpu.obs.trace import validate_trace_artifact
+
+    src = os.path.join(REPO, "artifacts", "serve_cpu_synthetic.trace.json")
+    doc = json.load(open(src, encoding="utf-8"))
+    original_of = doc.get("downsampled", {}).get(
+        "of", doc["counts"]["traces"])
+    out = downsample(doc, 5)
+    assert validate_trace_artifact(out) == []
+    assert out["counts"]["traces"] == 5
+    assert len(out["traces"]) == 5
+    # The marker survives repeated shrinking: "of" stays the ORIGINAL
+    # capture size, so the artifact never pretends to be the full run.
+    again = downsample(out, 3)
+    assert again["downsampled"] == {"kept": 3, "of": original_of}
+    assert validate_trace_artifact(again) == []
+
+    # CLI round-trip via --out; refuses an invalid artifact.
+    dst = tmp_path / "sub.trace.json"
+    assert main([src, "--keep", "4", "--out", str(dst)]) == 0
+    sub = json.load(open(dst, encoding="utf-8"))
+    assert validate_trace_artifact(sub) == []
+    bad = tmp_path / "bad.trace.json"
+    bad.write_text(json.dumps({"schema": "nope"}))
+    assert main([str(bad), "--keep", "2"]) == 1
+
+
+def test_downsample_keep_all_is_identity():
+    from downsample_trace import downsample
+
+    src = os.path.join(REPO, "artifacts", "serve_ab_pool.trace.json")
+    doc = json.load(open(src, encoding="utf-8"))
+    assert downsample(doc, 10 ** 6) is doc

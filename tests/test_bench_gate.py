@@ -1,0 +1,201 @@
+"""pvraft_bench/v1 + the regression gate: validator red/green, the
+comparability rules (CPU fallback can never ratio against a TPU
+baseline), and bench_compare.py's exit codes on an injected regression
+and a platform-mismatched comparison (the acceptance criteria)."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pvraft_tpu.obs.bench import (
+    BENCH_SCHEMA,
+    compare,
+    validate_bench,
+    validate_bench_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _doc(**over):
+    doc = {
+        "schema": BENCH_SCHEMA,
+        "metric": "train_point_pairs_per_sec_per_chip",
+        "value": 50000.0,
+        "unit": "point-pairs/s/chip (8192 pts, 8 iters, bs=2, "
+                "fwd+bwd+adam)",
+        "platform": "tpu",
+        "comparable": True,
+        "vs_baseline": 6.6,
+        "variant": "bf16+pallas+approx",
+        "dt_spread": 0.03,
+    }
+    doc.update(over)
+    return doc
+
+
+# --- validator --------------------------------------------------------------
+
+
+def test_validate_green():
+    assert validate_bench(_doc()) == []
+    assert validate_bench(_doc(platform="cpu", comparable=False,
+                               vs_baseline=0.0,
+                               note="cpu fallback")) == []
+
+
+@pytest.mark.parametrize("over, fragment", [
+    ({"schema": "pvraft_bench/v0"}, "schema"),
+    ({"value": -1.0}, "value"),
+    ({"value": "fast"}, "value"),
+    ({"platform": ""}, "platform"),
+    ({"comparable": "yes"}, "comparable must be a bool"),
+    ({"surprise": 1}, "unknown field"),
+    ({"dt_reps": [0.5, -0.1]}, "dt_reps"),
+])
+def test_validate_red(over, fragment):
+    problems = validate_bench(_doc(**over))
+    assert problems and any(fragment in p for p in problems), problems
+
+
+def test_validate_red_missing_required():
+    for key in ("platform", "comparable", "vs_baseline", "unit"):
+        doc = _doc()
+        del doc[key]
+        assert any(f"missing required field {key!r}" in p
+                   for p in validate_bench(doc)), key
+
+
+def test_incomparable_must_zero_vs_baseline():
+    # The BENCH_r05 failure mode, now a schema violation: an
+    # incomparable (CPU-fallback) run carrying a baseline ratio.
+    problems = validate_bench(_doc(platform="cpu", comparable=False,
+                                   vs_baseline=0.5))
+    assert any("may never carry a baseline ratio" in p for p in problems)
+    # …and comparable=true off-TPU is itself a violation.
+    problems = validate_bench(_doc(platform="cpu", comparable=True,
+                                   vs_baseline=6.6))
+    assert any("only TPU measurements" in p for p in problems)
+
+
+def test_validate_file_single_line(tmp_path):
+    path = tmp_path / "bench.json"
+    path.write_text(json.dumps(_doc()) + "\n")
+    assert validate_bench_file(str(path)) == []
+    path.write_text(json.dumps(_doc()) + "\n" + json.dumps(_doc()) + "\n")
+    assert any("exactly one JSON line" in p
+               for p in validate_bench_file(str(path)))
+    path.write_text("not json\n")
+    assert any("not valid JSON" in p
+               for p in validate_bench_file(str(path)))
+
+
+# --- compare ----------------------------------------------------------------
+
+
+def test_compare_within_band_ok():
+    verdict, msgs = compare(_doc(), _doc(value=48000.0))
+    assert verdict == "ok"
+    assert any("within the noise band" in m for m in msgs)
+
+
+def test_compare_regression():
+    verdict, msgs = compare(_doc(), _doc(value=40000.0))  # -20% > 10% band
+    assert verdict == "regression"
+    assert any("REGRESSION" in m for m in msgs)
+
+
+def test_compare_improvement_suggests_promotion():
+    verdict, msgs = compare(_doc(), _doc(value=60000.0))
+    assert verdict == "ok"
+    assert any("promoting the candidate" in m for m in msgs)
+
+
+def test_compare_spread_widens_band():
+    # A candidate whose own recorded spread exceeds the band must not
+    # flag its own jitter: 15% drop inside an 18% recorded spread.
+    verdict, _ = compare(_doc(), _doc(value=42500.0, dt_spread=0.18))
+    assert verdict == "ok"
+    verdict, _ = compare(_doc(), _doc(value=42500.0, dt_spread=0.01))
+    assert verdict == "regression"
+
+
+def test_compare_refuses_cross_platform():
+    cpu = _doc(platform="cpu", comparable=False, vs_baseline=0.0)
+    verdict, msgs = compare(_doc(), cpu)
+    assert verdict == "refused"
+    assert any("platform mismatch" in m for m in msgs)
+    assert any("CPU-fallback" in m for m in msgs)
+
+
+def test_compare_refuses_config_and_lever_mismatch():
+    verdict, msgs = compare(
+        _doc(), _doc(unit="point-pairs/s/chip (2048 pts, 4 iters, bs=2, "
+                          "fwd+bwd+adam)"))
+    assert verdict == "refused" and any("unit mismatch" in m for m in msgs)
+    verdict, msgs = compare(_doc(), _doc(variant="fp32"))
+    assert verdict == "refused" and any("variant" in m for m in msgs)
+    verdict, msgs = compare(
+        _doc(), _doc(ab_flags={"scatter_free_vjp": True}))
+    assert verdict == "refused" and any("ab_flags" in m for m in msgs)
+
+
+def test_compare_refuses_zero_measurement():
+    verdict, msgs = compare(_doc(value=0.0, vs_baseline=0.0,
+                                 comparable=False, platform="tpu"),
+                            _doc())
+    # comparable=False + platform tpu is legal schema-wise (a failed TPU
+    # run), but a zero baseline carries no information.
+    assert verdict == "refused"
+    assert any("zero/failed measurement" in m for m in msgs)
+
+
+# --- the CLI (acceptance: nonzero on regression AND platform mismatch) ------
+
+
+def _run_cli(baseline, candidate, tmp_path, *extra):
+    bp = tmp_path / "baseline.json"
+    cp = tmp_path / "candidate.json"
+    bp.write_text(json.dumps(baseline) + "\n")
+    cp.write_text(json.dumps(candidate) + "\n")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_compare.py"),
+         str(bp), str(cp), *extra],
+        capture_output=True, text=True, timeout=120)
+
+
+def test_cli_ok_and_injected_regression_and_platform_mismatch(tmp_path):
+    out = _run_cli(_doc(), _doc(value=49000.0), tmp_path)
+    assert out.returncode == 0, out.stderr
+    # Injected regression: exit 1.
+    out = _run_cli(_doc(), _doc(value=30000.0), tmp_path)
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    assert "REGRESSION" in out.stderr
+    # Platform-mismatched comparison: exit 2, loud refusal.
+    cpu = _doc(platform="cpu", comparable=False, vs_baseline=0.0)
+    out = _run_cli(_doc(), cpu, tmp_path)
+    assert out.returncode == 2, (out.stdout, out.stderr)
+    assert "platform mismatch" in out.stderr
+    # Schema-invalid candidate: exit 2 as well.
+    bad = copy.deepcopy(_doc())
+    del bad["comparable"]
+    out = _run_cli(_doc(), bad, tmp_path)
+    assert out.returncode == 2
+
+
+def test_committed_baseline_validates_and_self_compares():
+    """The committed baseline artifact is schema-valid and the gate's
+    wiring is sound: self-comparison is trivially within any band."""
+    path = os.path.join(REPO, "artifacts", "bench_baseline.json")
+    assert os.path.exists(path), (
+        "artifacts/bench_baseline.json is missing — regenerate with "
+        "bench.py and commit (see artifacts/README.md)")
+    assert validate_bench_file(path) == []
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_compare.py"),
+         path, path], capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, (out.stdout, out.stderr)

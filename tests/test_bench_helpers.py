@@ -32,13 +32,17 @@ def test_kernel_bench_timeit_runs_and_preserves_semantics():
 
 def test_bench_emit_comparability():
     """vs_baseline must be zeroed when the measured config is not the
-    flagship config (shrunk CPU fallback) instead of inflating."""
+    flagship config (shrunk CPU fallback) OR the platform is not tpu —
+    and every line must validate as pvraft_bench/v1."""
     out = subprocess.run(
         [sys.executable, "-c", (
             "import bench; "
             "bench._emit(1000.0, {'variant': 'x'}, comparable=False); "
             "bench._emit(bench.BASELINE_PAIRS_PER_SEC_PER_CHIP, {}, "
-            "comparable=True)"
+            "comparable=True, platform='tpu'); "
+            # A CPU-fallback run at the FULL config still may not be
+            # ratioed against the TPU baseline (BENCH_r05 failure mode).
+            "bench._emit(2000.0, {'platform': 'cpu'}, comparable=True)"
         )],
         capture_output=True, text=True, cwd=REPO, timeout=60,
     )
@@ -46,4 +50,14 @@ def test_bench_emit_comparability():
     lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
     assert lines[0]["vs_baseline"] == 0.0
     assert lines[0]["value"] == 1000.0
+    assert lines[0]["platform"] == "unknown"
+    assert lines[0]["comparable"] is False
     assert abs(lines[1]["vs_baseline"] - 1.0) < 1e-6
+    assert lines[1]["comparable"] is True
+    assert lines[2]["platform"] == "cpu"
+    assert lines[2]["comparable"] is False
+    assert lines[2]["vs_baseline"] == 0.0
+    from pvraft_tpu.obs.bench import validate_bench
+
+    for doc in lines:
+        assert validate_bench(doc) == [], doc

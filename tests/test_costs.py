@@ -1,0 +1,183 @@
+"""pvraft_costs/v1 (programs/costs.py): validator red/green, the
+cost_analysis flattening, and the committed-artifact pin — full
+registry coverage both directions, the same drift discipline as
+``artifacts/programs_list.txt``."""
+
+import copy
+import json
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pvraft_tpu.programs.costs import (  # noqa: E402
+    COSTS_SCHEMA,
+    check_coverage,
+    summarize_cost_analysis,
+    validate_costs,
+    validate_costs_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "artifacts", "programs_costs.json")
+
+
+def _record(**over):
+    rec = {
+        "name": "corr.corr_init",
+        "target": "host",
+        "tags": ["audit", "op"],
+        "ok": True,
+        "lower_s": 0.1,
+        "compile_s": 0.2,
+        "flops": 64500.0,
+        "bytes_accessed": 38400.0,
+        "memory": {
+            "argument_size_in_bytes": 1024,
+            "output_size_in_bytes": 512,
+            "temp_size_in_bytes": 256,
+            "generated_code_size_in_bytes": 4096,
+            "alias_size_in_bytes": 0,
+            "live_bytes_estimate": 1792,
+            "fits_16GiB_hbm": True,
+        },
+    }
+    rec.update(over)
+    return rec
+
+
+def _doc(records=None, **over):
+    doc = {
+        "schema": COSTS_SCHEMA,
+        "topology": "v5e:2x2x1",
+        "hbm_limit_bytes": 16 * 1024 ** 3,
+        "programs": [_record()] if records is None else records,
+    }
+    doc.update(over)
+    return doc
+
+
+# --- summarize_cost_analysis ------------------------------------------------
+
+
+def test_summarize_flattens_multi_computation_lists():
+    out = summarize_cost_analysis([
+        {"flops": 100.0, "bytes accessed": 40.0, "optimal_seconds": 0.5},
+        {"flops": 23.0, "bytes accessed": 2.0},
+    ])
+    assert out == {"flops": 123.0, "bytes_accessed": 42.0,
+                   "optimal_seconds": 0.5}
+    assert summarize_cost_analysis({"flops": 7.0}) == {
+        "flops": 7.0, "bytes_accessed": 0.0}
+    assert summarize_cost_analysis([]) == {"flops": 0.0,
+                                           "bytes_accessed": 0.0}
+
+
+def test_summarize_matches_real_cpu_compile():
+    f = jax.jit(lambda a, b: a @ b)
+    compiled = f.lower(jax.ShapeDtypeStruct((8, 16), "float32"),
+                       jax.ShapeDtypeStruct((16, 4), "float32")).compile()
+    out = summarize_cost_analysis(compiled.cost_analysis())
+    assert out["flops"] > 0 and out["bytes_accessed"] > 0
+
+
+# --- validator --------------------------------------------------------------
+
+
+def test_validate_green():
+    assert validate_costs(_doc()) == []
+
+
+@pytest.mark.parametrize("mutate, fragment", [
+    (lambda d: d.update(schema="pvraft_costs/v0"), "schema"),
+    (lambda d: d.pop("topology"), "missing field 'topology'"),
+    (lambda d: d.update(programs="lots"), "must be a list"),
+    (lambda d: d["programs"][0].pop("flops"), "flops"),
+    (lambda d: d["programs"][0].update(flops=-1.0), "flops"),
+    (lambda d: d["programs"][0].update(bytes_accessed="many"),
+     "bytes_accessed"),
+    (lambda d: d["programs"][0].update(ok=False, error="boom"),
+     "not ok"),
+    (lambda d: d["programs"][0].update(target=""), "target"),
+    (lambda d: d["programs"][0].pop("memory"), "missing memory"),
+    (lambda d: d["programs"][0]["memory"].update(
+        temp_size_in_bytes=-5), "temp_size_in_bytes"),
+    (lambda d: d["programs"][0]["memory"].pop("live_bytes_estimate"),
+     "live_bytes_estimate"),
+    (lambda d: d["programs"][0]["memory"].update(fits_16GiB_hbm="yes"),
+     "fits_16GiB_hbm"),
+    (lambda d: d["programs"].append(
+        copy.deepcopy(d["programs"][0])), "duplicate"),
+])
+def test_validate_red(mutate, fragment):
+    doc = _doc()
+    mutate(doc)
+    problems = validate_costs(doc)
+    assert problems and any(fragment in p for p in problems), problems
+
+
+# --- registry coverage ------------------------------------------------------
+
+
+def _registry_specs():
+    from pvraft_tpu.programs import load_catalog, specs
+
+    load_catalog()
+    return list(specs().values())
+
+
+def test_check_coverage_both_directions():
+    specs = _registry_specs()
+    covered = [_record(name=s.name) for s in specs if not s.expect_failure]
+    doc = _doc(records=covered)
+    assert check_coverage(doc, specs) == []
+    # A missing spec is reported…
+    missing = _doc(records=covered[1:])
+    assert any(covered[0]["name"] in p
+               for p in check_coverage(missing, specs))
+    # …and so is a stale record naming no live spec.
+    stale = _doc(records=covered + [_record(name="ghost_program")])
+    assert any("ghost_program" in p and "stale" in p
+               for p in check_coverage(stale, specs))
+
+
+def test_committed_costs_artifact_pinned():
+    """THE drift pin (mirrors test_programs_list_matches_committed_
+    artifact): the committed inventory is schema-valid and covers every
+    non-expect_failure registry spec, no more, no less. Regenerate with
+    `python -m pvraft_tpu.programs costs --out
+    artifacts/programs_costs.json` (needs the libtpu toolchain; ~30 min
+    cold, much less on a warm artifacts/xla_cache)."""
+    assert os.path.exists(ARTIFACT), (
+        "artifacts/programs_costs.json is missing — regenerate (see "
+        "artifacts/README.md)")
+    assert validate_costs_file(ARTIFACT) == []
+    doc = json.load(open(ARTIFACT, encoding="utf-8"))
+    specs = _registry_specs()
+    assert check_coverage(doc, specs, path=ARTIFACT) == [], (
+        "cost inventory drifted from the program registry — regenerate "
+        "artifacts/programs_costs.json")
+    # The excluded list is exactly the expect_failure slice (documented
+    # OOM programs are compile-gate evidence, not cost records).
+    assert doc["excluded_expect_failure"] == sorted(
+        s.name for s in specs if s.expect_failure)
+    # Every topology record really came from the TPU pipeline and every
+    # audit/profile record from the host leg.
+    by_name = {r["name"]: r for r in doc["programs"]}
+    for s in specs:
+        if s.expect_failure:
+            continue
+        rec = by_name[s.name]
+        assert rec["target"] == (s.topology if s.topology else "host"), (
+            s.name)
+
+
+def test_costs_check_cli(tmp_path, capsys):
+    from pvraft_tpu.programs.__main__ import main
+
+    assert main(["costs", "--check", ARTIFACT]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_doc(records=[_record(ok=False,
+                                                    error="x")])))
+    assert main(["costs", "--check", str(bad)]) == 1

@@ -1,0 +1,138 @@
+"""Device-memory telemetry (obs/device_memory.py): row normalization,
+the no-stats CPU path, the monitor's metrics/event fan-out, and the
+Prometheus gauge — with the JSON /metrics shape untouched."""
+
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pvraft_tpu.obs.device_memory import (  # noqa: E402
+    DeviceMemoryMonitor,
+    device_memory_row,
+    sample_device_memory,
+)
+
+
+class _FakeDevice:
+    def __init__(self, device_id=0, stats=None, platform="tpu"):
+        self.id = device_id
+        self.platform = platform
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+_STATS = {"bytes_in_use": 1 << 30, "peak_bytes_in_use": 2 << 30,
+          "bytes_limit": 16 << 30, "largest_alloc_size": 123}
+
+
+def test_row_normalizes_known_keys_only():
+    row = device_memory_row(_FakeDevice(3, _STATS))
+    assert row == {"device_id": 3, "platform": "tpu",
+                   "bytes_in_use": 1 << 30,
+                   "peak_bytes_in_use": 2 << 30,
+                   "bytes_limit": 16 << 30}
+
+
+def test_row_without_stats_is_none():
+    assert device_memory_row(_FakeDevice(0, None)) is None
+    assert device_memory_row(_FakeDevice(0, {})) is None
+    # An allocator with no bytes_in_use has nothing to gauge.
+    assert device_memory_row(_FakeDevice(0, {"bytes_limit": 4096})) is None
+
+    class _Raises:
+        id = 0
+
+        def memory_stats(self):
+            raise RuntimeError("no allocator")
+
+    assert device_memory_row(_Raises()) is None
+
+
+def test_cpu_backend_samples_to_nothing():
+    # The tier-1 backend has no allocator stats: zero noise, no events.
+    assert sample_device_memory(jax.local_devices()) == []
+
+
+def test_sampled_rows_are_schema_valid(tmp_path):
+    from pvraft_tpu.obs.events import validate_events_file
+    from pvraft_tpu.serve.events import ServeTelemetry
+
+    devices = [_FakeDevice(0, _STATS), _FakeDevice(1, dict(_STATS))]
+    path = str(tmp_path / "serve.events.jsonl")
+    tel = ServeTelemetry(path, enabled=True)
+    rows = sample_device_memory(devices)
+    tel.emit_device_memory(rows, context="serve")
+    tel.close()
+    assert validate_events_file(path) == []
+    records = [json.loads(l) for l in open(path)]
+    dm = [r for r in records if r["type"] == "device_memory"]
+    assert len(dm) == 1
+    assert [d["device_id"] for d in dm[0]["devices"]] == [0, 1]
+
+
+def test_monitor_feeds_metrics_and_events(tmp_path):
+    from pvraft_tpu.obs.events import validate_events_file
+    from pvraft_tpu.serve.events import ServeTelemetry
+    from pvraft_tpu.serve.metrics import ServeMetrics
+
+    path = str(tmp_path / "serve.events.jsonl")
+    tel = ServeTelemetry(path, enabled=True)
+    metrics = ServeMetrics(buckets=(2048,))
+    mon = DeviceMemoryMonitor(
+        emit=tel.emit_device_memory, metrics=metrics, interval_s=0,
+        devices=[_FakeDevice(0, _STATS), _FakeDevice(1, _STATS)])
+    rows = mon.sample_once()
+    assert len(rows) == 2 and mon.samples == 1
+    tel.close()
+    assert validate_events_file(path) == []
+    # Prometheus gauge present with per-device labels…
+    prom = metrics.prometheus()
+    assert 'pvraft_device_hbm_bytes{device="0"} 1073741824' in prom
+    assert 'pvraft_device_hbm_bytes{device="1"} 1073741824' in prom
+    assert 'pvraft_device_hbm_peak_bytes{device="0"} 2147483648' in prom
+    # …and the frozen JSON snapshot did NOT grow a new key.
+    assert "device_memory" not in metrics.snapshot()
+
+
+def test_monitor_interval_zero_never_starts_thread():
+    mon = DeviceMemoryMonitor(interval_s=0)
+    mon.start()
+    assert mon._thread is None
+    mon.stop()                     # no-op, must not raise
+
+
+def test_monitor_cpu_emits_nothing(tmp_path):
+    from pvraft_tpu.serve.events import ServeTelemetry
+
+    path = str(tmp_path / "serve.events.jsonl")
+    tel = ServeTelemetry(path, enabled=True)
+    mon = DeviceMemoryMonitor(emit=tel.emit_device_memory,
+                              interval_s=0)  # real (CPU) local devices
+    assert mon.sample_once() == [] and mon.samples == 0
+    tel.close()
+    records = [json.loads(l) for l in open(path)]
+    assert [r["type"] for r in records] == ["run_header"]
+
+
+def test_monitor_thread_lifecycle():
+    metrics_rows = []
+
+    class _Sink:
+        def record_device_memory(self, rows):
+            metrics_rows.append(rows)
+
+    mon = DeviceMemoryMonitor(metrics=_Sink(), interval_s=0.01,
+                              devices=[_FakeDevice(0, _STATS)])
+    mon.start()
+    import time
+
+    deadline = time.monotonic() + 5.0
+    while not metrics_rows and time.monotonic() < deadline:
+        time.sleep(0.01)
+    mon.stop()
+    assert metrics_rows, "monitor thread never sampled"
+    assert mon._thread is None

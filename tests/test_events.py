@@ -80,6 +80,49 @@ def test_validate_event_enum_fields():
     assert any("action" in p for p in validate_event(bad, seq=0))
 
 
+def test_validate_recompile_events():
+    ok = _record("recompile", program="train_step", count=2, baseline=1,
+                 signature="f32[2,8,3]", context="train")
+    assert validate_event(ok, seq=0) == []
+    bad = _record("recompile", program="", count=2)
+    assert any("program" in p for p in validate_event(bad, seq=0))
+    bad = _record("recompile", program="train_step", count=-1)
+    assert any(">= 0" in p for p in validate_event(bad, seq=0))
+    bad = _record("recompile", program="train_step")
+    assert any("missing field 'count'" in p
+               for p in validate_event(bad, seq=0))
+
+
+def test_validate_device_memory_events():
+    row = {"device_id": 0, "bytes_in_use": 1024,
+           "peak_bytes_in_use": 2048, "bytes_limit": 4096,
+           "platform": "tpu"}
+    ok = _record("device_memory", devices=[row], context="serve")
+    assert validate_event(ok, seq=0) == []
+    # Negative byte counts are a writer bug, not data.
+    bad = _record("device_memory",
+                  devices=[dict(row, bytes_in_use=-1)])
+    assert any("bytes_in_use" in p and ">= 0" in p
+               for p in validate_event(bad, seq=0))
+    # Unknown device: a row whose id is not a non-negative integer.
+    for dev in (-1, "tpu:0", None, 1.5, True):
+        bad = _record("device_memory",
+                      devices=[dict(row, device_id=dev)])
+        assert any("not a known device" in p
+                   for p in validate_event(bad, seq=0)), dev
+    # Missing rows / empty list / stray fields all fail.
+    assert any("non-empty list" in p for p in validate_event(
+        _record("device_memory", devices=[]), seq=0))
+    assert any("non-empty list" in p for p in validate_event(
+        _record("device_memory", devices={"0": row}), seq=0))
+    bad = _record("device_memory", devices=[dict(row, hbm="big")])
+    assert any("unknown field 'hbm'" in p
+               for p in validate_event(bad, seq=0))
+    bad = _record("device_memory", devices=[{"device_id": 0}])
+    assert any("missing 'bytes_in_use'" in p
+               for p in validate_event(bad, seq=0))
+
+
 # --- stream-level validation ------------------------------------------------
 
 

@@ -1,0 +1,273 @@
+"""Retrace watchdog (obs/retrace.py): baseline learning, trip + event
+emission, strict mode, and the sealed serve mode under real batcher
+thread concurrency — the runtime complement of deepcheck GJ007."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pvraft_tpu.obs.retrace import (  # noqa: E402
+    RetraceError,
+    RetraceWatchdog,
+    args_signature,
+)
+
+
+def _jitted():
+    return jax.jit(lambda x: x * 2.0 + 1.0)
+
+
+def test_args_signature_renders_shapes():
+    sig = args_signature((np.zeros((2, 8, 3), np.float32),
+                          {"m": np.zeros((2, 8), np.int32)}))
+    assert sig == "float32[2,8,3],int32[2,8]"
+
+
+def test_watchdog_learns_baseline_then_trips():
+    events = []
+    dog = RetraceWatchdog(emit=lambda **kw: events.append(kw))
+    f = _jitted()
+    dog.watch("prog", f)
+    # Before any call: nothing to learn, nothing trips.
+    assert dog.check() == []
+    f(np.ones(4, np.float32))
+    # First compile IS the program (warmup) — learned, not a trip.
+    assert dog.check() == []
+    f(np.ones(4, np.float32))
+    assert dog.check() == []               # cache hit
+    f(np.ones(5, np.float32))              # silent retrace
+    trips = dog.check(signature=lambda: "float32[5]")
+    assert [t["program"] for t in trips] == ["prog"]
+    assert trips[0]["count"] == trips[0]["baseline"] + 1
+    assert events[0]["signature"] == "float32[5]"
+    assert events[0]["context"] == "train"
+    # One growth = one event: the new size is the new baseline.
+    assert dog.check() == []
+    assert dog.trips == 1
+
+
+def test_watchdog_strict_raises():
+    dog = RetraceWatchdog(strict=True)
+    f = _jitted()
+    dog.watch("prog", f)
+    f(np.ones(4, np.float32))
+    dog.check()
+    f(np.ones((2, 2), np.float32))
+    with pytest.raises(RetraceError, match="prog.*recompiled after warmup"):
+        dog.check(signature="float32[2,2]")
+
+
+def test_watchdog_event_is_schema_valid(tmp_path):
+    from pvraft_tpu.obs.events import validate_events_file
+    from pvraft_tpu.serve.events import ServeTelemetry
+
+    tel = ServeTelemetry(str(tmp_path / "serve.events.jsonl"),
+                         enabled=True)
+    dog = RetraceWatchdog(emit=tel.emit_recompile, context="serve")
+    f = _jitted()
+    dog.watch("predict_b2048_bs1", f)
+    f(np.ones(3, np.float32))
+    dog.check()
+    f(np.ones(6, np.float32))
+    assert len(dog.check(signature="float32[6]")) == 1
+    tel.close()
+    path = str(tmp_path / "serve.events.jsonl")
+    assert validate_events_file(path) == []
+    records = [json.loads(l) for l in open(path)]
+    rec = [r for r in records if r["type"] == "recompile"]
+    assert rec and rec[0]["program"] == "predict_b2048_bs1"
+    assert rec[0]["count"] == rec[0]["baseline"] + 1
+    assert rec[0]["signature"] == "float32[6]"
+
+
+def test_sealed_mode_counts_any_backend_compile():
+    dog = RetraceWatchdog(context="serve")
+    assert dog.seal()
+    try:
+        assert dog.check() == []           # nothing compiled since seal
+        _jitted()(np.ones(7, np.float32))  # a compile from anywhere
+        trips = dog.check(program="serve_dispatch_b2048")
+        assert trips and trips[0]["program"] == "serve_dispatch_b2048"
+        assert dog.check() == []           # re-baselined after the trip
+    finally:
+        dog.close()
+    # Closed: further compiles are no longer watched.
+    _jitted()(np.ones(9, np.float32))
+    assert dog.check() == []
+
+
+def test_sealed_window_one_compile_trips_once():
+    """Two concurrent dispatches that both captured their window before
+    one compile landed must report it ONCE: the first reporter's ratchet
+    disarms the second's stale window."""
+    dog = RetraceWatchdog(context="serve")
+    assert dog.seal()
+    try:
+        dog.check()                          # settle the baseline
+        window_a = dog.global_compiles()
+        window_b = dog.global_compiles()     # both in flight
+        _jitted()(np.ones(17, np.float32))   # one hidden compile
+        assert len(dog.check(window_start=window_a)) == 1
+        assert dog.check(window_start=window_b) == []
+        assert dog.trips == 1
+    finally:
+        dog.close()
+
+
+def test_sealed_window_ignores_co_resident_compiles():
+    """The serve_ab two-leg pattern: another engine compiling its own
+    startup table BETWEEN dispatches must not trip a windowed check —
+    only compiles landing inside the dispatch window do."""
+    dog = RetraceWatchdog(context="serve")
+    assert dog.seal()
+    try:
+        _jitted()(np.ones(11, np.float32))  # co-resident leg compiles
+        window = dog.global_compiles()      # dispatch begins AFTER it
+        assert dog.check(window_start=window) == []
+        # The ratchet also cleared the backlog for default checks.
+        assert dog.check() == []
+        window = dog.global_compiles()
+        _jitted()(np.ones(13, np.float32))  # compile DURING the window
+        trips = dog.check(program="serve_dispatch_b32",
+                          window_start=window)
+        assert trips and trips[0]["baseline"] == window
+    finally:
+        dog.close()
+
+
+class _RetracingEngine:
+    """Batcher double whose dispatch path hides a jit compile — the
+    exact failure --strict-retrace exists to catch (a per-request
+    compile stall on the 'AOT-only' serving path)."""
+
+    def __init__(self):
+        from types import SimpleNamespace
+
+        self.cfg = SimpleNamespace(buckets=(32,), batch_sizes=(1,),
+                                   min_points=4, coord_limit=100.0)
+        self.calls = 0
+
+    def validate_request(self, pc1, pc2):
+        return 32
+
+    def batch_size_for(self, n):
+        return 1
+
+    def predict_batch(self, requests, bucket):
+        self.calls += 1
+        if self.calls > 1:
+            # A fresh program compiles mid-serving (shape varies per
+            # call so the second dispatch really hits the backend).
+            jax.jit(lambda x: x + float(self.calls))(
+                np.ones(self.calls, np.float32))
+        return [np.zeros((pc1.shape[0], 3), np.float32)
+                for pc1, _ in requests]
+
+    def compile_report(self):
+        return []
+
+
+def _submit_and_wait(batcher, n=8, seed=0):
+    pc = np.random.default_rng(seed).uniform(-1, 1, (n, 3)).astype(
+        np.float32)
+    return batcher.submit(pc, pc + 0.1).wait(20.0)
+
+
+def test_forced_recompile_trips_strict_retrace_threaded(tmp_path):
+    """The acceptance path: a forced recompile inside the (threaded)
+    serve dispatch emits a `recompile` event, bumps the Prometheus
+    counter, and under --strict-retrace fails the request loudly."""
+    from pvraft_tpu.obs.events import validate_events_file
+    from pvraft_tpu.serve.batcher import BatcherConfig, MicroBatcher
+    from pvraft_tpu.serve.events import ServeTelemetry
+    from pvraft_tpu.serve.metrics import ServeMetrics
+
+    events_path = str(tmp_path / "serve.events.jsonl")
+    tel = ServeTelemetry(events_path, enabled=True)
+    metrics = ServeMetrics(buckets=(32,))
+    dog = RetraceWatchdog(emit=tel.emit_recompile, strict=True,
+                          context="serve")
+    engine = _RetracingEngine()
+    assert dog.seal()
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8),
+        telemetry=tel, metrics=metrics, watchdog=dog)
+    try:
+        # First dispatch: clean (no compile since seal).
+        flow = _submit_and_wait(batcher, seed=1)
+        assert flow.shape == (8, 3)
+        # Second dispatch hides a compile -> the executor's watchdog
+        # check raises and the batch fails with RetraceError.
+        with pytest.raises(RetraceError, match="recompiled after warmup"):
+            _submit_and_wait(batcher, seed=2)
+    finally:
+        batcher.shutdown(drain=True)
+        dog.close()
+        tel.close()
+    assert metrics.recompiles_total == 1
+    prom = metrics.prometheus()
+    assert "pvraft_serve_recompiles_total 1" in prom
+    assert validate_events_file(events_path) == []
+    records = [json.loads(l) for l in open(events_path)]
+    rec = [r for r in records if r["type"] == "recompile"]
+    assert len(rec) == 1
+    assert rec[0]["program"] == "serve_dispatch_b32"
+    assert rec[0]["signature"] == "bucket=32 n=1"
+    assert rec[0]["context"] == "serve"
+
+
+def test_non_strict_observes_without_failing(tmp_path):
+    """Without --strict-retrace the same forced recompile is recorded
+    (event + counter) but the request still succeeds."""
+    from pvraft_tpu.serve.batcher import BatcherConfig, MicroBatcher
+    from pvraft_tpu.serve.events import ServeTelemetry
+    from pvraft_tpu.serve.metrics import ServeMetrics
+
+    events_path = str(tmp_path / "serve.events.jsonl")
+    tel = ServeTelemetry(events_path, enabled=True)
+    metrics = ServeMetrics(buckets=(32,))
+    dog = RetraceWatchdog(emit=tel.emit_recompile, strict=False,
+                          context="serve")
+    engine = _RetracingEngine()
+    assert dog.seal()
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8),
+        telemetry=tel, metrics=metrics, watchdog=dog)
+    try:
+        assert _submit_and_wait(batcher, seed=1).shape == (8, 3)
+        assert _submit_and_wait(batcher, seed=2).shape == (8, 3)
+    finally:
+        batcher.shutdown(drain=True)
+        dog.close()
+        tel.close()
+    assert metrics.recompiles_total == 1
+    records = [json.loads(l) for l in open(events_path)]
+    assert sum(r["type"] == "recompile" for r in records) == 1
+
+
+def test_watchdog_threadsafe_check():
+    """Concurrent checks from executor-like threads never double-count
+    one growth."""
+    dog = RetraceWatchdog()
+    f = _jitted()
+    dog.watch("prog", f)
+    f(np.ones(4, np.float32))
+    dog.check()
+    f(np.ones((3, 3), np.float32))
+    trips, barrier = [], threading.Barrier(4)
+
+    def worker():
+        barrier.wait(5)
+        trips.extend(dog.check())
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert len(trips) == 1
+    assert dog.trips == 1

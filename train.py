@@ -125,6 +125,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="with --telemetry: stop after the first "
                         "divergence snapshot instead of training on "
                         "with corrupt state")
+    p.add_argument("--strict_retrace", action="store_true",
+                   help="raise when a train-loop program's jit cache "
+                        "grows after warmup (the retrace watchdog, "
+                        "pvraft_tpu/obs/retrace.py, always emits a "
+                        "`recompile` event; this makes it fatal — use "
+                        "for perf runs where a silent recompile would "
+                        "corrupt the measurement)")
     return p.parse_args(argv)
 
 
@@ -164,6 +171,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
             divergence_zscore=a.divergence_zscore,
             divergence_window=a.divergence_window,
             halt_on_divergence=a.halt_on_divergence,
+            strict_retrace=a.strict_retrace,
         ),
         parallel=ParallelConfig(data_axis=a.data_parallel, seq_axis=a.seq_parallel,
                                 packed_state=a.packed_state,
