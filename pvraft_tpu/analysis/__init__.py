@@ -1,6 +1,6 @@
 """graftlint — JAX/TPU-aware static analysis for pvraft_tpu.
 
-Two halves:
+Three analysis engines plus a contract layer:
 
   * an AST lint engine (``pvraft_tpu.analysis.engine`` +
     ``pvraft_tpu.analysis.rules``) with TPU-specific rules: host-sync
@@ -10,16 +10,33 @@ Two halves:
 
         python -m pvraft_tpu.analysis lint pvraft_tpu/ tests/
 
+  * a jaxpr-level semantic engine (``pvraft_tpu.analysis.jaxpr``,
+    ``python -m pvraft_tpu.analysis deepcheck``): GJ rules over the
+    traced programs — collective consistency, donation efficacy,
+    precision flow, retrace hazards.
+
+  * a concurrency engine (``pvraft_tpu.analysis.concurrency``,
+    ``python -m pvraft_tpu.analysis concurrency``): GC rules over the
+    hand-threaded serve/obs/loader planes — guarded-by discipline,
+    lock-order cycles, check-then-act/TOCTOU shapes, un-joined threads
+    — plus the opt-in ``OrderedLock`` runtime lock-order sanitizer.
+
   * a shape/dtype contract layer (``pvraft_tpu.analysis.contracts``):
     the ``@shapecheck`` decorator on the package's public ops — a no-op
     unless ``PVRAFT_CHECKS=1`` — plus a ``jax.eval_shape`` trace-compat
     audit (``python -m pvraft_tpu.analysis trace``) that abstractly
     traces every registered op without running a FLOP.
 
-This package deliberately does NOT import jax at lint time: ``engine``
-and ``rules`` are pure stdlib-``ast`` code so the linter runs in
-milliseconds anywhere; only ``contracts``/``audit`` (imported lazily by
-the ``trace`` subcommand and by decorated modules) touch jax.
+All three engines share ONE ``Diagnostic`` type and ONE
+``# graftlint: disable=RULE -- reason`` pragma grammar, so the
+suppression-debt report (``lint --stats``) enumerates every engine's
+blind spots with no second parser.
+
+This package deliberately does NOT import jax at lint time: ``engine``,
+``rules`` and ``concurrency`` are pure stdlib-``ast`` code so the
+linters run in milliseconds anywhere; only ``contracts``/``audit``
+(imported lazily by the ``trace`` subcommand and by decorated modules)
+touch jax.
 """
 
 from pvraft_tpu.analysis.engine import (  # noqa: F401

@@ -1,4 +1,4 @@
-"""CLI: ``python -m pvraft_tpu.analysis {lint,trace,deepcheck} ...``.
+"""CLI: ``python -m pvraft_tpu.analysis {lint,trace,deepcheck,concurrency}``.
 
 ``lint`` is pure stdlib-AST and never initializes a jax backend
 (``--stats`` prints the suppression-debt report instead of findings).
@@ -8,6 +8,10 @@ concretization / shape errors a TPU run would hit at compile time.
 ``deepcheck`` traces the same registry to ClosedJaxprs and runs the
 GJ001+ semantic rules: collective consistency, donation efficacy,
 precision flow, retrace hazards.
+``concurrency`` (threadcheck) runs the GC001+ rules — guarded-by
+discipline, lock-order cycles, check-then-act/TOCTOU shapes, un-joined
+threads — over the hand-threaded planes (default scope ``serve/``,
+``obs/``, ``data/loader.py``); pure stdlib-AST like ``lint``.
 """
 
 from __future__ import annotations
@@ -119,6 +123,28 @@ def _cmd_deepcheck(args) -> int:
     return 0 if report.ok else 1
 
 
+def _cmd_concurrency(args) -> int:
+    from pvraft_tpu.analysis.concurrency.check import (
+        check_paths,
+        default_scope,
+    )
+    from pvraft_tpu.analysis.concurrency.rules import all_concurrency_rules
+
+    if args.list_rules:
+        for rule in all_concurrency_rules():
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id}  {rule.title:<28} {doc}")
+        return 0
+    paths = args.paths or list(default_scope())
+    select = tuple(args.select.split(",")) if args.select else ()
+    diags, nfiles = check_paths(paths, rule_ids=select)
+    for d in diags:
+        print(d.format())
+    print(f"threadcheck: {len(diags)} finding(s) in {nfiles} file(s)",
+          file=sys.stderr)
+    return 1 if diags else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pvraft_tpu.analysis",
@@ -160,6 +186,20 @@ def main(argv=None) -> int:
                         help="per-entry program stats (eqn/collective "
                              "counts, precision-flow map)")
     p_deep.set_defaults(fn=_cmd_deepcheck)
+
+    p_conc = sub.add_parser(
+        "concurrency",
+        help="threadcheck: concurrency static analysis (GC rules) over "
+             "the hand-threaded serve/obs/loader planes",
+    )
+    p_conc.add_argument("paths", nargs="*",
+                        help="files/directories to check (default: the "
+                             "serve/, obs/, data/loader.py scope)")
+    p_conc.add_argument("--list-rules", action="store_true",
+                        help="print the GC rule table and exit")
+    p_conc.add_argument("--select", default="",
+                        help="comma-separated GC rule ids (default all)")
+    p_conc.set_defaults(fn=_cmd_concurrency)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,41 @@
+"""threadcheck — concurrency analysis for the hand-threaded planes.
+
+The serving/observability planes are ~5k LoC of hand-threaded Python
+(collector/executor/monitor threads, 10+ locks, bounded queues) where
+every known race so far was found by ad-hoc manual review (CHANGES.md
+PRs 5/8/9). This package encodes that review checklist as the repo's
+THIRD analysis engine, beside the AST lint (``analysis.rules``) and the
+jaxpr deepcheck (``analysis.jaxpr``):
+
+  * a **static half** (``model.py`` + ``rules.py`` + ``check.py``):
+    a guarded-by model — ``# guarded-by: <lock>`` field annotations
+    plus AST inference from ``with self._lock:`` bodies — feeding rules
+    GC001+ (guarded attributes accessed outside their lock, lock-order
+    cycles, check-then-act/TOCTOU shapes, un-joined non-daemon
+    threads), run as ``python -m pvraft_tpu.analysis concurrency`` over
+    ``serve/``, ``obs/`` and ``data/loader.py``;
+
+  * a **dynamic half** (``sanitizer.py``): an instrumented
+    :class:`OrderedLock` that records each thread's acquisition stack
+    and raises on lock-order inversions. Opt-in via ``PVRAFT_CHECKS=1``
+    exactly like ``@shapecheck`` — the serve/obs locks are built
+    through :func:`ordered_lock`, so the existing threaded tier-1 tests
+    double as a runtime lock-order sanitizer run when checks are on,
+    and cost a plain ``threading.Lock`` when they are off.
+
+Diagnostics reuse :class:`pvraft_tpu.analysis.engine.Diagnostic` and
+the one ``# graftlint: disable=GCxxx -- reason`` pragma grammar, so the
+suppression-debt report (``lint --stats``) counts GC blind spots with
+no second parser. Like the AST lint, the static half never imports jax.
+"""
+
+from pvraft_tpu.analysis.concurrency.check import (  # noqa: F401
+    DEFAULT_SCOPE,
+    check_paths,
+    check_source,
+)
+from pvraft_tpu.analysis.concurrency.sanitizer import (  # noqa: F401
+    LockOrderError,
+    OrderedLock,
+    ordered_lock,
+)

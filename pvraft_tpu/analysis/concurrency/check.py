@@ -1,0 +1,84 @@
+"""Concurrency check driver: files -> model -> GC rules -> diagnostics.
+
+Mirrors ``engine.lint_paths`` deliberately: same ``Diagnostic`` type,
+same ``# graftlint: disable=GCxxx -- reason`` suppression grammar (one
+parser — what ``lint --stats`` counts is exactly what is honored here),
+same stable ordering. Scope defaults to the hand-threaded planes the
+rules were written for: ``serve/``, ``obs/`` and ``data/loader.py``
+(``DEFAULT_SCOPE``), resolved relative to the installed package so
+``python -m pvraft_tpu.analysis concurrency`` works from any cwd.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Sequence, Tuple
+
+from pvraft_tpu.analysis.engine import (
+    Diagnostic,
+    _expand_decorated_regions,
+    _suppressed,
+    _suppressions,
+    iter_py_files,
+)
+from pvraft_tpu.analysis.concurrency.model import build_module_model
+from pvraft_tpu.analysis.concurrency.rules import (
+    ConcurrencyContext,
+    all_concurrency_rules,
+)
+
+
+def default_scope() -> Tuple[str, ...]:
+    """The gate's scan scope, as absolute paths of this checkout."""
+    import pvraft_tpu
+
+    pkg = os.path.dirname(os.path.abspath(pvraft_tpu.__file__))
+    return (
+        os.path.join(pkg, "serve"),
+        os.path.join(pkg, "obs"),
+        os.path.join(pkg, "data", "loader.py"),
+    )
+
+
+# Spelled as a constant for docs/tests; resolved lazily by the CLI so
+# importing this module never imports the full package tree.
+DEFAULT_SCOPE = ("pvraft_tpu/serve", "pvraft_tpu/obs",
+                 "pvraft_tpu/data/loader.py")
+
+
+def check_source(source: str, path: str = "<string>",
+                 rule_ids: Sequence[str] = ()) -> List[Diagnostic]:
+    """Run the GC rules over one source string (suppressions applied)."""
+    source = source.lstrip("\ufeff")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic(path, e.lineno or 1, e.offset or 0, "GC000",
+                           f"syntax error: {e.msg}")]
+    model = build_module_model(tree, source, path)
+    ctx = ConcurrencyContext(path, source, tree, model)
+    per_line, file_ids = _suppressions(source)
+    _expand_decorated_regions(tree, per_line)
+    out: List[Diagnostic] = []
+    for rule_cls in all_concurrency_rules():
+        if rule_ids and rule_cls.id not in rule_ids:
+            continue
+        for d in rule_cls().check(ctx):
+            if not _suppressed(d, per_line, file_ids):
+                out.append(d)
+    out.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return out
+
+
+def check_paths(paths: Sequence[str], rule_ids: Sequence[str] = ()
+                ) -> Tuple[List[Diagnostic], int]:
+    """Check files/directories. Returns (diagnostics, files_checked)."""
+    out: List[Diagnostic] = []
+    n = 0
+    for f in iter_py_files(paths):
+        n += 1
+        with open(f, "r", encoding="utf-8-sig") as fh:
+            out.extend(check_source(fh.read(), path=f, rule_ids=rule_ids))
+    out.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return out, n

@@ -1,0 +1,173 @@
+"""Runtime lock-order sanitizer: the dynamic half of threadcheck.
+
+:class:`OrderedLock` wraps ``threading.Lock`` with a per-thread
+acquisition stack and a process-wide order graph: the first time lock B
+is acquired while A is held, the edge A->B is recorded with its call
+site; a later attempt to acquire A while B is held is an order
+inversion — the exact shape that deadlocks under the right interleaving
+— and raises :class:`LockOrderError` naming both sites *before*
+blocking on the lock (a sanitizer that deadlocks while reporting a
+deadlock would be satire). Recursive acquisition of the same
+non-reentrant lock by one thread (guaranteed self-deadlock) raises too.
+
+Opt-in mirrors ``@shapecheck`` (``analysis/contracts.py``): the
+:func:`ordered_lock` factory returns a plain ``threading.Lock`` unless
+``PVRAFT_CHECKS=1``, so production/serving pays zero overhead — no
+wrapper object, no indirection — while any test run with checks on
+turns every adopted serve/obs lock into a sanitizer probe. The threaded
+tier-1 tests (batcher no-HOL, pool, retrace, drain races) thereby
+double as a lock-order sanitizer pass:
+
+    PVRAFT_CHECKS=1 python -m pytest tests/test_serve.py tests/test_serve_pool.py
+
+Non-blocking acquires (``blocking=False``) neither raise on inversion
+nor record an order edge for the lock being try-acquired: a trylock
+cannot wait, so it cannot complete a deadlock cycle — constraining the
+opposite (blocking) order on its account would flag deadlock-free code.
+A trylock-HELD lock still constrains later blocking acquires normally:
+the held stack does not care how a lock was won.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from pvraft_tpu.analysis.contracts import checks_enabled
+
+
+class LockOrderError(RuntimeError):
+    """Two locks acquired in opposite orders by different code paths
+    (deadlock-prone), or one non-reentrant lock acquired recursively
+    (deadlock-certain)."""
+
+
+# Process-wide order graph: (held_name, acquired_name) -> first-seen
+# call site. One plain lock guards it — the graph lock is leaf-only
+# (nothing is acquired under it), so it cannot itself invert.
+_GRAPH_LOCK = threading.Lock()
+_EDGES: Dict[Tuple[str, str], str] = {}
+
+_HELD = threading.local()
+
+
+def _held_stack() -> List["OrderedLock"]:
+    stack = getattr(_HELD, "stack", None)
+    if stack is None:
+        stack = _HELD.stack = []
+    return stack
+
+
+def _call_site() -> str:
+    """The acquiring frame outside this module — what the error report
+    and the order graph anchor to."""
+    for frame in reversed(traceback.extract_stack(limit=16)):
+        if not frame.filename.endswith("sanitizer.py"):
+            return f"{frame.filename}:{frame.lineno} in {frame.name}"
+    return "<unknown>"
+
+
+def order_edges() -> Dict[Tuple[str, str], str]:
+    """Snapshot of the observed acquisition-order graph (tests assert
+    on it; the keys read "held -> acquired")."""
+    with _GRAPH_LOCK:
+        return dict(_EDGES)
+
+
+def reset_order_graph() -> None:
+    """Forget every recorded edge (test isolation only — a live process
+    must keep its history, or an inversion across test phases hides)."""
+    with _GRAPH_LOCK:
+        _EDGES.clear()
+
+
+class OrderedLock:
+    """``threading.Lock`` with acquisition-order recording.
+
+    Drop-in for the subset of the Lock API this codebase uses:
+    ``with``-statement, ``acquire(blocking=, timeout=)``, ``release()``,
+    ``locked()``. ``name`` should be globally descriptive
+    (``"MicroBatcher._count_lock"``) — the order graph and error
+    messages are keyed on it, and two instances sharing a name share an
+    order node (what you want for per-instance locks of the same class).
+    """
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+
+    def _check_order(self, blocking: bool) -> None:
+        stack = _held_stack()
+        if not stack:
+            return
+        if any(h is self for h in stack):
+            raise LockOrderError(
+                f"recursive acquisition of non-reentrant lock "
+                f"{self.name!r} at {_call_site()} — this thread already "
+                f"holds it (guaranteed self-deadlock)")
+        if not blocking:
+            # A trylock never waits: it can neither complete a deadlock
+            # cycle itself nor justify failing the opposite blocking
+            # order — no raise, no recorded edge. (Locks it WON stay on
+            # the held stack and constrain later blocking acquires.)
+            return
+        site = _call_site()
+        with _GRAPH_LOCK:
+            for held in stack:
+                if held.name == self.name:
+                    # Same-name, different-object nesting (two instances
+                    # of one class): a real order exists but the name
+                    # graph cannot express it without a self-loop; skip
+                    # rather than lie.
+                    continue
+                inverse = _EDGES.get((self.name, held.name))
+                if inverse is not None:
+                    raise LockOrderError(
+                        f"lock-order inversion: acquiring {self.name!r} "
+                        f"while holding {held.name!r} at {site}, but the "
+                        f"opposite order ({self.name!r} -> {held.name!r}) "
+                        f"was taken at {inverse} — two threads running "
+                        f"these paths concurrently deadlock")
+                _EDGES.setdefault((held.name, self.name), site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._check_order(blocking)
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _held_stack().append(self)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.release()
+        return None
+
+    def __repr__(self) -> str:
+        return f"OrderedLock({self.name!r})"
+
+
+def ordered_lock(name: str):
+    """The adoption point: a plain ``threading.Lock`` when checks are
+    off (zero overhead — the production path), an :class:`OrderedLock`
+    under ``PVRAFT_CHECKS=1``. Evaluated per call, so a lock built
+    inside a test that sets the env var is instrumented even though the
+    module imported earlier."""
+    if checks_enabled():
+        return OrderedLock(name)
+    return threading.Lock()

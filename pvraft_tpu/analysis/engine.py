@@ -340,12 +340,24 @@ def collect_suppressions(paths: Sequence[str]) -> List[Pragma]:
 
 
 def known_rule_ids() -> Set[str]:
-    """Ids of every registered rule, AST (GL) and jaxpr (GJ) families."""
+    """Ids of every registered rule: AST (GL), jaxpr (GJ) and
+    concurrency (GC) families — one namespace for the shared pragma
+    grammar, so ``lint --stats`` counts every engine's suppressions and
+    flags none of them as unknown."""
     ids = {r.id for r in all_rules()}
     try:
         from pvraft_tpu.analysis.jaxpr.rules import all_jaxpr_rules
 
         ids |= {r.id for r in all_jaxpr_rules()}
+    except ImportError:  # pragma: no cover - partial checkouts only
+        pass
+    try:
+        from pvraft_tpu.analysis.concurrency.rules import (
+            all_concurrency_rules,
+        )
+
+        ids |= {r.id for r in all_concurrency_rules()}
+        ids.add("GC000")  # the checker's syntax-error diagnostic
     except ImportError:  # pragma: no cover - partial checkouts only
         pass
     return ids

@@ -209,6 +209,7 @@ class PrefetchLoader:
         done: "dict[int, Item]" = {}
         done_lock = threading.Condition()
         errors: list[BaseException] = []
+        stop = False  # guarded-by done_lock; True once the epoch ends
 
         for rank, _ in enumerate(starts):
             todo.put(rank)
@@ -231,9 +232,17 @@ class PrefetchLoader:
                     return
                 with done_lock:
                     # Bounded prefetch: stall if we're too far ahead of the
-                    # consumer (next_rank tracked via popped entries).
-                    while rank - min(done.keys(), default=rank) > self.prefetch + self.num_workers:
+                    # consumer (next_rank tracked via popped entries). The
+                    # stop flag breaks the stall when the consumer abandons
+                    # the generator mid-epoch — without it a worker parked
+                    # here re-armed its 0.5 s wait forever (one leaked
+                    # spinning thread per abandoned epoch; threadcheck
+                    # daemon-spawn sweep).
+                    while (not stop and rank - min(done.keys(), default=rank)
+                           > self.prefetch + self.num_workers):
                         done_lock.wait(timeout=0.5)
+                    if stop:
+                        return
                     done[rank] = batch
                     done_lock.notify_all()
 
@@ -255,7 +264,15 @@ class PrefetchLoader:
                     done_lock.notify_all()
                 yield batch
         finally:
-            # Drain the work queue so threads exit promptly.
+            # Shut the pool down whether the epoch completed or the
+            # consumer walked away: wake stalled workers, drain the work
+            # queue, re-post the exit sentinels, and join. The join has a
+            # bounded timeout (a worker can be mid-collate inside numpy
+            # IO); any straggler is a daemon and exits at its next
+            # sentinel/stop check instead of spinning.
+            with done_lock:
+                stop = True
+                done_lock.notify_all()
             try:
                 while True:
                     todo.get_nowait()
@@ -263,3 +280,5 @@ class PrefetchLoader:
                 pass
             for _ in workers:
                 todo.put(None)
+            for t in workers:
+                t.join(timeout=5.0)

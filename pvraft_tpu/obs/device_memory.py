@@ -27,6 +27,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
+
 # memory_stats() key -> schema key (first match wins; runtimes disagree
 # on spelling).
 _STAT_KEYS = (
@@ -97,7 +99,13 @@ class DeviceMemoryMonitor:
         self.context = context
         self.samples = 0
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        # Lifecycle lock (threadcheck GC003): start/stop are a classic
+        # test-then-assign pair on _thread — two concurrent callers both
+        # passing the `_thread is None` check would double-start the
+        # sampler (or stop() would join a thread start() already
+        # replaced). The whole transition runs under one lock.
+        self._state_lock = ordered_lock("DeviceMemoryMonitor._state_lock")
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _state_lock
 
     def sample_once(self) -> List[Dict[str, Any]]:
         rows = sample_device_memory(self.devices)
@@ -110,14 +118,15 @@ class DeviceMemoryMonitor:
         return rows
 
     def start(self) -> None:
-        if self.interval_s <= 0 or self._thread is not None:
-            return
-        self._stop.clear()  # restartable: stop() leaves the flag set
-        # First sample happens on the thread (jax device probing can
-        # block briefly; startup must not).
-        self._thread = threading.Thread(
-            target=self._run, name="pvraft-devmem", daemon=True)
-        self._thread.start()
+        with self._state_lock:
+            if self.interval_s <= 0 or self._thread is not None:
+                return
+            self._stop.clear()  # restartable: stop() leaves the flag set
+            # First sample happens on the thread (jax device probing can
+            # block briefly; startup must not).
+            self._thread = threading.Thread(
+                target=self._run, name="pvraft-devmem", daemon=True)
+            self._thread.start()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -128,11 +137,17 @@ class DeviceMemoryMonitor:
             self._stop.wait(self.interval_s)
 
     def stop(self) -> None:
-        if self._thread is None:
-            return
-        self._stop.set()
-        self._thread.join(5.0)
-        self._thread = None
+        # Join under the lifecycle lock: the sampler thread never takes
+        # it, so this cannot deadlock — it only serializes a concurrent
+        # start(), which must not spin up a replacement thread until the
+        # old one is confirmed dead (and must then see _stop cleared).
+        with self._state_lock:
+            thread = self._thread
+            if thread is None:
+                return
+            self._thread = None
+            self._stop.set()
+            thread.join(5.0)
         try:
             self.sample_once()  # final watermark
         except Exception:  # noqa: BLE001 — shutdown must complete

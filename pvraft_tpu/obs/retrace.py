@@ -31,9 +31,9 @@ host-side only, no jaxpr anywhere changes (the
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
 from pvraft_tpu.compat import (
     jit_cache_size,
     register_compile_listener,
@@ -78,15 +78,15 @@ class RetraceWatchdog:
         self.emit = emit
         self.strict = strict
         self.context = context
-        self.trips = 0
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("RetraceWatchdog._lock")
+        self.trips = 0  # guarded-by: _lock
         # name -> [jitted, baseline or None]; baseline None = warmup not
         # seen yet (the program's first cache entry is legitimate).
-        self._watched: Dict[str, List[Any]] = {}
-        self._sealed = False
-        self._global_compiles = 0
-        self._global_baseline = 0
-        self._listener = None
+        self._watched: Dict[str, List[Any]] = {}  # guarded-by: _lock
+        self._sealed = False  # guarded-by: _lock
+        self._global_compiles = 0  # guarded-by: _lock
+        self._global_baseline = 0  # guarded-by: _lock
+        self._listener = None  # guarded-by: _lock
 
     # ---------------------------------------------------------- watching --
 
@@ -111,19 +111,24 @@ class RetraceWatchdog:
 
         if not register_compile_listener(on_event):
             return False
-        self._listener = on_event
         with self._lock:
+            self._listener = on_event
             self._sealed = True
             self._global_baseline = self._global_compiles
         return True
 
     def close(self) -> None:
-        """Unhook the global listener (tests arm/disarm repeatedly)."""
-        if self._listener is not None:
-            unregister_compile_listener(self._listener)
-            self._listener = None
+        """Unhook the global listener (tests arm/disarm repeatedly).
+        The swap runs under the lock (threadcheck GC003: the old
+        test-then-assign let two concurrent closers both see the same
+        listener and double-unregister it); the jax-side unregister call
+        happens after release — it takes jax's own monitoring lock, and
+        holding ours across a foreign lock is how order cycles start."""
         with self._lock:
+            listener, self._listener = self._listener, None
             self._sealed = False
+        if listener is not None:
+            unregister_compile_listener(listener)
 
     def global_compiles(self) -> int:
         """Current process-wide compile count (sealed mode). Dispatchers

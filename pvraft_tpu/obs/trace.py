@@ -40,10 +40,11 @@ validate-trace``).
 
 from __future__ import annotations
 
-import threading
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
 
 TRACE_SCHEMA = "pvraft_trace/v1"
 
@@ -163,8 +164,8 @@ class Tracer:
             raise ValueError("sample_every must be >= 0 (0 disables)")
         self.sample_every = int(sample_every)
         self.emit = emit
-        self._n = 0
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("Tracer._lock")
+        self._n = 0  # guarded-by: _lock
 
     @property
     def enabled(self) -> bool:

@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
 from pvraft_tpu.serve.engine import InferenceEngine, RequestError
 
 
@@ -171,20 +172,25 @@ class MicroBatcher:
         # check, lose the CPU while shutdown joins the workers AND runs
         # its sweep, then enqueue into a queue nobody will ever read —
         # stranding an accepted request (504/hang instead of 503).
-        self._intake_lock = threading.Lock()
+        # ordered_lock: under PVRAFT_CHECKS=1 the lock-order sanitizer
+        # records every acquisition (threadcheck's dynamic half); plain
+        # threading.Lock otherwise.
+        self._intake_lock = ordered_lock("MicroBatcher._intake_lock")
         self._drain = True
-        self._served = 0
-        self._rejected = 0
-        self._drained = 0
-        # Pool occupancy + per-replica accounting, all under _count_lock:
+        # Pool occupancy + per-replica accounting, all under _count_lock
+        # (the `# guarded-by:` annotations are machine-checked by
+        # threadcheck GC001 — an access outside the lock fails lint.sh):
         # _busy = executors currently inside predict (the eager-dispatch
         # idleness signal); per-replica in-flight requests and
         # served-batch counters feed /healthz and Prometheus.
-        self._busy = 0
-        self._replica_inflight = [0] * len(self.replicas)
-        self._replica_batches = [0] * len(self.replicas)
-        self._collectors_live = len(engine.cfg.buckets)
-        self._count_lock = threading.Lock()
+        self._count_lock = ordered_lock("MicroBatcher._count_lock")
+        self._served = 0    # guarded-by: _count_lock
+        self._rejected = 0  # guarded-by: _count_lock
+        self._drained = 0   # guarded-by: _count_lock
+        self._busy = 0      # guarded-by: _count_lock
+        self._replica_inflight = [0] * len(self.replicas)  # guarded-by: _count_lock
+        self._replica_batches = [0] * len(self.replicas)   # guarded-by: _count_lock
+        self._collectors_live = len(engine.cfg.buckets)    # guarded-by: _count_lock
         self._collectors = [
             threading.Thread(target=self._collector, args=(b,),
                              name=f"pvraft-serve-b{b}", daemon=True)

@@ -16,9 +16,9 @@ strictly sequential or the file fails its own validator.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
 
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
 from pvraft_tpu.obs.events import EventLog, run_metadata
 
 
@@ -27,8 +27,10 @@ class ServeTelemetry:
 
     def __init__(self, events_path: str, cfg=None,
                  enabled: Optional[bool] = None):
-        self._lock = threading.Lock()
-        self.events = EventLog(events_path, enabled=enabled)
+        self._lock = ordered_lock("ServeTelemetry._lock")
+        # EventLog.seq must stay strictly sequential: every emit after
+        # the construction-time run_header goes through _lock.
+        self.events = EventLog(events_path, enabled=enabled)  # guarded-by: _lock
         self.events.emit("run_header", **run_metadata(cfg, mode="serve"))
 
     def emit_compile(self, bucket: int, batch: int, lower_s: float,

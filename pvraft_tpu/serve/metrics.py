@@ -22,8 +22,9 @@ Two exposition formats off the same store:
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
 
 # Fixed histogram edges (ms): latency falls in the first bucket whose
 # edge is >= the sample; the final bucket is unbounded.
@@ -94,31 +95,41 @@ class ServeMetrics:
     """All serve counters behind one lock."""
 
     def __init__(self, buckets):
-        self._lock = threading.Lock()
-        self.requests_total = 0
-        self.responses_total = 0
+        # Every field below is guarded-by _lock (machine-checked:
+        # threadcheck GC001 flags any access outside it). External
+        # readers go through snapshot()/prometheus(), never the fields.
+        self._lock = ordered_lock("ServeMetrics._lock")
+        self.requests_total = 0   # guarded-by: _lock
+        self.responses_total = 0  # guarded-by: _lock
         # Accepted requests whose outcome is not yet recorded. Updated
         # under the same lock as every counter, so the reconciliation
         # identity `requests_total == responses_total + sum(rejected) +
         # in_flight` holds at EVERY snapshot, not just at quiescence.
         # Prometheus/healthz-only (the JSON snapshot shape is frozen).
-        self.in_flight = 0
-        self.rejected: Dict[str, int] = {}
-        self.batches_total = 0
-        self.batch_fill_sum = 0.0
-        self.per_bucket_requests: Dict[int, int] = {int(b): 0
+        self.in_flight = 0  # guarded-by: _lock
+        self.rejected: Dict[str, int] = {}  # guarded-by: _lock
+        self.batches_total = 0    # guarded-by: _lock
+        self.batch_fill_sum = 0.0  # guarded-by: _lock
+        self.per_bucket_requests: Dict[int, int] = {int(b): 0  # guarded-by: _lock
                                                     for b in buckets}
-        self.latency = LatencyHistogram()
+        self.latency = LatencyHistogram()  # guarded-by: _lock
         # Prometheus-only series (the JSON snapshot's shape is frozen):
         # live request sizes (points per cloud) + per-(bucket, stage)
         # latency fed from traced requests (obs/trace.py).
-        self.request_points = LatencyHistogram(edges=POINT_EDGES)
-        self.stage_latency: Dict[Tuple[int, str], LatencyHistogram] = {}
+        self.request_points = LatencyHistogram(edges=POINT_EDGES)  # guarded-by: _lock
+        self.stage_latency: Dict[Tuple[int, str], LatencyHistogram] = {}  # guarded-by: _lock
         # Latest device-memory sample rows (obs/device_memory.py) and
         # the recompile-trip counter (obs/retrace.py) — both
         # Prometheus-only, fed by the serve pool's monitor/watchdog.
-        self.device_memory: List[Dict[str, Any]] = []
-        self.recompiles_total = 0
+        self.device_memory: List[Dict[str, Any]] = []  # guarded-by: _lock
+        self.recompiles_total = 0  # guarded-by: _lock
+
+    def current_in_flight(self) -> int:
+        """Locked read of the in-flight gauge for external surfaces
+        (/healthz): the fields themselves are guarded-by _lock and must
+        not be read bare from other modules."""
+        with self._lock:
+            return self.in_flight
 
     def record_submit(self, bucket: int,
                       n_points: Optional[int] = None) -> None:

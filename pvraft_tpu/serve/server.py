@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
 from pvraft_tpu.obs.trace import Tracer
 from pvraft_tpu.serve.batcher import (
     BatcherConfig,
@@ -69,8 +70,10 @@ JSON_CT = "application/json"
 
 # jax.profiler supports ONE active trace per process, so /debug/trace
 # captures serialize process-wide — even across multiple embedded
-# ServeHTTPServer instances (the loadgen/test pattern).
-_DEBUG_TRACE_LOCK = threading.Lock()
+# ServeHTTPServer instances (the loadgen/test pattern). Acquired
+# non-blocking only (409 while busy), so it can never complete a
+# deadlock cycle; ordered_lock still records it under PVRAFT_CHECKS=1.
+_DEBUG_TRACE_LOCK = ordered_lock("serve.server._DEBUG_TRACE_LOCK")
 
 
 def _decode_json(body: bytes) -> Tuple[np.ndarray, np.ndarray]:
@@ -165,7 +168,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # Per-replica visibility (ISSUE 9 satellite): device id,
                 # in-flight count, served-batch counter per replica.
                 "replicas": self.batcher.replica_stats(),
-                "in_flight": (self.metrics.in_flight
+                "in_flight": (self.metrics.current_in_flight()
                               if self.metrics is not None else None),
                 "programs": self.batcher.engine.compile_report(),
                 "telemetry": {

@@ -12,9 +12,20 @@ python -m pvraft_tpu.analysis lint pvraft_tpu/ tests/ scripts/
 
 echo "== graftlint: suppression-debt report (reason-less pragmas fail)"
 # The gate's blind spots, enumerated: per-rule counts of active
-# `graftlint: disable` pragmas; any suppression without a `-- reason`
-# exits non-zero.
+# `graftlint: disable` pragmas (GL + GJ + GC — one shared grammar); any
+# suppression without a `-- reason` exits non-zero.
 python -m pvraft_tpu.analysis lint --stats pvraft_tpu/ tests/ scripts/
+
+echo "== threadcheck: concurrency static analysis (GC rules) over serve/obs/loader"
+# The third analysis engine (ISSUE 11): guarded-by discipline (GC001),
+# lock-order cycles (GC002), check-then-act/TOCTOU shapes (GC003) and
+# un-joined non-daemon threads (GC004) over the hand-threaded planes.
+# Zero findings on the clean tree — real violations get fixed (the
+# deepcheck precedent), not pragma'd. Pure stdlib AST, no jax import.
+# The dynamic half is opt-in at test time: PVRAFT_CHECKS=1 turns the
+# serve/obs locks into OrderedLocks, so the threaded tier-1 tests
+# double as a runtime lock-order sanitizer run.
+python -m pvraft_tpu.analysis concurrency
 
 # 8 virtual CPU devices (appended to any caller-set XLA_FLAGS) so the
 # ring audit entries trace with a REAL 2-shard seq axis — the programs

@@ -1,0 +1,31 @@
+"""The sixth review-found race: the PR 5 404 keep-alive desync.
+
+A POST to an unknown path was answered 404 with the request body left
+unread; a pooled HTTP/1.1 client reusing the connection then had the
+stale body parsed as its next request line — every subsequent request
+on that connection failed in confusing ways.
+
+This one is OUT OF STATIC REACH for the GC rules on purpose: the shared
+mutable state is the socket stream's read cursor, a protocol-level
+invariant ("answer only after consuming the body, or close") that no
+lock discipline expresses. It is pinned dynamically instead: the
+raw-socket keep-alive tests in ``tests/test_serve.py`` drive the
+404-then-reuse sequence against the real server (the in-tree fix sets
+``close_connection`` before replying — ``serve/server.py``).
+
+The class below is the distilled FIXED shape, kept here so the corpus
+enumerates all six races; ``tests/test_threadcheck.py`` asserts it
+checks clean and documents why there is no red twin.
+"""
+
+
+class Connection:
+    def __init__(self, stream):
+        self.stream = stream
+        self.close_connection = False
+
+    def respond_404(self, content_length):
+        # The body is left unread: a reused keep-alive connection would
+        # parse it as the next request line, so close.
+        self.close_connection = True
+        return b"HTTP/1.1 404 Not Found\r\nConnection: close\r\n\r\n"

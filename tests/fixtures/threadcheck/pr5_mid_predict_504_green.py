@@ -1,0 +1,37 @@
+"""CURRENT shape of the PR 5 mid-predict 504 accounting (clean).
+
+Exactly ONE party records the request's outcome: whoever wins the
+non-blocking finalize token does the ledger write under the lock, the
+loser records nothing — the in-tree fix (``serve/batcher.py``
+``_Request.finalize`` + ``record_failure_for``).
+"""
+
+import threading
+
+
+class Dispatch:
+    def __init__(self):
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._final = threading.Lock()  # outcome token (try-acquire)
+        self._served = 0    # guarded-by: _lock
+        self._timeouts = 0  # guarded-by: _lock
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def wait(self, timeout):
+        if not self._done.wait(timeout):
+            if self._final.acquire(blocking=False):
+                with self._lock:
+                    self._timeouts += 1
+            return False
+        return True
+
+    def _run(self):
+        if self._final.acquire(blocking=False):
+            with self._lock:
+                self._served += 1
+        self._done.set()
+
+    def shutdown(self):
+        self._worker.join(timeout=5.0)

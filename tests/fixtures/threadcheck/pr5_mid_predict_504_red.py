@@ -1,0 +1,35 @@
+"""PRE-fix shape of the PR 5 mid-predict 504 race (detected: GC003).
+
+A waiter that timed out while the engine was mid-predict checked the
+done flag and recorded a timeout; the dispatch loop, resolving in the
+same instant, recorded a response for the same request. Both ledger
+writes landed — the served count lied and the in-flight gauge skewed
+permanently.
+"""
+
+import threading
+
+
+class Dispatch:
+    def __init__(self):
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._served = 0    # guarded-by: _lock
+        self._timeouts = 0  # guarded-by: _lock
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def wait(self, timeout):
+        self._done.wait(timeout)
+        if not self._done.is_set():   # check: "not finished"...
+            self._timeouts += 1       # ...but the worker can resolve and
+            return False              # count a response concurrently
+        return True
+
+    def _run(self):
+        with self._lock:
+            self._served += 1
+        self._done.set()
+
+    def shutdown(self):
+        self._worker.join(timeout=5.0)

@@ -1,0 +1,34 @@
+"""CURRENT shape of the PR 5 record_submit path (clean).
+
+The submit is counted INSIDE the intake critical section, before the
+enqueue becomes visible to a worker — the in-tree fix
+(``serve/batcher.py``: counter increments only, no telemetry I/O under
+the lock).
+"""
+
+import queue
+import threading
+
+
+class Intake:
+    def __init__(self):
+        self._intake_lock = threading.Lock()
+        self._q = queue.Queue(maxsize=8)
+        self._accepted = 0  # guarded-by: _intake_lock
+        self._worker = threading.Thread(target=self._serve, daemon=True)
+        self._worker.start()
+
+    def submit(self, item):
+        with self._intake_lock:
+            self._accepted += 1
+            self._q.put_nowait(item)
+
+    def _serve(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+
+    def shutdown(self):
+        self._q.put(None)
+        self._worker.join(timeout=5.0)

@@ -1,0 +1,37 @@
+"""PRE-fix shape of the PR 5 record_submit race (detected: GC001).
+
+The submit counter was bumped OUTSIDE the intake critical section: a
+worker could dispatch the enqueued request and record its response
+before the submit was counted, so a concurrent metrics snapshot saw
+``responses_total > requests_total`` — a reconciliation identity no
+dashboard should ever show.
+"""
+
+import queue
+import threading
+
+
+class Intake:
+    def __init__(self):
+        self._intake_lock = threading.Lock()
+        self._q = queue.Queue(maxsize=8)
+        self._accepted = 0  # guarded-by: _intake_lock
+        self._worker = threading.Thread(target=self._serve, daemon=True)
+        self._worker.start()
+
+    def submit(self, item):
+        with self._intake_lock:
+            self._q.put_nowait(item)
+        # Counted AFTER the enqueue is visible to a worker: the
+        # response can reach the ledger first.
+        self._accepted += 1
+
+    def _serve(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+
+    def shutdown(self):
+        self._q.put(None)
+        self._worker.join(timeout=5.0)

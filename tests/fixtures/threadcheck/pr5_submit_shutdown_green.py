@@ -1,0 +1,43 @@
+"""CURRENT shape of the PR 5 submit/shutdown path (clean).
+
+The stopping check and the enqueue are one critical section under the
+intake lock, and shutdown sets the flag under the same lock: an
+accepted enqueue happens-before the stop flag, so the workers (or the
+drain sweep) are guaranteed to see it — the in-tree fix,
+``serve/batcher.py``.
+"""
+
+import queue
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=4)
+        self._stopping = threading.Event()
+        self._intake_lock = threading.Lock()
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def submit(self, item):
+        with self._intake_lock:
+            if self._stopping.is_set():
+                raise RuntimeError("shutting down")
+            if self._q.full():
+                # Submitters are lock-serialized and workers only
+                # remove, so full() here IS the admission decision.
+                raise RuntimeError("queue full")
+            self._q.put_nowait(item)
+        return item
+
+    def _drain(self):
+        while not self._stopping.is_set():
+            try:
+                self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+
+    def shutdown(self):
+        with self._intake_lock:
+            self._stopping.set()
+        self._worker.join(timeout=5.0)

@@ -1,0 +1,35 @@
+"""PRE-fix shape of the PR 5 submit/shutdown TOCTOU (detected: GC003).
+
+``submit`` checks the stopping flag, then enqueues. Between the two, a
+concurrent ``shutdown`` can set the flag, join the workers and sweep
+the queues — the accepted request lands in a queue nobody will ever
+read (client hangs to a 504 instead of getting the 503 it was owed).
+"""
+
+import queue
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=4)
+        self._stopping = threading.Event()
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def submit(self, item):
+        if self._stopping.is_set():          # check...
+            raise RuntimeError("shutting down")
+        self._q.put_nowait(item)             # ...then act: the flag can
+        return item                          # flip in between
+
+    def _drain(self):
+        while not self._stopping.is_set():
+            try:
+                self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+
+    def shutdown(self):
+        self._stopping.set()
+        self._worker.join(timeout=5.0)

@@ -1,0 +1,32 @@
+"""CURRENT shape of the PR 8 in_flight gauge (clean).
+
+The gauge moves under the SAME lock as every counter, so the identity
+``requests_total == responses_total + rejected + in_flight`` holds at
+EVERY snapshot — the in-tree fix (``serve/metrics.py``).
+"""
+
+import threading
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests_total = 0   # guarded-by: _lock
+        self.responses_total = 0  # guarded-by: _lock
+        self.in_flight = 0        # guarded-by: _lock
+
+    def record_submit(self):
+        with self._lock:
+            self.requests_total += 1
+            self.in_flight += 1
+
+    def record_batch(self, n):
+        with self._lock:
+            self.responses_total += n
+            self.in_flight -= n
+
+    def snapshot(self):
+        with self._lock:
+            return {"requests_total": self.requests_total,
+                    "responses_total": self.responses_total,
+                    "in_flight": self.in_flight}

@@ -1,0 +1,34 @@
+"""PRE-fix shape of the PR 8 in_flight identity race (detected: GC001).
+
+The in-flight gauge was updated outside the lock that guards every
+counter, so the reconciliation identity ``requests_total ==
+responses_total + rejected + in_flight`` failed at snapshots taken
+mid-update — exactly the kind of "transient lie" a metrics surface
+must never tell.
+"""
+
+import threading
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests_total = 0   # guarded-by: _lock
+        self.responses_total = 0  # guarded-by: _lock
+        self.in_flight = 0        # guarded-by: _lock
+
+    def record_submit(self):
+        with self._lock:
+            self.requests_total += 1
+        self.in_flight += 1  # outside the counters' lock
+
+    def record_batch(self, n):
+        with self._lock:
+            self.responses_total += n
+        self.in_flight -= n  # outside the counters' lock
+
+    def snapshot(self):
+        with self._lock:
+            return {"requests_total": self.requests_total,
+                    "responses_total": self.responses_total,
+                    "in_flight": self.in_flight}

@@ -1,0 +1,41 @@
+"""CURRENT shape of the PR 9 monitor lifecycle (clean).
+
+The whole start/stop transition — flag clear, thread swap, join — runs
+under one lifecycle lock, so concurrent callers serialize and a
+restart always sees a cleared stop flag — the in-tree fix
+(``obs/device_memory.py``).
+"""
+
+import threading
+import time
+
+
+class Monitor:
+    def __init__(self, interval_s=0.05):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._state_lock = threading.Lock()
+        self._thread = None  # guarded-by: _state_lock
+        self.samples = 0
+
+    def start(self):
+        with self._state_lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()  # restartable: stop() leaves it set
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.samples += 1
+            time.sleep(self.interval_s)
+
+    def stop(self):
+        with self._state_lock:
+            thread = self._thread
+            if thread is None:
+                return
+            self._thread = None
+            self._stop.set()
+            thread.join(timeout=5.0)

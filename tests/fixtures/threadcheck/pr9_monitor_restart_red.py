@@ -1,0 +1,39 @@
+"""PRE-fix shape of the PR 9 monitor restart bug family (detected: GC003).
+
+``start``/``stop`` test-then-assign the thread field with no lock: two
+concurrent ``start`` calls both pass the ``_thread is not None`` check
+and double-start the sampler; ``stop`` racing ``start`` joins a thread
+the other call already replaced. (The PR 9 fix also made ``start``
+clear the stop flag — ``stop()`` used to leave it set, so a restarted
+monitor thread exited immediately; a flag-state bug the lifecycle lock
+now makes atomic with the thread swap.)
+"""
+
+import threading
+import time
+
+
+class Monitor:
+    def __init__(self, interval_s=0.05):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = None
+        self.samples = 0
+
+    def start(self):
+        if self._thread is not None:   # check...
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()           # ...then act, no lock
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.samples += 1
+            time.sleep(self.interval_s)
+
+    def stop(self):
+        if self._thread is None:       # same shape on the stop side
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None

@@ -1,0 +1,619 @@
+"""threadcheck: model extraction, GC rules red/green, the historical
+race fixture corpus, the clean-tree gate, and the runtime lock-order
+sanitizer. Pure host-side — no jax tracing anywhere (tier-1 on CPU)."""
+
+import ast
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pvraft_tpu.analysis.concurrency.check import (
+    check_paths,
+    check_source,
+    default_scope,
+)
+from pvraft_tpu.analysis.concurrency.model import build_module_model
+from pvraft_tpu.analysis.concurrency.rules import all_concurrency_rules
+from pvraft_tpu.analysis.concurrency.sanitizer import (
+    LockOrderError,
+    OrderedLock,
+    order_edges,
+    ordered_lock,
+    reset_order_graph,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "threadcheck")
+
+
+def ids(src, path="x.py"):
+    return [d.rule_id for d in check_source(src, path=path)]
+
+
+def model_of(src, path="x.py"):
+    return build_module_model(ast.parse(src), src, path)
+
+
+# --- model extraction -----------------------------------------------------
+
+MODEL_SRC = '''
+import queue
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._q = queue.Queue(maxsize=4)
+        self._n = 0  # guarded-by: _lock
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self._n += 1
+
+    def hook(self):
+        def inner():
+            return self._n
+        return inner
+'''
+
+
+def test_model_classifies_fields():
+    cls = model_of(MODEL_SRC).class_named("C")
+    assert set(cls.locks) == {"_lock"}
+    assert set(cls.events) == {"_stop"}
+    assert set(cls.queues) == {"_q"}
+    assert cls.guard_of("_n") == "_lock"
+    assert cls.concurrent
+    assert [s.target for s in cls.spawns] == ["_run"]
+    assert "_run" in cls.thread_entry_methods()
+
+
+def test_model_held_tracking_and_nested_def():
+    cls = model_of(MODEL_SRC).class_named("C")
+    run_writes = [a for a in cls.accesses
+                  if a.method == "_run" and a.attr == "_n"]
+    assert run_writes and all("_lock" in a.held for a in run_writes)
+    # A closure body runs after the enclosing with exits: empty held set.
+    inner_reads = [a for a in cls.accesses
+                   if a.method.startswith("hook") and a.attr == "_n"]
+    assert inner_reads and all(not a.held for a in inner_reads)
+
+
+def test_guard_comment_does_not_leak_to_next_line():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.a = 0  # guarded-by: _lock\n"
+        "        self.b = 0\n"
+    )
+    cls = model_of(src).class_named("C")
+    assert cls.guard_of("a") == "_lock"
+    assert cls.guard_of("b") is None
+
+
+def test_guard_comment_on_own_line_annotates_below():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        # guarded-by: _lock\n"
+        "        self.a = 0\n"
+    )
+    assert model_of(src).class_named("C").guard_of("a") == "_lock"
+
+
+# --- per-rule red/green ---------------------------------------------------
+
+GC001_RED = '''
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  # guarded-by: _lock
+    def bump(self):
+        self.n += 1
+'''
+
+GC001_GREEN = '''
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  # guarded-by: _lock
+    def bump(self):
+        with self._lock:
+            self.n += 1
+'''
+
+GC002_RED = '''
+import threading
+class C:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+    def f(self):
+        with self.a:
+            with self.b:
+                pass
+    def g(self):
+        with self.b:
+            with self.a:
+                pass
+'''
+
+GC002_GREEN = '''
+import threading
+class C:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+    def f(self):
+        with self.a:
+            with self.b:
+                pass
+    def g(self):
+        with self.a:
+            with self.b:
+                pass
+'''
+
+GC002_CALL_RED = '''
+import threading
+class C:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+    def f(self):
+        with self.a:
+            self.g()
+    def g(self):
+        with self.b:
+            self.h()
+    def h(self):
+        with self.a:
+            pass
+'''
+
+GC002_MULTI_ITEM_RED = '''
+import threading
+class C:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+    def f(self):
+        with self.a, self.b:
+            pass
+    def g(self):
+        with self.b:
+            with self.a:
+                pass
+'''
+
+GC004_STRING_JOIN_RED = '''
+import os
+import threading
+class C:
+    def __init__(self):
+        self._t = threading.Thread(target=self.run)
+        self._t.start()
+    def run(self):
+        return ", ".join(["a", "b"]) + os.path.join("x", "y")
+'''
+
+GC003_QUEUE_RED = '''
+import queue
+import threading
+class C:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=2)
+        self._t = threading.Thread(target=self.run, daemon=True)
+    def run(self):
+        pass
+    def submit(self, item):
+        if not self._q.full():
+            self._q.put_nowait(item)
+'''
+
+GC003_QUEUE_GREEN = '''
+import queue
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue(maxsize=2)
+        self._t = threading.Thread(target=self.run, daemon=True)
+    def run(self):
+        pass
+    def submit(self, item):
+        with self._lock:
+            if not self._q.full():
+                self._q.put_nowait(item)
+'''
+
+GC004_RED = '''
+import threading
+class C:
+    def __init__(self):
+        self._t = threading.Thread(target=self.run)
+        self._t.start()
+    def run(self):
+        pass
+'''
+
+GC004_GREEN = '''
+import threading
+class C:
+    def __init__(self):
+        self._t = threading.Thread(target=self.run)
+        self._t.start()
+    def run(self):
+        pass
+    def close(self):
+        self._t.join()
+'''
+
+
+@pytest.mark.parametrize("rule,red,green", [
+    ("GC001", GC001_RED, GC001_GREEN),
+    ("GC002", GC002_RED, GC002_GREEN),
+    ("GC002", GC002_CALL_RED, GC002_GREEN),
+    # `with self.a, self.b:` acquires left-to-right — a real ordering
+    # constraint the graph must carry.
+    ("GC002", GC002_MULTI_ITEM_RED, GC002_GREEN),
+    ("GC003", GC003_QUEUE_RED, GC003_QUEUE_GREEN),
+    ("GC004", GC004_RED, GC004_GREEN),
+    # String/path joins must not satisfy the join requirement — one
+    # `", ".join(...)` in a class would otherwise disarm GC004 wholesale.
+    ("GC004", GC004_STRING_JOIN_RED, GC004_GREEN),
+])
+def test_rule_red_green(rule, red, green):
+    assert rule in ids(red)
+    assert ids(green) == []
+
+
+def test_benign_consumer_loop_not_flagged():
+    # `while not stopped: q.get(timeout=...)` is the standard worker
+    # idiom — the event check gates only the producer side (GC003).
+    src = '''
+import queue
+import threading
+class C:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self.run, daemon=True)
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+    def close(self):
+        self._t.join()
+'''
+    assert ids(src) == []
+
+
+def test_single_threaded_class_skipped():
+    # No locks, no spawns: not a concurrent class, nothing fires even
+    # on shapes that would be flagged in one.
+    src = '''
+class C:
+    def __init__(self):
+        self._thread = None
+    def start(self):
+        if self._thread is None:
+            self._thread = object()
+'''
+    assert ids(src) == []
+
+
+def test_suppression_shared_pragma_grammar():
+    red = GC001_RED.replace(
+        "        self.n += 1",
+        "        self.n += 1  # graftlint: disable=GC001 -- test-only")
+    assert ids(red) == []
+
+
+def test_syntax_error_is_gc000():
+    assert ids("def broken(:\n") == ["GC000"]
+
+
+# --- the historical race corpus ------------------------------------------
+
+# fixture stem -> rule that must detect the PRE-fix shape.
+CORPUS = {
+    "pr5_submit_shutdown": "GC003",
+    "pr5_record_submit": "GC001",
+    "pr8_in_flight": "GC001",
+    "pr5_mid_predict_504": "GC003",
+    "pr9_monitor_restart": "GC003",
+}
+
+
+@pytest.mark.parametrize("stem,rule", sorted(CORPUS.items()))
+def test_corpus_red_detected(stem, rule):
+    diags, n = check_paths([os.path.join(FIXTURES, f"{stem}_red.py")])
+    assert n == 1
+    assert rule in {d.rule_id for d in diags}, (
+        f"historical race {stem} no longer detected by {rule}")
+
+
+@pytest.mark.parametrize("stem", sorted(CORPUS))
+def test_corpus_green_clean(stem):
+    diags, _ = check_paths([os.path.join(FIXTURES, f"{stem}_green.py")])
+    assert diags == []
+
+
+def test_corpus_covers_at_least_four_races():
+    # Acceptance bar (ISSUE 11): >= 4 of the six PR 5/8/9 races
+    # reproduced as detections. Five are; the sixth (404 keep-alive
+    # desync) is protocol-state, documented out of static reach and
+    # pinned by the raw-socket test in test_serve.py instead.
+    assert len(CORPUS) >= 4
+    diags, _ = check_paths([os.path.join(FIXTURES, "pr5_keepalive_404.py")])
+    assert diags == []
+
+
+# --- the gate: clean tree at zero findings --------------------------------
+
+def test_scope_checks_clean():
+    """The lint.sh stage in test form: serve/ + obs/ + data/loader.py
+    must be at zero findings (real violations get FIXED, not pragma'd —
+    the deepcheck precedent)."""
+    diags, nfiles = check_paths(default_scope())
+    assert nfiles >= 15
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
+def test_no_reasonless_gc_pragmas_in_package():
+    """GC suppressions ride the shared pragma grammar, so they feed the
+    lint --stats debt gate; any GC pragma in the package must carry a
+    reason."""
+    from pvraft_tpu.analysis.engine import collect_suppressions
+
+    pragmas = collect_suppressions([os.path.join(REPO, "pvraft_tpu")])
+    gc = [p for p in pragmas if any(i.startswith("GC") for i in p.ids)]
+    assert all(p.reason for p in gc)
+
+
+def test_known_rule_ids_include_gc_family():
+    from pvraft_tpu.analysis.engine import known_rule_ids
+
+    known = known_rule_ids()
+    for rule in all_concurrency_rules():
+        assert rule.id in known
+
+
+def test_rule_table_unique_and_documented():
+    rules = all_concurrency_rules()
+    assert len({r.id for r in rules}) == len(rules)
+    for r in rules:
+        assert r.__doc__ and r.title
+
+
+# --- CLI ------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "pvraft_tpu.analysis", "concurrency", *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    assert "GC001" in proc.stdout and "GC004" in proc.stdout
+
+
+def test_cli_red_fixture_exits_nonzero():
+    proc = _run_cli(os.path.join(FIXTURES, "pr8_in_flight_red.py"))
+    assert proc.returncode == 1
+    assert "GC001" in proc.stdout
+
+
+def test_cli_default_scope_clean():
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stderr
+
+
+def test_lint_stats_counts_gc_namespace(tmp_path):
+    """`lint --stats` counts GC pragmas through the one shared grammar
+    and does not warn about them as unknown rules."""
+    f = tmp_path / "x.py"
+    f.write_text("y = 1  # graftlint: disable=GC001 -- fixture reason\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pvraft_tpu.analysis", "lint", "--stats",
+         str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "GC001" in proc.stdout
+    assert "unknown" not in proc.stdout
+
+
+# --- sanitizer ------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    reset_order_graph()
+    yield
+    reset_order_graph()
+
+
+def test_sanitizer_consistent_order_ok():
+    a, b = OrderedLock("t.a"), OrderedLock("t.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert ("t.a", "t.b") in order_edges()
+
+
+def test_sanitizer_inversion_raises_with_both_sites():
+    a, b = OrderedLock("t.a"), OrderedLock("t.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderError) as err:
+            a.acquire()
+    msg = str(err.value)
+    assert "t.a" in msg and "t.b" in msg and "opposite order" in msg
+
+
+def test_sanitizer_inversion_across_threads():
+    a, b = OrderedLock("t.a"), OrderedLock("t.b")
+
+    def leg_ab():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=leg_ab)
+    t.start()
+    t.join()
+    with b:
+        with pytest.raises(LockOrderError):
+            with a:
+                pass
+
+
+def test_sanitizer_recursive_acquire_raises():
+    a = OrderedLock("t.a")
+    with a:
+        with pytest.raises(LockOrderError, match="recursive"):
+            a.acquire()
+    # The failed acquire must not have corrupted the held stack.
+    with a:
+        pass
+
+
+def test_sanitizer_trylock_never_raises():
+    a, b = OrderedLock("t.a"), OrderedLock("t.b")
+    with a:
+        with b:
+            pass
+    with b:
+        assert a.acquire(blocking=False) is True
+        a.release()
+    # The trylock left no (t.b, t.a) edge behind: a leg that can never
+    # wait must not constrain the opposite blocking order either.
+    assert ("t.b", "t.a") not in order_edges()
+
+
+def test_sanitizer_trylock_held_still_constrains():
+    # A lock WON via trylock sits on the held stack normally: a blocking
+    # acquire under it records the edge and inversions still raise.
+    a, b = OrderedLock("t.a"), OrderedLock("t.b")
+    assert a.acquire(blocking=False)
+    with b:
+        pass
+    a.release()
+    assert ("t.a", "t.b") in order_edges()
+    with b:
+        with pytest.raises(LockOrderError):
+            a.acquire()
+
+
+def test_sanitizer_release_out_of_order():
+    a, b = OrderedLock("t.a"), OrderedLock("t.b")
+    a.acquire()
+    b.acquire()
+    a.release()  # hand-over-hand: release the outer lock first
+    b.release()
+    with a:
+        pass
+
+
+def test_ordered_lock_factory_gates_on_env(monkeypatch):
+    monkeypatch.delenv("PVRAFT_CHECKS", raising=False)
+    assert not isinstance(ordered_lock("t.x"), OrderedLock)
+    monkeypatch.setenv("PVRAFT_CHECKS", "1")
+    assert isinstance(ordered_lock("t.x"), OrderedLock)
+
+
+# --- sanitizer end-to-end on the real batcher -----------------------------
+
+class _FakeEngine:
+    """Minimal engine double (same contract as test_serve's)."""
+
+    def __init__(self, buckets=(32,), batch_sizes=(1, 2)):
+        from types import SimpleNamespace
+
+        self.cfg = SimpleNamespace(buckets=buckets,
+                                   batch_sizes=batch_sizes,
+                                   min_points=4, coord_limit=100.0)
+
+    def validate_request(self, pc1, pc2):
+        return self.cfg.buckets[0]
+
+    def batch_size_for(self, n):
+        for bs in self.cfg.batch_sizes:
+            if n <= bs:
+                return bs
+        return self.cfg.batch_sizes[-1]
+
+    def predict_batch(self, requests, bucket):
+        return [np.zeros((pc1.shape[0], 3), np.float32)
+                for pc1, _ in requests]
+
+    def compile_report(self):
+        return []
+
+
+def test_sanitizer_live_batcher_run(monkeypatch):
+    """PVRAFT_CHECKS=1 turns the adopted serve locks into OrderedLocks:
+    a real MicroBatcher+ServeMetrics round-trip runs clean under the
+    sanitizer and records the intake->metrics acquisition edge — the
+    'threaded tier-1 tests double as a sanitizer run' wiring, proven
+    in-process."""
+    monkeypatch.setenv("PVRAFT_CHECKS", "1")
+    from pvraft_tpu.serve.batcher import BatcherConfig, MicroBatcher
+    from pvraft_tpu.serve.metrics import ServeMetrics
+
+    engine = _FakeEngine()
+    metrics = ServeMetrics(engine.cfg.buckets)
+    assert isinstance(metrics._lock, OrderedLock)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=2, max_wait_ms=1.0, queue_depth=8),
+        metrics=metrics)
+    assert isinstance(batcher._intake_lock, OrderedLock)
+    pc = np.zeros((8, 3), np.float32)
+    handles = [batcher.submit(pc, pc) for _ in range(6)]
+    for h in handles:
+        h.wait(30)
+    batcher.shutdown(drain=True)
+    edges = order_edges()
+    assert ("MicroBatcher._intake_lock", "ServeMetrics._lock") in edges
+    snap = metrics.snapshot()
+    assert snap["requests_total"] == 6
+    assert snap["responses_total"] == 6
+
+
+def test_sanitizer_devmem_lifecycle(monkeypatch):
+    """The device-memory monitor's new lifecycle lock under the
+    sanitizer: start/stop/start cycles are race-free and restartable."""
+    monkeypatch.setenv("PVRAFT_CHECKS", "1")
+    from pvraft_tpu.obs.device_memory import DeviceMemoryMonitor
+
+    seen = []
+    mon = DeviceMemoryMonitor(emit=lambda rows, context: seen.append(rows),
+                              interval_s=0.01, devices=[])
+    mon.start()
+    time.sleep(0.05)
+    mon.stop()
+    mon.start()  # restart must re-arm (stop flag cleared under the lock)
+    assert mon._thread is not None
+    mon.stop()
+    assert mon._thread is None
