@@ -1,4 +1,5 @@
-"""CLI: ``python -m pvraft_tpu.analysis {lint,trace,deepcheck,concurrency}``.
+"""CLI: ``python -m pvraft_tpu.analysis
+{lint,trace,deepcheck,concurrency,kernels}``.
 
 ``lint`` is pure stdlib-AST and never initializes a jax backend
 (``--stats`` prints the suppression-debt report instead of findings).
@@ -12,6 +13,12 @@ precision flow, retrace hazards.
 discipline, lock-order cycles, check-then-act/TOCTOU shapes, un-joined
 threads — over the hand-threaded planes (default scope ``serve/``,
 ``obs/``, ``data/loader.py``); pure stdlib-AST like ``lint``.
+``kernels`` (kernelcheck) runs the GK001+ rules — tile alignment, VMEM
+budget, grid coverage, Mosaic lowering hazards, registry coverage,
+interpreter escape hatch — over the Pallas plane (``ops/pallas/``);
+``--plan`` joins the static models with the committed cost inventory
+into the ``pvraft_kernel_plan/v1`` artifact (fused-GRU VMEM residency,
+roofline verdicts, static-vs-Mosaic cross-validation).
 """
 
 from __future__ import annotations
@@ -145,6 +152,67 @@ def _cmd_concurrency(args) -> int:
     return 1 if diags else 0
 
 
+def _cmd_kernels(args) -> int:
+    from pvraft_tpu.analysis.kernels.check import check_paths, default_scope
+    from pvraft_tpu.analysis.kernels.rules import all_kernel_rules
+
+    if args.list_rules:
+        for rule in all_kernel_rules():
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id}  {rule.title:<28} {doc}")
+        return 0
+    if args.plan or args.check:
+        return _kernels_plan(args)
+    paths = args.paths or list(default_scope())
+    select = tuple(args.select.split(",")) if args.select else ()
+    diags, notes, nfiles = check_paths(paths, rule_ids=select)
+    for d in diags:
+        print(d.format())
+    for d in notes:
+        print(f"note: {d.format()}")
+    print(f"kernelcheck: {len(diags)} finding(s), {len(notes)} layout "
+          f"note(s) in {nfiles} file(s)", file=sys.stderr)
+    return 1 if diags else 0
+
+
+def _kernels_plan(args) -> int:
+    """Build (or --check) the pvraft_kernel_plan/v1 artifact: static
+    kernel models joined with the committed cost inventory. Exit 1 on
+    any plan problem — a failed static-vs-Mosaic cross-validation, a
+    kernel-tag spec with no cost record, or (with --check) a committed
+    plan that drifted from the regenerated one."""
+    import json
+
+    from pvraft_tpu.analysis.kernels.planner import (
+        build_plan,
+        check_plan_file,
+        write_plan,
+    )
+
+    if args.check:
+        problems = check_plan_file(args.check, args.costs)
+        for p in problems:
+            print(p, file=sys.stderr)
+        if not problems:
+            print(f"{args.check}: OK (matches the plan regenerated from "
+                  f"{args.costs})")
+        return 1 if problems else 0
+    try:
+        plan = build_plan(args.costs, paths=args.paths or None)
+    except (OSError, ValueError) as e:
+        print(f"kernels --plan: {e}", file=sys.stderr)
+        return 1
+    if args.out:
+        write_plan(plan, args.out)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(json.dumps(plan, indent=1, sort_keys=True))
+    for rec in plan["fused_gru_residency"]:
+        print(f"[residency] N={rec['n_points']} K={rec['truncate_k']}: "
+              f"{rec['verdict']}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pvraft_tpu.analysis",
@@ -200,6 +268,32 @@ def main(argv=None) -> int:
     p_conc.add_argument("--select", default="",
                         help="comma-separated GC rule ids (default all)")
     p_conc.set_defaults(fn=_cmd_concurrency)
+
+    p_kern = sub.add_parser(
+        "kernels",
+        help="kernelcheck: Pallas/Mosaic static analysis (GK rules) over "
+             "ops/pallas/, plus the --plan VMEM/roofline planner",
+    )
+    p_kern.add_argument("paths", nargs="*",
+                        help="files/directories to check (default: the "
+                             "ops/pallas scope)")
+    p_kern.add_argument("--list-rules", action="store_true",
+                        help="print the GK rule table and exit")
+    p_kern.add_argument("--select", default="",
+                        help="comma-separated GK rule ids (default all)")
+    p_kern.add_argument("--plan", action="store_true",
+                        help="emit the pvraft_kernel_plan/v1 artifact "
+                             "(static models joined with --costs)")
+    p_kern.add_argument("--out", default="",
+                        help="with --plan: write the artifact here "
+                             "instead of stdout")
+    p_kern.add_argument("--check", default="", metavar="ARTIFACT",
+                        help="regenerate the plan and compare against a "
+                             "committed artifact (exit 1 on drift)")
+    p_kern.add_argument("--costs", default="artifacts/programs_costs.json",
+                        help="the committed pvraft_costs/v1 inventory to "
+                             "join against")
+    p_kern.set_defaults(fn=_cmd_kernels)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -340,8 +340,8 @@ def collect_suppressions(paths: Sequence[str]) -> List[Pragma]:
 
 
 def known_rule_ids() -> Set[str]:
-    """Ids of every registered rule: AST (GL), jaxpr (GJ) and
-    concurrency (GC) families — one namespace for the shared pragma
+    """Ids of every registered rule: AST (GL), jaxpr (GJ), concurrency
+    (GC) and kernel (GK) families — one namespace for the shared pragma
     grammar, so ``lint --stats`` counts every engine's suppressions and
     flags none of them as unknown."""
     ids = {r.id for r in all_rules()}
@@ -358,6 +358,13 @@ def known_rule_ids() -> Set[str]:
 
         ids |= {r.id for r in all_concurrency_rules()}
         ids.add("GC000")  # the checker's syntax-error diagnostic
+    except ImportError:  # pragma: no cover - partial checkouts only
+        pass
+    try:
+        from pvraft_tpu.analysis.kernels.rules import all_kernel_rules
+
+        ids |= {r.id for r in all_kernel_rules()}
+        ids.add("GK000")  # the model-incomplete/syntax diagnostic
     except ImportError:  # pragma: no cover - partial checkouts only
         pass
     return ids

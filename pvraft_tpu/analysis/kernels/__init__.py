@@ -1,0 +1,48 @@
+"""kernelcheck: Pallas/Mosaic static analysis + the VMEM/roofline planner.
+
+The FOURTH analysis engine (after graftlint GL, deepcheck GJ and
+threadcheck GC), sharing the one :class:`~pvraft_tpu.analysis.engine.
+Diagnostic` type and ``# graftlint: disable=GKxxx -- reason`` pragma
+grammar. Two halves:
+
+* **checker** (``model.py`` + ``rules.py`` + ``check.py``): a concrete
+  static model of every ``pallas_call`` site — grid, BlockSpecs, index
+  maps, operands, kernel-body ops — and the GK001-GK006 rules over it
+  (tile alignment, VMEM budget, grid coverage, Mosaic lowering hazards,
+  registry coverage, interpreter escape hatch);
+* **planner** (``planner.py``): joins the static models with the
+  committed cost inventory into ``artifacts/kernel_plan.json``
+  (``pvraft_kernel_plan/v1``) — per-kernel roofline verdicts, the
+  static-vs-Mosaic HBM cross-validation pin, and the fused-GRU VMEM
+  residency verdict ROADMAP item 1 cites.
+
+CLI: ``python -m pvraft_tpu.analysis kernels [--plan]``. Pure stdlib
+``ast`` + committed artifacts — no jax import anywhere on the check
+path, so the gate runs on hosts with no accelerator stack at all.
+"""
+
+from pvraft_tpu.analysis.kernels.check import (         # noqa: F401
+    DEFAULT_SCOPE,
+    check_paths,
+    check_source,
+    default_scope,
+    registered_kernel_modules,
+)
+from pvraft_tpu.analysis.kernels.model import (         # noqa: F401
+    ArrayInfo,
+    BlockSpecModel,
+    KERNEL_BINDINGS,
+    KernelModel,
+    build_module_kernel_model,
+)
+from pvraft_tpu.analysis.kernels.planner import (       # noqa: F401
+    PLAN_SCHEMA,
+    build_plan,
+    check_plan_file,
+    fused_gru_residency,
+    write_plan,
+)
+from pvraft_tpu.analysis.kernels.rules import (         # noqa: F401
+    VMEM_BUDGET_BYTES,
+    all_kernel_rules,
+)

@@ -1,0 +1,152 @@
+"""kernelcheck driver: files -> kernel models -> GK rules -> diagnostics.
+
+Mirrors ``concurrency/check.py`` deliberately: same ``Diagnostic`` type,
+same ``# graftlint: disable=GKxxx -- reason`` suppression grammar (one
+parser — what ``lint --stats`` counts is exactly what is honored here),
+same stable ordering. Scope defaults to the Pallas kernel plane
+(``ops/pallas/``), resolved relative to the installed package so
+``python -m pvraft_tpu.analysis kernels`` works from any cwd.
+
+A ``pallas_call`` site whose geometry cannot be statically modeled gets
+a ``GK000`` finding (the GC000/GL000 discipline): a new kernel either
+evaluates — literal dims, or one :data:`~.model.KERNEL_BINDINGS` row at
+its certified geometry — or fails the gate; it can never silently skip
+analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pvraft_tpu.analysis.engine import (
+    Diagnostic,
+    _expand_decorated_regions,
+    _suppressed,
+    _suppressions,
+    iter_py_files,
+)
+from pvraft_tpu.analysis.kernels.model import build_module_kernel_model
+from pvraft_tpu.analysis.kernels.rules import (
+    KernelContext,
+    all_kernel_rules,
+)
+
+
+def default_scope() -> Tuple[str, ...]:
+    """The gate's scan scope, as absolute paths of this checkout."""
+    import pvraft_tpu
+
+    pkg = os.path.dirname(os.path.abspath(pvraft_tpu.__file__))
+    return (os.path.join(pkg, "ops", "pallas"),)
+
+
+# Spelled as a constant for docs/tests; resolved lazily by the CLI.
+DEFAULT_SCOPE = ("pvraft_tpu/ops/pallas",)
+
+_IMPORT_RE = re.compile(
+    r"(?:from|import)\s+(pvraft_tpu\.ops\.pallas\.\w+)")
+
+
+def kernel_spec_imports() -> "Dict[str, List[str]]":
+    """kernel-tag ProgramSpec name -> normalized path suffixes of every
+    Pallas module its thunk source imports (order-preserving, deduped).
+    THE one catalog inspection — GK005's coverage set and the planner's
+    spec->module mapping both derive from it, so they cannot drift.
+    Import-light: ``load_catalog`` registers specs without importing jax
+    (thunks stay lazy), and the thunk *source* is inspected, never run."""
+    import inspect
+
+    from pvraft_tpu.programs import by_tag, load_catalog
+
+    load_catalog()
+    out: Dict[str, List[str]] = {}
+    for spec in by_tag("kernel"):
+        try:
+            source = inspect.getsource(spec.thunk)
+        except (OSError, TypeError):
+            continue
+        mods: List[str] = []
+        for mod in _IMPORT_RE.findall(source):
+            suffix = mod.replace(".", "/") + ".py"
+            if suffix not in mods:
+                mods.append(suffix)
+        out[spec.name] = mods
+    return out
+
+
+def registered_kernel_modules() -> Set[str]:
+    """Path suffixes of every Pallas module some ``kernel``-tagged
+    ProgramSpec imports — the GK005 coverage set."""
+    return {m for mods in kernel_spec_imports().values() for m in mods}
+
+
+def check_source(source: str, path: str = "<string>",
+                 rule_ids: Sequence[str] = (),
+                 registered_modules: Optional[Set[str]] = None,
+                 ) -> Tuple[List[Diagnostic], List[Diagnostic]]:
+    """Run the GK rules over one source string (suppressions applied).
+
+    Returns ``(findings, notes)`` — notes are advisory layout
+    observations (GK001 whole-axis blocks) that never fail the gate.
+    """
+    source = source.lstrip("\ufeff")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return ([Diagnostic(path, e.lineno or 1, e.offset or 0, "GK000",
+                            f"syntax error: {e.msg}")], [])
+    model = build_module_kernel_model(tree, source, path)
+    ctx = KernelContext(path, source, tree, model,
+                        registered_modules=registered_modules)
+    per_line, file_ids = _suppressions(source)
+    _expand_decorated_regions(tree, per_line)
+    out: List[Diagnostic] = []
+    for km in model.kernels:
+        for problem in km.problems:
+            d = Diagnostic(
+                path, km.line, km.col, "GK000",
+                f"pallas_call in `{km.func}` cannot be statically "
+                f"modeled: {problem} — use literal dims or add a "
+                f"KERNEL_BINDINGS row at the kernel's certified geometry")
+            if (not rule_ids or "GK000" in rule_ids) and \
+                    not _suppressed(d, per_line, file_ids):
+                out.append(d)
+    for rule_cls in all_kernel_rules():
+        if rule_ids and rule_cls.id not in rule_ids:
+            continue
+        for d in rule_cls().check(ctx):
+            if not _suppressed(d, per_line, file_ids):
+                out.append(d)
+    notes = [d for d in ctx.notes
+             if not _suppressed(d, per_line, file_ids)
+             and (not rule_ids or d.rule_id in rule_ids)]
+    out.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    notes.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return out, notes
+
+
+def check_paths(paths: Sequence[str], rule_ids: Sequence[str] = (),
+                registered_modules: Optional[Set[str]] = None,
+                ) -> Tuple[List[Diagnostic], List[Diagnostic], int]:
+    """Check files/directories. Returns (findings, notes, files_checked).
+
+    ``registered_modules`` defaults to the live registry's kernel-tag
+    coverage set, so the clean-tree gate always arms GK005."""
+    if registered_modules is None:
+        registered_modules = registered_kernel_modules()
+    findings: List[Diagnostic] = []
+    notes: List[Diagnostic] = []
+    n = 0
+    for f in iter_py_files(paths):
+        n += 1
+        with open(f, "r", encoding="utf-8-sig") as fh:
+            d, w = check_source(fh.read(), path=f, rule_ids=rule_ids,
+                                registered_modules=registered_modules)
+        findings.extend(d)
+        notes.extend(w)
+    findings.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    notes.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return findings, notes, n

@@ -1,0 +1,709 @@
+"""Static Pallas kernel model: what the GK rules reason over.
+
+Pure stdlib ``ast`` — like graftlint and threadcheck, this must run in
+milliseconds on hosts with no accelerator stack. For every
+``pl.pallas_call`` site in a scanned file the extractor produces a
+:class:`KernelModel` holding the *concrete* launch geometry:
+
+  * the ``grid`` tuple, evaluated to ints;
+  * every input/output :class:`BlockSpecModel` — block shape (ints),
+    the index-map lambda's AST, and the declaration site;
+  * the abstract operands (``ArrayInfo``: shape + dtype) the call is
+    applied to, and the declared ``out_shape`` structs;
+  * the kernel body's ``FunctionDef`` (resolved through
+    ``functools.partial``) for the GK004 hazard scan;
+  * the ``interpret=`` keyword's AST for the GK006 escape-hatch check.
+
+Shapes in the source are *expressions* (``(1, tile, k)`` where ``tile =
+_pick_tile(n)``), so the extractor runs a tiny safe evaluator: sequential
+constant propagation over the enclosing function's straight-line
+assignments, seeded from a :data:`KERNEL_BINDINGS` environment (the
+flagship geometry from :mod:`pvraft_tpu.programs.geometries` — the SAME
+dims the ``kernel``-tagged ProgramSpecs compile at, so static numbers
+and the committed Mosaic records describe one program). Module-level
+helper functions (``_pick_tile``) are executed for real — compiled from
+their own AST into a namespace with whitelisted builtins only, never
+imported (importing ``ops/pallas`` would drag jax in).
+
+A fixture (or future kernel) with literal dims needs no binding at all;
+a kernel whose geometry can NOT be evaluated gets a ``GK000``
+model-incomplete finding from the check driver — a new kernel either
+models cleanly or fails the gate, it cannot silently skip analysis.
+
+Everything here is deliberately under-approximate (no branching, no
+cross-file dataflow): like the other engines, a gate that only flags
+certainties gets kept.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import math
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# Bytes per element for the dtypes a kernel block can carry.
+DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "fp8": 1,
+}
+
+# Dotted-tail attribute names that evaluate to a dtype string
+# (``jnp.float32``, ``np.int32``, a bare ``float32`` import).
+_DTYPE_TAILS = {
+    "float32", "bfloat16", "float16", "float64", "int8", "int16",
+    "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+}
+_DTYPE_ALIASES = {"bool_": "bool", "bool": "bool"}
+
+
+class EvalError(Exception):
+    """A geometry expression the safe evaluator cannot resolve."""
+
+
+def _dotted_tail(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayInfo:
+    """Abstract array: shape + dtype (the eval_shape view of an operand)."""
+
+    shape: Tuple[int, ...]
+    dtype: str = "float32"
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def nbytes(self) -> int:
+        n = DTYPE_BYTES.get(self.dtype, 4)
+        for d in self.shape:
+            n *= d
+        return n
+
+    def __getitem__(self, key) -> "ArrayInfo":
+        """Shape-level subscript: supports the slicing the kernels use
+        (``xyz[..., 0]`` drops the axis, ``coords[..., 0:1]`` keeps a
+        length-1 axis)."""
+        if not isinstance(key, tuple):
+            key = (key,)
+        n_explicit = sum(1 for k in key if k is not Ellipsis)
+        out: List[int] = []
+        dim = 0
+        for k in key:
+            if k is Ellipsis:
+                keep = len(self.shape) - n_explicit
+                out.extend(self.shape[dim:dim + keep])
+                dim += keep
+            elif isinstance(k, slice):
+                out.append(len(range(*k.indices(self.shape[dim]))))
+                dim += 1
+            elif isinstance(k, int):
+                dim += 1  # integer index drops the axis
+            else:
+                raise EvalError(f"unsupported subscript {k!r}")
+        out.extend(self.shape[dim:])
+        return ArrayInfo(tuple(out), self.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSpecModel:
+    """One evaluated ``pl.BlockSpec``: concrete block shape + the
+    index-map lambda's AST (None for whole-array specs)."""
+
+    block: Optional[Tuple[int, ...]]
+    index_map: Optional[ast.Lambda]
+    line: int
+    col: int
+
+    def block_bytes(self, dtype: str) -> int:
+        if self.block is None:
+            return 0
+        n = DTYPE_BYTES.get(dtype, 4)
+        for d in self.block:
+            n *= d
+        return n
+
+
+@dataclasses.dataclass(frozen=True)
+class PartialModel:
+    """``functools.partial(kernel_fn, **kw)`` — enough to resolve the
+    kernel body and read its statically-evaluable keyword args."""
+
+    func_name: str
+    kwargs: Dict[str, Any]
+
+
+class _InterpretMode:
+    """Marker for a call to the ``interpret_mode()`` escape hatch."""
+
+
+@dataclasses.dataclass
+class KernelModel:
+    """One ``pallas_call`` site, concretely modeled."""
+
+    path: str
+    line: int
+    col: int
+    func: str                     # enclosing module-level function
+    kernel_fn_name: str = ""
+    kernel_fn_node: Optional[ast.AST] = None
+    grid: Optional[Tuple[int, ...]] = None
+    in_specs: Optional[List[BlockSpecModel]] = None
+    out_specs: Optional[List[BlockSpecModel]] = None
+    out_info: Optional[List[ArrayInfo]] = None
+    operands: Optional[List[Optional[ArrayInfo]]] = None
+    scratch: Tuple[ArrayInfo, ...] = ()
+    interpret_node: Optional[ast.AST] = None
+    # True when `interpret=` EVALUATES to the interpret_mode() marker —
+    # covers the `interp = interpret_mode()` local-variable spelling
+    # the AST walk in GK006 cannot see.
+    interpret_resolved: bool = False
+    problems: List[str] = dataclasses.field(default_factory=list)
+
+    def io_pairs(self) -> List[Tuple[str, BlockSpecModel, ArrayInfo]]:
+        """(role, spec, operand) for every spec matched to a concrete
+        operand — inputs first, then outputs."""
+        out: List[Tuple[str, BlockSpecModel, ArrayInfo]] = []
+        if self.in_specs and self.operands:
+            for spec, op in zip(self.in_specs, self.operands):
+                if op is not None:
+                    out.append(("in", spec, op))
+        if self.out_specs and self.out_info:
+            for spec, op in zip(self.out_specs, self.out_info):
+                out.append(("out", spec, op))
+        return out
+
+    def vmem_estimate_bytes(self) -> Optional[int]:
+        """Static VMEM footprint: every grid-streamed block
+        double-buffered (the pipeline loads the next block behind
+        compute); whole-array (block=None) specs and scratch are
+        resident once — not streamed, so not double-buffered."""
+        pairs = self.io_pairs()
+        if not pairs:
+            return None
+        total = 0
+        for _, spec, op in pairs:
+            if spec.block is None:
+                total += op.nbytes
+            else:
+                total += 2 * spec.block_bytes(op.dtype)
+        total += sum(s.nbytes for s in self.scratch)
+        return total
+
+    def hbm_operand_bytes(self) -> Optional[Tuple[int, int]]:
+        """(input bytes, output bytes) of the full operands — what the
+        compiled program's memory_analysis calls argument/output size."""
+        if self.operands is None or self.out_info is None or \
+                any(op is None for op in self.operands):
+            return None
+        return (sum(op.nbytes for op in self.operands if op is not None),
+                sum(o.nbytes for o in self.out_info))
+
+
+@dataclasses.dataclass
+class ModuleKernelModel:
+    path: str
+    kernels: List[KernelModel] = dataclasses.field(default_factory=list)
+    functions: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+
+
+# --- geometry bindings ------------------------------------------------------
+
+def _flagship_env() -> Dict[str, Any]:
+    from pvraft_tpu.programs import geometries as g
+
+    b, n, k = g.FLAGSHIP_BATCH, g.FLAGSHIP_POINTS, g.FLAGSHIP_TRUNCATE_K
+    corr = ArrayInfo((b, n, k))
+    return {"b": b, "n": n, "k": k, "corr": corr}
+
+
+def _voxel_env() -> Dict[str, Any]:
+    env = _flagship_env()
+    plane = ArrayInfo(env["corr"].shape)
+    env.update(relx=plane, rely=plane, relz=plane,
+               num_levels=3, base_scale=0.25, resolution=3)
+    return env
+
+
+def _fused_env() -> Dict[str, Any]:
+    env = _flagship_env()
+    b, n, k = env["b"], env["n"], env["k"]
+    env.update(xyz=ArrayInfo((b, n, k, 3)), coords=ArrayInfo((b, n, 3)),
+               num_levels=3, base_scale=0.25, resolution=3, knn=32)
+    return env
+
+
+# path suffix (forward slashes) -> {enclosing function: env factory}.
+# The env binds the enclosing function's PARAMETERS at the flagship
+# geometry — the same dims the kernel-tag ProgramSpecs Mosaic-compile at
+# (programs/catalog.py), so the static model and the committed compile
+# evidence describe the same program. A new kernel adds one row (or uses
+# literal dims); an unbound, unevaluable kernel fails the gate via GK000.
+KERNEL_BINDINGS: Dict[str, Dict[str, Callable[[], Dict[str, Any]]]] = {
+    "pvraft_tpu/ops/pallas/voxel_corr.py": {
+        "_voxel_forward_pallas": _voxel_env,
+    },
+    "pvraft_tpu/ops/pallas/corr_lookup.py": {
+        "_fused_forward": _fused_env,
+    },
+}
+
+
+def binding_for(path: str, func: str) -> Dict[str, Any]:
+    norm = path.replace("\\", "/")
+    for suffix, funcs in KERNEL_BINDINGS.items():
+        if norm.endswith(suffix) and func in funcs:
+            return funcs[func]()
+    return {}
+
+
+# --- the safe evaluator -----------------------------------------------------
+
+_SAFE_BUILTINS = {
+    "range": range, "min": min, "max": max, "len": len, "abs": abs,
+    "int": int, "float": float, "sum": sum, "tuple": tuple, "list": list,
+    "enumerate": enumerate, "sorted": sorted, "round": round,
+}
+
+_BINOPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.Div: lambda a, b: a / b,
+    ast.FloorDiv: lambda a, b: a // b,
+    ast.Mod: lambda a, b: a % b,
+    ast.Pow: lambda a, b: a ** b,
+}
+
+
+class _Evaluator:
+    """Evaluates straight-line geometry expressions against an env.
+
+    Module-level helper functions referenced by name (``_pick_tile``)
+    are compiled from their own AST and executed in a namespace holding
+    only :data:`_SAFE_BUILTINS` — real logic, no imports, no jax.
+    """
+
+    def __init__(self, env: Dict[str, Any],
+                 module_funcs: Dict[str, ast.AST]):
+        self.env = env
+        self.module_funcs = module_funcs
+        self._compiled: Dict[str, Callable] = {}
+
+    def eval(self, node: ast.AST) -> Any:
+        method = getattr(self, f"_eval_{type(node).__name__}", None)
+        if method is None:
+            raise EvalError(f"unsupported expression {type(node).__name__}")
+        try:
+            return method(node)
+        except EvalError:
+            raise
+        except Exception as e:  # noqa: BLE001 — a TypeError from
+            # ArrayInfo arithmetic, a ZeroDivisionError in a dim
+            # expression, tuple(<int>) on a scalar block shape: ANY
+            # failure inside the sandbox must surface as a GK000
+            # model-incomplete finding, never crash the gate.
+            raise EvalError(f"{type(e).__name__}: {e}") from e
+
+    # -- leaves --------------------------------------------------------------
+
+    def _eval_Constant(self, node: ast.Constant) -> Any:
+        return node.value
+
+    def _eval_Name(self, node: ast.Name) -> Any:
+        if node.id in self.env:
+            return self.env[node.id]
+        raise EvalError(f"unbound name {node.id!r}")
+
+    def _eval_Attribute(self, node: ast.Attribute) -> Any:
+        if node.attr in _DTYPE_TAILS:
+            return node.attr
+        if node.attr in _DTYPE_ALIASES:
+            return _DTYPE_ALIASES[node.attr]
+        if node.attr == "inf":
+            return math.inf
+        base = self.eval(node.value)
+        if isinstance(base, ArrayInfo) and node.attr in ("shape", "dtype",
+                                                         "ndim", "nbytes"):
+            return getattr(base, node.attr)
+        raise EvalError(f"unsupported attribute .{node.attr}")
+
+    # -- structure -----------------------------------------------------------
+
+    def _eval_Tuple(self, node: ast.Tuple) -> tuple:
+        return tuple(self.eval(e) for e in node.elts)
+
+    def _eval_List(self, node: ast.List) -> list:
+        return [self.eval(e) for e in node.elts]
+
+    def _eval_BinOp(self, node: ast.BinOp) -> Any:
+        op = _BINOPS.get(type(node.op))
+        if op is None:
+            raise EvalError(f"unsupported operator {type(node.op).__name__}")
+        return op(self.eval(node.left), self.eval(node.right))
+
+    def _eval_UnaryOp(self, node: ast.UnaryOp) -> Any:
+        val = self.eval(node.operand)
+        if isinstance(node.op, ast.USub):
+            return -val
+        if isinstance(node.op, ast.UAdd):
+            return +val
+        raise EvalError(f"unsupported unary {type(node.op).__name__}")
+
+    def _eval_Subscript(self, node: ast.Subscript) -> Any:
+        base = self.eval(node.value)
+        key = self._eval_key(node.slice)
+        try:
+            return base[key]
+        except (TypeError, IndexError, KeyError) as e:
+            raise EvalError(f"subscript failed: {e}") from e
+
+    def _eval_key(self, node: ast.AST) -> Any:
+        if isinstance(node, ast.Tuple):
+            return tuple(self._eval_key(e) for e in node.elts)
+        if isinstance(node, ast.Slice):
+            return slice(
+                None if node.lower is None else self.eval(node.lower),
+                None if node.upper is None else self.eval(node.upper),
+                None if node.step is None else self.eval(node.step))
+        val = self.eval(node)
+        return val
+
+    def _eval_IfExp(self, node: ast.IfExp) -> Any:
+        return self.eval(node.body) if self.eval(node.test) \
+            else self.eval(node.orelse)
+
+    def _eval_GeneratorExp(self, node: ast.GeneratorExp) -> Any:
+        return self._comprehend(node.elt, node.generators)
+
+    def _eval_ListComp(self, node: ast.ListComp) -> Any:
+        return self._comprehend(node.elt, node.generators)
+
+    def _comprehend(self, elt: ast.AST, generators) -> list:
+        if len(generators) != 1:
+            raise EvalError("only single-generator comprehensions")
+        gen = generators[0]
+        if not isinstance(gen.target, ast.Name):
+            raise EvalError("only simple comprehension targets")
+        out = []
+        for item in self.eval(gen.iter):
+            sub = _Evaluator(dict(self.env, **{gen.target.id: item}),
+                             self.module_funcs)
+            if all(sub.eval(cond) for cond in gen.ifs):
+                out.append(sub.eval(elt))
+        return out
+
+    # -- calls ---------------------------------------------------------------
+
+    def _eval_Call(self, node: ast.Call) -> Any:
+        tail = _dotted_tail(node.func)
+        if tail == "BlockSpec":
+            return self._block_spec(node)
+        if tail == "ShapeDtypeStruct":
+            shape = tuple(self.eval(node.args[0]))
+            dtype = self.eval(node.args[1]) if len(node.args) > 1 else \
+                "float32"
+            if not isinstance(dtype, str):
+                raise EvalError(f"non-string dtype {dtype!r}")
+            return ArrayInfo(shape, _DTYPE_ALIASES.get(dtype, dtype))
+        if tail == "partial":
+            return self._partial(node)
+        if tail == "interpret_mode":
+            return _InterpretMode()
+        if tail in ("stop_gradient",):
+            return self.eval(node.args[0])
+        if tail == "tuple" and len(node.args) == 1:
+            return tuple(self.eval(node.args[0]))
+        if tail in _SAFE_BUILTINS and isinstance(node.func, ast.Name):
+            args = [self.eval(a) for a in node.args]
+            return _SAFE_BUILTINS[tail](*args)
+        if tail in self.module_funcs and isinstance(node.func, ast.Name):
+            fn = self._compile_module_func(tail)
+            args = [self.eval(a) for a in node.args]
+            kwargs = {kw.arg: self.eval(kw.value)
+                      for kw in node.keywords if kw.arg}
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — helper misuse -> EvalError
+                raise EvalError(f"{tail}() raised {type(e).__name__}: {e}")
+        raise EvalError(f"unsupported call {tail or '<expr>'}()")
+
+    def _block_spec(self, node: ast.Call) -> BlockSpecModel:
+        block_node: Optional[ast.AST] = node.args[0] if node.args else None
+        index_node: Optional[ast.AST] = node.args[1] if len(node.args) > 1 \
+            else None
+        for kw in node.keywords:
+            if kw.arg == "block_shape":
+                block_node = kw.value
+            elif kw.arg == "index_map":
+                index_node = kw.value
+        block = None
+        if block_node is not None and not (
+                isinstance(block_node, ast.Constant)
+                and block_node.value is None):
+            block = tuple(self.eval(block_node))
+            if not all(isinstance(d, int) for d in block):
+                raise EvalError(f"non-integer block shape {block!r}")
+        index_map = index_node if isinstance(index_node, ast.Lambda) else None
+        return BlockSpecModel(block=block, index_map=index_map,
+                              line=node.lineno, col=node.col_offset)
+
+    def _partial(self, node: ast.Call) -> PartialModel:
+        if not node.args:
+            raise EvalError("partial() with no function")
+        func_name = _dotted_tail(node.args[0])
+        kwargs: Dict[str, Any] = {}
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            try:
+                kwargs[kw.arg] = self.eval(kw.value)
+            except EvalError:
+                pass  # best-effort: geometry rules don't need every kwarg
+        return PartialModel(func_name=func_name, kwargs=kwargs)
+
+    def _compile_module_func(self, name: str) -> Callable:
+        if name not in self._compiled:
+            fndef = self.module_funcs[name]
+            mod = ast.Module(body=[fndef], type_ignores=[])
+            ast.fix_missing_locations(mod)
+            ns: Dict[str, Any] = {"__builtins__": dict(_SAFE_BUILTINS)}
+            try:
+                exec(compile(mod, "<kernelcheck>", "exec"), ns)  # noqa: S102
+            except Exception as e:  # noqa: BLE001
+                raise EvalError(
+                    f"helper {name} does not compile standalone: {e}")
+            self._compiled[name] = ns[name]
+        return self._compiled[name]
+
+
+# --- extraction -------------------------------------------------------------
+
+def _propagate(fn: ast.AST, env: Dict[str, Any],
+               module_funcs: Dict[str, ast.AST]) -> _Evaluator:
+    """Sequential constant propagation over the function's top-level
+    straight-line assignments. Unevaluable values are simply left
+    unbound — the rules that need them report precisely what's missing."""
+    ev = _Evaluator(env, module_funcs)
+    for stmt in getattr(fn, "body", ()):
+        if not isinstance(stmt, ast.Assign):
+            continue
+        try:
+            value = ev.eval(stmt.value)
+        except EvalError:
+            continue
+        for target in stmt.targets:
+            if isinstance(target, ast.Name):
+                env[target.id] = value
+            elif isinstance(target, ast.Tuple) and all(
+                    isinstance(e, ast.Name) for e in target.elts):
+                try:
+                    parts = tuple(value)
+                except TypeError:
+                    continue
+                if len(parts) == len(target.elts):
+                    for e, v in zip(target.elts, parts):
+                        env[e.id] = v
+    return ev
+
+
+def _as_spec_list(value: Any) -> Optional[List[BlockSpecModel]]:
+    if isinstance(value, BlockSpecModel):
+        return [value]
+    if isinstance(value, (list, tuple)) and all(
+            isinstance(v, BlockSpecModel) for v in value):
+        return list(value)
+    return None
+
+
+def _as_info_list(value: Any) -> Optional[List[ArrayInfo]]:
+    if isinstance(value, ArrayInfo):
+        return [value]
+    if isinstance(value, (list, tuple)) and all(
+            isinstance(v, ArrayInfo) for v in value):
+        return list(value)
+    return None
+
+
+def _attach_parents(root: ast.AST) -> None:
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            child._gk_parent = node  # type: ignore[attr-defined]
+
+
+def _extract_site(call: ast.Call, fn: ast.FunctionDef, ev: _Evaluator,
+                  path: str, module_funcs: Dict[str, ast.AST]
+                  ) -> KernelModel:
+    model = KernelModel(path=path, line=call.lineno, col=call.col_offset,
+                        func=fn.name)
+
+    # Kernel body: first positional arg, possibly through a partial.
+    if call.args:
+        kernel_arg = call.args[0]
+        name = _dotted_tail(kernel_arg)
+        resolved: Any = None
+        try:
+            resolved = ev.eval(kernel_arg)
+        except EvalError:
+            pass
+        if isinstance(resolved, PartialModel):
+            name = resolved.func_name
+        if name:
+            model.kernel_fn_name = name
+            model.kernel_fn_node = module_funcs.get(name)
+
+    kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+    def need(key: str, convert, required: bool = True):
+        node = kwargs.get(key)
+        if node is None:
+            if required:
+                model.problems.append(f"missing `{key}=` keyword")
+            return None
+        try:
+            value = ev.eval(node)
+        except EvalError as e:
+            model.problems.append(f"`{key}=` not statically evaluable "
+                                  f"({e})")
+            return None
+        out = convert(value)
+        if out is None:
+            model.problems.append(f"`{key}=` evaluated to an unexpected "
+                                  f"{type(value).__name__}")
+        return out
+
+    def as_grid(value):
+        if isinstance(value, int):
+            return (value,)
+        if isinstance(value, tuple) and all(
+                isinstance(v, int) for v in value):
+            return value
+        return None
+
+    model.grid = need("grid", as_grid)
+    model.in_specs = need("in_specs", _as_spec_list)
+    model.out_specs = need("out_specs", _as_spec_list)
+    model.out_info = need("out_shape", _as_info_list)
+    model.interpret_node = kwargs.get("interpret")
+    if model.interpret_node is not None:
+        try:
+            value = ev.eval(model.interpret_node)
+        except EvalError:
+            pass
+        else:
+            model.interpret_resolved = isinstance(value, _InterpretMode)
+
+    scratch_node = kwargs.get("scratch_shapes")
+    if scratch_node is not None:
+        try:
+            value = ev.eval(scratch_node)
+        except EvalError:
+            model.problems.append(
+                "`scratch_shapes=` not statically evaluable")
+        else:
+            infos = _as_info_list(value)
+            if infos is not None:
+                model.scratch = tuple(infos)
+
+    # Operands: the immediate outer call `pl.pallas_call(...)(ops...)`.
+    parent = getattr(call, "_gk_parent", None)
+    if isinstance(parent, ast.Call) and parent.func is call:
+        ops: List[Optional[ArrayInfo]] = []
+        for arg in parent.args:
+            try:
+                value = ev.eval(arg)
+            except EvalError:
+                ops.append(None)
+                continue
+            ops.append(value if isinstance(value, ArrayInfo) else None)
+        model.operands = ops
+        if any(op is None for op in ops):
+            model.problems.append(
+                "some call operands are not statically evaluable")
+    else:
+        model.problems.append(
+            "pallas_call result is not applied at the call site — "
+            "operands unknown")
+    return model
+
+
+def _imported_helpers(tree: ast.Module, path: str) -> Dict[str, ast.AST]:
+    """FunctionDefs imported ``from pvraft_tpu... import name`` resolved
+    from their home module's AST — so a helper like ``_pick_tile``
+    (defined in ``voxel_corr.py``, imported by ``corr_lookup.py``)
+    evaluates in both files. Source-level only: nothing is imported."""
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    if "/pvraft_tpu/" not in norm:
+        return {}
+    root = norm.rsplit("/pvraft_tpu/", 1)[0]
+    out: Dict[str, ast.AST] = {}
+    for stmt in tree.body:
+        if not (isinstance(stmt, ast.ImportFrom) and stmt.module
+                and stmt.module.startswith("pvraft_tpu")):
+            continue
+        target = os.path.join(root, *stmt.module.split(".")) + ".py"
+        try:
+            with open(target, "r", encoding="utf-8-sig") as fh:
+                other = ast.parse(fh.read(), filename=target)
+        except (OSError, SyntaxError):
+            continue
+        wanted = {a.name: a.asname or a.name for a in stmt.names}
+        for node in other.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in wanted:
+                out[wanted[node.name]] = node
+    return out
+
+
+def build_module_kernel_model(tree: ast.Module, source: str,
+                              path: str) -> ModuleKernelModel:
+    """Extract every ``pallas_call`` site's :class:`KernelModel`."""
+    del source  # symmetry with the other engines' builders
+    module = ModuleKernelModel(path=path)
+    module.functions = _imported_helpers(tree, path)
+    module.functions.update({
+        stmt.name: stmt for stmt in tree.body
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+    })
+    _attach_parents(tree)
+    for fn in tree.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sites = [node for node in ast.walk(fn)
+                 if isinstance(node, ast.Call)
+                 and _dotted_tail(node.func) == "pallas_call"]
+        if not sites:
+            continue
+        env = binding_for(path, fn.name)
+        # Function parameters with defaults evaluate too (fixtures).
+        defaults_ev = _Evaluator(dict(env), module.functions)
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults):
+            if arg.arg not in env:
+                try:
+                    env[arg.arg] = defaults_ev.eval(default)
+                except EvalError:
+                    pass
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if default is not None and arg.arg not in env:
+                try:
+                    env[arg.arg] = defaults_ev.eval(default)
+                except EvalError:
+                    pass
+        ev = _propagate(fn, env, module.functions)
+        for call in sites:
+            module.kernels.append(
+                _extract_site(call, fn, ev, path, module.functions))
+    return module

@@ -1,0 +1,525 @@
+"""kernelcheck rules GK001-GK006 — the Pallas/Mosaic failure classes.
+
+The repo has already eaten one silent Mosaic lowering regression (PR 5:
+the fused-lookup kernel's integer-iota ``reduce_min`` argmin stopped
+compiling under toolchain drift) and the fused-GRU campaign (ROADMAP
+item 1) is about to multiply the amount of Pallas code. These rules make
+BlockSpec geometry, tile alignment, VMEM residency and the known Mosaic
+hazard patterns machine-checked surfaces, the way graftlint/deepcheck/
+threadcheck already gate the other layers. Suppress with
+``# graftlint: disable=GKxxx -- reason`` (shared pragma grammar;
+reason-less suppressions fail ``lint --stats``).
+
+Severity discipline (GK001): a *chosen* tile of a larger axis that
+breaks the TPU layout (last dim % 128, second-minor % 8 fp32 / % 16
+bf16) is an ERROR — pick a different tile. A block dim that simply IS
+the whole operand axis (the 81-cell voxel output, a knn=32 column
+block) cannot be re-tiled without changing semantics: those are emitted
+as layout *notes* (``ctx.notes``) — printed, never failing the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
+
+from pvraft_tpu.analysis.engine import Diagnostic, LintContext, Rule
+from pvraft_tpu.analysis.kernels.model import (
+    ArrayInfo,
+    BlockSpecModel,
+    KernelModel,
+    ModuleKernelModel,
+    _dotted_tail,
+)
+
+# The on-chip vector memory a single core can feed a kernel from
+# (v5e/v4 class: ~16 MiB usable per core; the Mosaic default
+# vmem_limit_bytes is in the same band). One number, used by GK002 and
+# the planner.
+VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+
+# Minimal layout tiles per dtype: (sublane, lane). Lane is always 128.
+SUBLANE_MULTIPLE = {"float32": 8, "int32": 8, "uint32": 8,
+                    "bfloat16": 16, "float16": 16,
+                    "int8": 32, "uint8": 32, "bool": 32}
+LANE_MULTIPLE = 128
+
+
+class KernelContext(LintContext):
+    """LintContext + the extracted kernel models + a notes channel.
+
+    ``registered_modules`` is the set of normalized path suffixes that
+    some ``kernel``-tagged ProgramSpec covers (GK005); ``None`` means
+    the caller did not supply registry context and GK005 stays silent.
+    """
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 model: ModuleKernelModel,
+                 registered_modules: Optional[Set[str]] = None):
+        super().__init__(path, source, tree)
+        self.model = model
+        self.registered_modules = registered_modules
+        self.notes: List[Diagnostic] = []
+
+    def note(self, line: int, col: int, rule_id: str, message: str) -> None:
+        d = Diagnostic(self.path, line, col, rule_id, message)
+        if d not in self.notes:
+            self.notes.append(d)
+
+
+class KernelRule(Rule):
+    """Base for GK rules: sees one file's :class:`KernelContext`."""
+
+    def check(self, ctx: KernelContext) -> Iterable[Diagnostic]:
+        raise NotImplementedError
+
+
+_GK_REGISTRY: List[Type[KernelRule]] = []
+
+
+def gk_register(cls: Type[KernelRule]) -> Type[KernelRule]:
+    if not cls.id or not cls.title:
+        raise ValueError(f"rule {cls.__name__} must set id and title")
+    if any(r.id == cls.id for r in _GK_REGISTRY):
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _GK_REGISTRY.append(cls)
+    return cls
+
+
+def all_kernel_rules() -> Tuple[Type[KernelRule], ...]:
+    return tuple(sorted(_GK_REGISTRY, key=lambda r: r.id))
+
+
+# --- GK001 ----------------------------------------------------------------
+
+@gk_register
+class TileMisalignment(KernelRule):
+    """Block tile breaks the TPU (sublane, lane) layout.
+
+    VMEM blocks are laid out in (sublane x 128-lane) tiles — (8, 128)
+    for fp32, (16, 128) for bf16. A block whose last dim is not a
+    multiple of 128 (or second-minor not a multiple of the dtype
+    sublane) is padded per tile: wasted lanes, and historically the
+    geometry most likely to hit Mosaic lowering edge cases. A *chosen*
+    tile of a larger axis is an error (re-tile it); a block dim that
+    equals the whole operand axis is geometry-inherent and only noted.
+    """
+
+    id = "GK001"
+    title = "tile-misalignment"
+
+    def check(self, ctx: KernelContext) -> Iterable[Diagnostic]:
+        for km in ctx.model.kernels:
+            for role, spec, op in km.io_pairs():
+                if spec.block is None or len(spec.block) < 1:
+                    continue
+                yield from self._dim(ctx, km, role, spec, op,
+                                     -1, LANE_MULTIPLE, "last (lane)")
+                if len(spec.block) >= 2:
+                    sub = SUBLANE_MULTIPLE.get(op.dtype, 8)
+                    yield from self._dim(ctx, km, role, spec, op,
+                                         -2, sub, "second-minor (sublane)")
+
+    def _dim(self, ctx: KernelContext, km: KernelModel, role: str,
+             spec: BlockSpecModel, op: ArrayInfo, axis: int,
+             multiple: int, label: str) -> Iterable[Diagnostic]:
+        block_d = spec.block[axis]
+        if block_d % multiple == 0:
+            return
+        if block_d == 1:
+            # A squeezed/batch-like dim (the leading `1` of a (1, T, K)
+            # block, a row-per-step pattern): padded but deliberate and
+            # universally supported — never a misalignment finding.
+            return
+        operand_d = op.shape[axis] if len(op.shape) >= abs(axis) else None
+        msg = (f"{role} block {spec.block} {label} dim {block_d} is not a "
+               f"multiple of {multiple} for {op.dtype}")
+        if operand_d == block_d:
+            # The block spans the whole axis: inherent to the operand
+            # geometry, padded to one layout tile — note, don't fail.
+            ctx.note(spec.line, spec.col, self.id,
+                     msg + " (whole-axis block: geometry-inherent, padded "
+                           "in VMEM — consider packing small feature axes "
+                           "if this block dominates)")
+            return
+        tiled = (f" while tiling an axis of {operand_d}"
+                 if operand_d is not None
+                 else " (block rank exceeds the operand's)")
+        yield Diagnostic(
+            ctx.path, spec.line, spec.col, self.id,
+            msg + tiled + " — the chosen tile forces per-block padding "
+                  f"and relayout; pick a multiple of {multiple}")
+
+
+# --- GK002 ----------------------------------------------------------------
+
+@gk_register
+class VmemBudget(KernelRule):
+    """Static VMEM footprint exceeds the per-core budget.
+
+    Every grid-streamed input/output block is double-buffered by the
+    pipeline (next block loads behind compute), plus single-buffered
+    scratch. If 2 x sum(block bytes) + scratch > ~16 MiB the kernel
+    cannot stay resident and Mosaic either spills or refuses; this
+    surfaces at lowering time on a real toolchain but silently at HEAD
+    without one.
+    """
+
+    id = "GK002"
+    title = "vmem-budget-exceeded"
+
+    def check(self, ctx: KernelContext) -> Iterable[Diagnostic]:
+        for km in ctx.model.kernels:
+            est = km.vmem_estimate_bytes()
+            if est is None:
+                continue
+            if est > VMEM_BUDGET_BYTES:
+                yield Diagnostic(
+                    ctx.path, km.line, km.col, self.id,
+                    f"kernel `{km.kernel_fn_name or km.func}` needs "
+                    f"~{est / 2**20:.1f} MiB of VMEM (double-buffered "
+                    f"blocks + scratch) against the "
+                    f"~{VMEM_BUDGET_BYTES / 2**20:.0f} MiB/core budget — "
+                    f"shrink the block tile or split the kernel")
+
+
+# --- GK003 ----------------------------------------------------------------
+
+def _index_map_roles(spec: BlockSpecModel,
+                     n_grid: int) -> Optional[List[Tuple[str, int]]]:
+    """Per block dim: ("axis", grid_pos) | ("const", value) | ("expr", 0).
+    None when the lambda shape itself is malformed for the grid."""
+    lam = spec.index_map
+    if lam is None:
+        return None
+    params = [a.arg for a in lam.args.args]
+    if len(params) != n_grid:
+        return None
+    body = lam.body
+    elts: Sequence[ast.AST]
+    if isinstance(body, ast.Tuple):
+        elts = body.elts
+    else:
+        elts = [body]
+    roles: List[Tuple[str, int]] = []
+    for e in elts:
+        if isinstance(e, ast.Name) and e.id in params:
+            roles.append(("axis", params.index(e.id)))
+        elif isinstance(e, ast.Constant) and isinstance(e.value, int):
+            roles.append(("const", e.value))
+        else:
+            roles.append(("expr", 0))
+    return roles
+
+
+@gk_register
+class GridCoverageMismatch(KernelRule):
+    """grid x block under- or over-covers an operand axis.
+
+    For an identity-mapped dim, ``block[d] * grid[g]`` must equal the
+    operand's axis: less leaves a remainder the kernel never touches
+    (silently wrong output — there is no masked remainder in these
+    kernels), more reads/writes out of bounds (padded reads, dropped
+    writes — also silently wrong). For a constant-0 dim the block must
+    span the whole axis.
+    """
+
+    id = "GK003"
+    title = "grid-coverage-mismatch"
+
+    def check(self, ctx: KernelContext) -> Iterable[Diagnostic]:
+        for km in ctx.model.kernels:
+            if km.grid is None:
+                continue
+            for role, spec, op in km.io_pairs():
+                if spec.block is None:
+                    continue
+                roles = _index_map_roles(spec, len(km.grid))
+                if roles is None or len(roles) != len(spec.block) \
+                        or len(spec.block) != len(op.shape):
+                    continue
+                for d, (kind, val) in enumerate(roles):
+                    block_d = spec.block[d]
+                    if kind == "axis":
+                        covered = block_d * km.grid[val]
+                        if covered != op.shape[d]:
+                            how = "under" if covered < op.shape[d] else "over"
+                            yield Diagnostic(
+                                ctx.path, spec.line, spec.col, self.id,
+                                f"{role} dim {d}: block {block_d} x grid "
+                                f"axis {val} ({km.grid[val]} steps) covers "
+                                f"{covered} of the operand's {op.shape[d]} "
+                                f"— {how}-coverage with no masked "
+                                f"remainder; fix the grid/tile or mask "
+                                f"the tail block")
+                    elif kind == "const" and val == 0:
+                        if block_d != op.shape[d]:
+                            how = ("under" if block_d < op.shape[d]
+                                   else "over")
+                            yield Diagnostic(
+                                ctx.path, spec.line, spec.col, self.id,
+                                f"{role} dim {d}: constant-indexed block "
+                                f"of {block_d} against an operand axis of "
+                                f"{op.shape[d]} — {how}-coverage; a "
+                                f"constant index map must span the axis")
+
+
+# --- GK004 ----------------------------------------------------------------
+
+_REDUCE_MINMAX = {"min", "max", "argmin", "argmax", "reduce_min",
+                  "reduce_max"}
+_INT_DTYPES = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+               "uint32", "uint64"}
+_FLOAT_DTYPES = {"float32", "bfloat16", "float16"}
+
+
+def _dtype_of_node(node: ast.AST) -> Optional[str]:
+    tail = _dotted_tail(node)
+    if tail in _INT_DTYPES or tail in _FLOAT_DTYPES or tail == "float64":
+        return tail
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _float_cast_covered(expr: ast.AST) -> Set[int]:
+    """ids of every node living under an ``.astype(<float dtype>)`` call
+    — an integer iota inside one of these is sanctioned (the PR-5 fix),
+    wherever the cast sits in a compound expression."""
+    covered: Set[int] = set()
+    for node in ast.walk(expr):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and node.args
+                and _dtype_of_node(node.args[0]) in _FLOAT_DTYPES):
+            for sub in ast.walk(node.func.value):
+                covered.add(id(sub))
+    return covered
+
+
+def _uncast_int_iotas(expr: ast.AST) -> List[ast.Call]:
+    """Integer-dtype iota calls in ``expr`` NOT covered by a float
+    astype anywhere above them."""
+    covered = _float_cast_covered(expr)
+    out: List[ast.Call] = []
+    for call in ast.walk(expr):
+        if (isinstance(call, ast.Call)
+                and _dotted_tail(call.func) in ("broadcasted_iota", "iota")
+                and id(call) not in covered):
+            dtype = _dtype_of_node(call.args[0]) if call.args else None
+            if dtype is None or dtype in _INT_DTYPES:
+                out.append(call)
+    return out
+
+
+def _int_iota_names(fn: ast.AST) -> Set[str]:
+    """Locals assigned from an INTEGER iota that is not float-cast
+    anywhere in the assignment expression (the PR-5 pre-fix shape).
+    `x = broadcasted_iota(jnp.int32, ...)` is tracked;
+    `x = broadcasted_iota(jnp.int32, ...).astype(jnp.float32)` — and
+    any compound expression around that cast — is not; neither is a
+    name whose cast is a separate reassignment
+    (`x = x.astype(jnp.float32)`), the fix written as two statements."""
+    names: Set[str] = set()
+    recast: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        tainted = bool(_uncast_int_iotas(node.value))
+        has_float_cast = bool(_float_cast_covered(node.value))
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                if tainted:
+                    names.add(t.id)
+                elif has_float_cast:
+                    recast.add(t.id)
+    # Un-tainting is the safe direction: a missed finding here is still
+    # caught by the deviceless Mosaic compile gate; a false finding
+    # would force a pragma on the rule's own recommended fix.
+    return names - recast
+
+
+def _hazard_int_reduce(fn: ast.AST) -> Iterable[Tuple[int, int, str]]:
+    """Integer-dtype min/max/arg-extremum reductions — the exact class
+    that silently stopped lowering in PR 5."""
+    int_names = _int_iota_names(fn)
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and _dotted_tail(node.func) in _REDUCE_MINMAX
+                and node.args):
+            continue
+        arg = node.args[0]
+        mentioned = {n.id for n in ast.walk(arg) if isinstance(n, ast.Name)}
+        if mentioned & int_names:
+            which = sorted(mentioned & int_names)[0]
+            yield (node.lineno, node.col_offset,
+                   f"`{_dotted_tail(node.func)}` reduction over integer "
+                   f"iota `{which}` — Mosaic has no integer min/max "
+                   f"reduction lowering (the PR-5 regression class); "
+                   f"generate the iota as i32 and `.astype` it to f32 "
+                   f"before reducing (f32 is exact to 2^24)")
+            continue
+        if _uncast_int_iotas(arg):
+            yield (node.lineno, node.col_offset,
+                   f"`{_dotted_tail(node.func)}` reduction over an "
+                   f"inline integer iota — cast the iota to f32 "
+                   f"first (PR-5 regression class)")
+
+
+def _hazard_1d_iota(fn: ast.AST) -> Iterable[Tuple[int, int, str]]:
+    """1D iota generation: Mosaic requires >= 2D iota on TPU."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _dotted_tail(node.func)
+        if tail == "arange":
+            yield (node.lineno, node.col_offset,
+                   "`arange` in a kernel body produces a 1D iota — Mosaic "
+                   "requires >= 2D; use `lax.broadcasted_iota` with an "
+                   "explicit dimension")
+        elif tail == "iota" and len(node.args) >= 2:
+            # lax.iota(dtype, size) is always rank-1.
+            yield (node.lineno, node.col_offset,
+                   "`lax.iota` is rank-1 — Mosaic requires >= 2D iota; "
+                   "use `lax.broadcasted_iota`")
+        elif tail == "broadcasted_iota" and len(node.args) >= 2:
+            shape = node.args[1]
+            if isinstance(shape, ast.Tuple) and len(shape.elts) == 1:
+                yield (node.lineno, node.col_offset,
+                       "`broadcasted_iota` over a rank-1 shape — Mosaic "
+                       "requires >= 2D iota; keep the block rank >= 2")
+
+
+def _hazard_f64_cast(fn: ast.AST) -> Iterable[Tuple[int, int, str]]:
+    """float64 anywhere in a kernel body: TPU has no f64 — the cast
+    either fails to lower or silently truncates under x64 config."""
+    for node in ast.walk(fn):
+        if _dotted_tail(node) == "float64":
+            yield (node.lineno, node.col_offset,
+                   "float64 in a kernel body — TPU/Mosaic has no f64; "
+                   "use float32 (exact for indices to 2^24)")
+
+
+# Extensible pattern table: (hazard id, matcher over one kernel-body
+# FunctionDef). New Mosaic hazards learned from toolchain drift get a
+# row here plus a red/green fixture under tests/fixtures/kernelcheck/.
+MOSAIC_HAZARDS: Tuple[Tuple[str, object], ...] = (
+    ("int-minmax-reduce", _hazard_int_reduce),
+    ("iota-1d", _hazard_1d_iota),
+    ("float64-cast", _hazard_f64_cast),
+)
+
+
+@gk_register
+class MosaicLoweringHazard(KernelRule):
+    """Known Mosaic lowering hazard pattern in a kernel body.
+
+    An extensible table (:data:`MOSAIC_HAZARDS`) of op shapes that have
+    broken (or are documented unsupported) in the Mosaic TPU lowering:
+    integer min/max reductions (the PR-5 silent regression), 1D iota,
+    float64 casts. The deviceless compile gate catches these too — but
+    only on hosts with a libtpu; this rule fails them everywhere,
+    pattern-first, with the fix in the message.
+    """
+
+    id = "GK004"
+    title = "mosaic-lowering-hazard"
+
+    def check(self, ctx: KernelContext) -> Iterable[Diagnostic]:
+        seen: Set[Tuple[int, int, str]] = set()
+        bodies: Dict[str, ast.AST] = {}
+        for km in ctx.model.kernels:
+            if km.kernel_fn_node is not None:
+                bodies.setdefault(km.kernel_fn_name, km.kernel_fn_node)
+                # Same-module helpers called from the kernel body run
+                # inside the kernel too (voxel_level_means).
+                for node in ast.walk(km.kernel_fn_node):
+                    if isinstance(node, ast.Call):
+                        callee = _dotted_tail(node.func)
+                        helper = ctx.model.functions.get(callee)
+                        if helper is not None:
+                            bodies.setdefault(callee, helper)
+        for name, fn in sorted(bodies.items()):
+            for hazard_id, matcher in MOSAIC_HAZARDS:
+                for line, col, msg in matcher(fn):  # type: ignore[operator]
+                    key = (line, col, hazard_id)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Diagnostic(
+                        ctx.path, line, col, self.id,
+                        f"[{hazard_id}] in kernel body `{name}`: {msg}")
+
+
+# --- GK005 ----------------------------------------------------------------
+
+@gk_register
+class UnregisteredKernel(KernelRule):
+    """``pallas_call`` entry point with no ``kernel``-tagged ProgramSpec.
+
+    The deviceless Mosaic compile gate (``programs compile --tag
+    kernel``) only certifies what the registry enumerates: a Pallas
+    kernel module no ``kernel``-tagged spec imports is invisible to the
+    gate — the exact shape under which the PR-5 regression rotted at
+    HEAD. Register fwd (and VJP, if custom) specs in
+    ``programs/catalog.py``.
+    """
+
+    id = "GK005"
+    title = "unregistered-kernel"
+
+    def check(self, ctx: KernelContext) -> Iterable[Diagnostic]:
+        if ctx.registered_modules is None or not ctx.model.kernels:
+            return
+        norm = ctx.norm_path
+        if any(norm.endswith(suffix) for suffix in ctx.registered_modules):
+            return
+        km = min(ctx.model.kernels, key=lambda k: (k.line, k.col))
+        yield Diagnostic(
+            ctx.path, km.line, km.col, self.id,
+            "this module's pallas_call has no `kernel`-tagged ProgramSpec "
+            "— the deviceless Mosaic compile gate cannot see it and "
+            "toolchain drift will rot silently; register it in "
+            "pvraft_tpu/programs/catalog.py")
+
+
+# --- GK006 ----------------------------------------------------------------
+
+@gk_register
+class InterpretModeLeak(KernelRule):
+    """``pallas_call`` without the ``interpret_mode()`` escape hatch.
+
+    CPU tier-1 (and the host leg of the cost inventory) runs every
+    kernel through the Pallas interpreter via
+    ``interpret=interpret_mode()`` (``PVRAFT_PALLAS_INTERPRET``). A
+    site that hardcodes ``interpret=False`` (or omits the kwarg) can
+    never run in CI; ``interpret=True`` silently benchmarks the
+    interpreter on TPU. Wire the shared helper.
+    """
+
+    id = "GK006"
+    title = "interpret-mode-leak"
+
+    def check(self, ctx: KernelContext) -> Iterable[Diagnostic]:
+        for km in ctx.model.kernels:
+            if km.interpret_resolved:
+                continue  # `interp = interpret_mode()` local spelling
+            node = km.interpret_node
+            if node is not None and any(
+                    isinstance(n, ast.Call)
+                    and _dotted_tail(n.func) == "interpret_mode"
+                    for n in ast.walk(node)):
+                continue
+            if node is None:
+                detail = "has no `interpret=` keyword"
+            elif isinstance(node, ast.Constant):
+                detail = f"hardcodes `interpret={node.value!r}`"
+            else:
+                detail = "computes `interpret=` without interpret_mode()"
+            yield Diagnostic(
+                ctx.path, km.line, km.col, self.id,
+                f"pallas_call {detail} — route it through "
+                f"`pvraft_tpu.ops.pallas.interpret_mode()` so CPU tier-1 "
+                f"interprets and TPU compiles (PVRAFT_PALLAS_INTERPRET "
+                f"escape hatch)")

@@ -7,6 +7,15 @@ interpreter (CPU tests) or compiles through Mosaic (TPU). The
 deviceless-compile the kernels against a TPU topology from a CPU host
 (the backend there is cpu, but the target is tpu) — and ``1`` forces the
 interpreter.
+
+Contract (machine-checked): every ``pallas_call`` in this package passes
+``interpret=interpret_mode()`` (kernelcheck rule GK006 — a hardcoded or
+missing kwarg either bricks CPU tier-1 or silently benchmarks the
+interpreter on TPU), registers ``kernel``-tagged ProgramSpecs in
+``programs/catalog.py`` so the deviceless Mosaic compile gate sees it
+(GK005), and models statically at its certified geometry — literal dims
+or a ``KERNEL_BINDINGS`` row in ``analysis/kernels/model.py`` (GK000).
+``python -m pvraft_tpu.analysis kernels`` is the gate.
 """
 
 from __future__ import annotations

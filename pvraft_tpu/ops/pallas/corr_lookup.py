@@ -23,6 +23,14 @@ wins (torch ``topk`` tie order differs; bit-level only, SURVEY.md §7).
 Gradients flow to ``corr`` only (geometry is under ``no_grad`` in the
 reference, and the model stop-gradients coords before the lookup);
 backward recomputes selections with XLA ops.
+
+Statically analyzed: kernelcheck models the ``pallas_call`` site below
+at the flagship geometry via the ``KERNEL_BINDINGS`` row keyed on
+``_fused_forward`` and its parameter names (the float-valued-iota argmin
+below is exactly the shape its GK004 hazard table guards — the integer
+pre-fix form is pinned DETECTED in ``tests/fixtures/kernelcheck/``). A
+rename or geometry change here must keep that row in sync; the gate
+fails with GK000 otherwise, never silently.
 """
 
 from __future__ import annotations

@@ -20,6 +20,13 @@ Layout notes:
 
 Deterministic by construction (fixed reduction order), unlike CUDA
 scatter-add atomics — see SURVEY.md §5 "race detection".
+
+Statically analyzed: kernelcheck (``python -m pvraft_tpu.analysis
+kernels``) models the ``pallas_call`` site below at the flagship
+geometry via the ``KERNEL_BINDINGS`` row keyed on
+``_voxel_forward_pallas`` and its parameter names — a rename or
+geometry change here must keep that row in sync (the gate fails with
+GK000 otherwise, never silently).
 """
 
 from __future__ import annotations
@@ -38,7 +45,12 @@ pl = import_pallas()
 
 
 def _pick_tile(n: int, target: int = 64) -> int:
-    """Largest divisor of n that is <= target (prefer multiples of 8)."""
+    """Largest divisor of n that is <= target (prefer multiples of 8 —
+    the fp32 sublane quantum, so the (tile, K) block maps onto whole
+    (8, 128) layout tiles; kernelcheck GK001 errors on misaligned
+    *chosen* tiles). kernelcheck evaluates this helper from its AST
+    (never imports this module) when modeling the launch geometry, so
+    keep it dependency-free pure Python."""
     best = 1
     for t in range(1, min(n, target) + 1):
         if n % t == 0 and (t % 8 == 0 or t == n or best < 8):

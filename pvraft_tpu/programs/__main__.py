@@ -123,6 +123,20 @@ def _cmd_compile(args) -> int:
         topology_devices,
     )
 
+    if args.check:
+        # Validate a committed kernel-compile artifact (schema sanity +
+        # both-direction coverage vs the kernel-tag registry) — no
+        # toolchain, no compiles; the lint.sh drift pin.
+        from pvraft_tpu.programs.compile import validate_kernels_file
+
+        problems = validate_kernels_file(args.check)
+        for p in problems:
+            print(p, file=sys.stderr)
+        if not problems:
+            print(f"{args.check}: OK (kernel-tag registry coverage, both "
+                  "directions)")
+        return 1 if problems else 0
+
     pin_cpu_host()
     sel = [s for s in _selected(args) if s.topology]
     if not sel:
@@ -264,6 +278,10 @@ def main(argv=None) -> int:
     p_comp.add_argument("--allow-missing-toolchain", action="store_true",
                         help="exit 0 (loudly) when libtpu cannot provide "
                              "the compile topology")
+    p_comp.add_argument("--check", default="", metavar="ARTIFACT",
+                        help="validate a committed kernel-compile "
+                             "artifact (both-direction coverage vs the "
+                             "kernel-tag registry) instead of compiling")
     p_comp.set_defaults(fn=_cmd_compile)
 
     p_costs = sub.add_parser(

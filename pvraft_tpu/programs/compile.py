@@ -205,3 +205,76 @@ def run_compile(
             [f for f in os.listdir(cache_dir) if not f.startswith(".")])
     rec["ok"] = all(r["ok"] or r.get("expected_failure") for r in results)
     return rec
+
+
+# ---------------------------------------------------------------- validate --
+
+def validate_kernels_artifact(doc, specs, path: str = "<kernels>",
+                              topology: str = TOPOLOGY):
+    """Problems of a committed kernel-compile artifact
+    (``artifacts/programs_kernels.json``) against the live kernel-tag
+    registry — both directions, the ``programs_list.txt`` discipline.
+    Until now this evidence could drift silently: a kernel spec added
+    (or renamed) after the last ``compile --tag kernel --out`` run left
+    an artifact that still LOOKED like full Mosaic coverage. Returns
+    ``[]`` when every kernel-tagged spec has a successful record and
+    every record names a live spec at the declared topology."""
+    if not isinstance(doc, dict):
+        return [f"{path}: artifact is {type(doc).__name__}, not an object"]
+    problems = []
+    if doc.get("topology") != topology:
+        problems.append(
+            f"{path}: topology {doc.get('topology')!r} != the declared "
+            f"compile target {topology!r}")
+    programs = doc.get("programs")
+    if not isinstance(programs, list):
+        problems.append(f"{path}: missing/invalid 'programs' list")
+        return problems
+    records = {}
+    for i, r in enumerate(programs):
+        if not isinstance(r, dict) or not isinstance(r.get("name"), str):
+            problems.append(f"{path}: programs[{i}] is not an object with "
+                            "a 'name'")
+            continue
+        if r["name"] in records:
+            problems.append(f"{path}: duplicate record {r['name']!r}")
+        records[r["name"]] = r
+    want = {s.name: s for s in specs if "kernel" in s.tags and s.topology}
+    for name in sorted(set(want) - set(records)):
+        problems.append(
+            f"{path}: kernel spec {name!r} has no compile record — the "
+            f"Mosaic evidence drifted; regenerate: python -m "
+            f"pvraft_tpu.programs compile --tag kernel --out {path}")
+    for name in sorted(set(records) - set(want)):
+        problems.append(
+            f"{path}: record {name!r} names no live kernel-tagged spec "
+            "(stale artifact) — regenerate")
+    for name in sorted(set(want) & set(records)):
+        r = records[name]
+        if not (r.get("ok") or r.get("expected_failure")):
+            problems.append(
+                f"{path}: {name}: recorded compile FAILED "
+                f"({str(r.get('error', 'no error recorded'))[:200]})")
+        elif r.get("ok") and not isinstance(r.get("memory"), dict):
+            problems.append(
+                f"{path}: {name}: missing memory analysis — the VMEM/"
+                "roofline planner cross-validates against it")
+    return problems
+
+
+def validate_kernels_file(path: str):
+    """Validate a committed kernel-compile artifact against the LIVE
+    registry (coverage is the whole point of the check — an empty spec
+    list would flag every record as stale, so there is no opt-out)."""
+    import json
+
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable: {e}"]
+    from pvraft_tpu.programs import load_catalog, specs as registry
+
+    load_catalog()
+    return validate_kernels_artifact(doc, list(registry().values()),
+                                     path=path)

@@ -40,6 +40,10 @@ BUDGETS = (
     # capture (256 requests, 100% sampled) sits near 600 KiB.
     ("artifacts/*.events.jsonl", 768 * 1024),
     ("artifacts/*.jsonl", 128 * 1024),
+    # The VMEM/roofline plan is a small pure-function-of-inputs record
+    # (pvraft_kernel_plan/v1, regenerate-and-compare pinned by lint.sh);
+    # growth here means the planner started dumping, not planning.
+    ("artifacts/kernel_plan.json", 32 * 1024),
     # Structured reports (costs inventory, SLO, loadgen, convergence).
     ("artifacts/*.json", 128 * 1024),
     ("artifacts/*.log", 64 * 1024),

@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
-# Static-analysis gate: graftlint AST rules + eval_shape trace-compat audit.
-# Runs before training jobs (run.sh) and as the standing gate for
-# kernel/sharding PRs (ROADMAP.md). Exits non-zero on any finding.
+# Static-analysis gate: graftlint AST rules, threadcheck, kernelcheck,
+# the registry verify/deepcheck/Mosaic-compile legs and the committed-
+# artifact validators. Runs before training jobs (run.sh) and as the
+# standing gate for kernel/sharding PRs (ROADMAP.md). Exits non-zero on
+# any finding.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -26,6 +28,37 @@ echo "== threadcheck: concurrency static analysis (GC rules) over serve/obs/load
 # serve/obs locks into OrderedLocks, so the threaded tier-1 tests
 # double as a runtime lock-order sanitizer run.
 python -m pvraft_tpu.analysis concurrency
+
+echo "== kernelcheck: Pallas/Mosaic static analysis (GK rules) over ops/pallas"
+# The fourth analysis engine (ISSUE 12): tile alignment vs the TPU
+# (sublane, lane) layout (GK001), static double-buffered VMEM budget
+# (GK002), grid x block coverage (GK003), the Mosaic lowering hazard
+# table — integer min/max reductions, the PR-5 regression class; 1D
+# iota; f64 casts — (GK004), kernel-tag registry coverage (GK005), and
+# the interpret_mode() escape hatch the CPU tier relies on (GK006).
+# Zero findings on the clean tree — real violations get fixed (the
+# deepcheck/threadcheck precedent), not pragma'd. Pure stdlib AST, no
+# jax import; layout notes (whole-axis small blocks) print but never
+# fail.
+python -m pvraft_tpu.analysis kernels
+
+echo "== kernelcheck: committed VMEM/roofline plan matches the static model"
+# artifacts/kernel_plan.json (pvraft_kernel_plan/v1) is a pure function
+# of the static kernel models + the committed cost inventory: this
+# regenerates it and compares, enforcing on the way that
+# every kernel-tag spec's static HBM estimate agrees with the real
+# deviceless Mosaic memory_analysis within the pinned factor (2.0) —
+# the cross-validation that keeps the fused-GRU residency verdict
+# honest before the kernel is written (ROADMAP item 1).
+python -m pvraft_tpu.analysis kernels --check artifacts/kernel_plan.json
+
+echo "== programs: committed kernel-compile evidence covers the kernel tag"
+# artifacts/programs_kernels.json must name exactly the kernel-tagged
+# registry specs, each with a successful Mosaic compile record — both
+# directions (the programs_list.txt / programs_costs.json drift
+# discipline; until now this evidence could go stale silently). Pure
+# validation — no toolchain, no compiles.
+python -m pvraft_tpu.programs compile --check artifacts/programs_kernels.json
 
 # 8 virtual CPU devices (appended to any caller-set XLA_FLAGS) so the
 # ring audit entries trace with a REAL 2-shard seq axis — the programs

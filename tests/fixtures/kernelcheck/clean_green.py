@@ -1,0 +1,35 @@
+"""GREEN: a fully aligned, fully covered, interpreter-gated kernel.
+
+Parsed by kernelcheck tests, never executed. Literal dims so the static
+model needs no geometry binding: blocks (1, 64, 128) over (2, 1024,
+128) with grid (2, 16) — lane dim a multiple of 128, sublane a multiple
+of 8, exact coverage, tiny VMEM footprint.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.compat import import_pallas
+from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
+
+
+def _scale_kernel(x_ref, o_ref, *, gain):
+    o_ref[0] = x_ref[0] * gain
+
+
+def clean_scale():
+    x = jax.ShapeDtypeStruct((2, 1024, 128), jnp.float32)
+    kernel = functools.partial(_scale_kernel, gain=2.0)
+    spec = pl.BlockSpec((1, 64, 128), lambda bi, ni: (bi, ni, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(2, 16),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((2, 1024, 128), jnp.float32),
+        interpret=interpret_mode(),
+    )(x)

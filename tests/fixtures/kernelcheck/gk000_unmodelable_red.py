@@ -1,0 +1,33 @@
+"""RED (GK000): a pallas_call whose geometry cannot be modeled.
+
+Parsed, never executed. The dims come from an argument with no literal
+value and no ``KERNEL_BINDINGS`` row — the extractor cannot evaluate
+the grid or blocks, and the driver must fail the site loudly (a new
+kernel either models cleanly or fails the gate; it cannot silently
+skip analysis the way the PR-5 regression skipped the compile gate).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.compat import import_pallas
+from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[0] = x_ref[0]
+
+
+def dynamic_geometry(x, tile):
+    b, n, k = x.shape
+    spec = pl.BlockSpec((1, tile, k), lambda bi, ni: (bi, ni, 0))
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(b, n // tile),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, k), jnp.float32),
+        interpret=interpret_mode(),
+    )(x)

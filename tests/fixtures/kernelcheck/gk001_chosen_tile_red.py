@@ -1,0 +1,48 @@
+"""RED (GK001): chosen tiles that break the TPU (sublane, lane) layout.
+
+Parsed, never executed. Two distinct misalignments, both on *chosen*
+tiles of larger axes (so they are errors, not whole-axis layout notes):
+
+* ``_sublane``: second-minor block dim 60 tiles an axis of 1920 — not a
+  multiple of 8 for fp32;
+* ``_lane``: last block dim 100 tiles an axis of 400 — not a multiple
+  of 128.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.compat import import_pallas
+from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[0] = x_ref[0]
+
+
+def misaligned_sublane():
+    x = jax.ShapeDtypeStruct((2, 1920, 128), jnp.float32)
+    spec = pl.BlockSpec((1, 60, 128), lambda bi, ni: (bi, ni, 0))
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(2, 32),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((2, 1920, 128), jnp.float32),
+        interpret=interpret_mode(),
+    )(x)
+
+
+def misaligned_lane():
+    x = jax.ShapeDtypeStruct((2, 64, 400), jnp.float32)
+    spec = pl.BlockSpec((1, 64, 100), lambda bi, ki: (bi, 0, ki))
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(2, 4),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((2, 64, 400), jnp.float32),
+        interpret=interpret_mode(),
+    )(x)

@@ -1,0 +1,31 @@
+"""RED (GK002): double-buffered blocks that blow the VMEM budget.
+
+Parsed, never executed. One (1, 1024, 2048) fp32 block is 8 MiB;
+double-buffered in + out is 32 MiB against the ~16 MiB/core budget —
+Mosaic would spill or refuse at lowering time; the gate refuses first.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.compat import import_pallas
+from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[0] = x_ref[0]
+
+
+def oversized_blocks():
+    x = jax.ShapeDtypeStruct((4, 1024, 2048), jnp.float32)
+    spec = pl.BlockSpec((1, 1024, 2048), lambda bi: (bi, 0, 0))
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(4,),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((4, 1024, 2048), jnp.float32),
+        interpret=interpret_mode(),
+    )(x)

@@ -1,0 +1,46 @@
+"""RED (GK003): grid x block under- and over-covering an operand.
+
+Parsed, never executed. ``under_covered``: 15 grid steps of 64 rows
+cover 960 of 1000 — the last 40 rows are never computed and the output
+tail is garbage, silently. ``over_covered``: 16 steps of 64 cover 1024
+of 1000 — the tail block reads out of bounds (padded) and its writes
+are dropped, also silently. Neither kernel masks a remainder.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.compat import import_pallas
+from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[0] = x_ref[0]
+
+
+def under_covered():
+    x = jax.ShapeDtypeStruct((2, 1000, 128), jnp.float32)
+    spec = pl.BlockSpec((1, 64, 128), lambda bi, ni: (bi, ni, 0))
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(2, 15),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((2, 1000, 128), jnp.float32),
+        interpret=interpret_mode(),
+    )(x)
+
+
+def over_covered():
+    x = jax.ShapeDtypeStruct((2, 1000, 128), jnp.float32)
+    spec = pl.BlockSpec((1, 64, 128), lambda bi, ni: (bi, ni, 0))
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(2, 16),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((2, 1000, 128), jnp.float32),
+        interpret=interpret_mode(),
+    )(x)

@@ -1,0 +1,41 @@
+"""GREEN (GK004): the current (PR-5 fixed) float-iota argmin shape.
+
+Parsed, never executed. The sanctioned fix for the integer-min-
+reduction hazard: the iota is *generated* as i32 (Mosaic only supports
+32-bit integer iota generation) and immediately ``.astype`` to f32 at
+the assignment, so every reduction over it is a float reduction — and
+f32 represents candidate indices exactly up to 2^24, far beyond any K
+here, so first-of-ties semantics are unchanged. Must stay CLEAN.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pvraft_tpu.compat import import_pallas
+from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
+
+
+def _argmin_kernel(dist_ref, o_ref):
+    dist = dist_ref[0]
+    iota = lax.broadcasted_iota(
+        jnp.int32, dist.shape, 1).astype(jnp.float32)
+    cap = jnp.asarray(float(dist.shape[-1]), jnp.float32)
+    m = jnp.min(dist, axis=-1, keepdims=True)
+    eq = dist == m
+    first = jnp.min(jnp.where(eq, iota, cap), axis=-1)
+    o_ref[0] = first
+
+
+def float_argmin():
+    x = jax.ShapeDtypeStruct((2, 64, 512), jnp.float32)
+    return pl.pallas_call(
+        _argmin_kernel,
+        grid=(2, 1),
+        in_specs=[pl.BlockSpec((1, 64, 512), lambda bi, ni: (bi, ni, 0))],
+        out_specs=pl.BlockSpec((1, 64), lambda bi, ni: (bi, ni)),
+        out_shape=jax.ShapeDtypeStruct((2, 64), jnp.float32),
+        interpret=interpret_mode(),
+    )(x)

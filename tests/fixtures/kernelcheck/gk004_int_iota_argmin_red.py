@@ -1,0 +1,42 @@
+"""RED (GK004): the PR-5 silent Mosaic regression, pre-fix shape.
+
+Parsed, never executed. This is the fused-lookup kernel's original
+first-of-ties argmin: an INTEGER ``broadcasted_iota`` fed into a
+``jnp.min`` reduction. It compiled for months, then Mosaic toolchain
+drift removed the integer min-reduction lowering and the kernel
+silently stopped compiling at HEAD (found and fixed in PR 5 by
+generating the iota as i32 and casting to f32 — exact for candidate
+indices up to 2^24). GK004's ``int-minmax-reduce`` hazard must keep
+this shape DETECTED so the class can never return unnoticed.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pvraft_tpu.compat import import_pallas
+from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
+
+
+def _argmin_kernel(dist_ref, o_ref):
+    dist = dist_ref[0]
+    # Pre-fix shape: integer iota, integer min-reduction over it.
+    iota = lax.broadcasted_iota(jnp.int32, dist.shape, 1)
+    m = jnp.min(dist, axis=-1, keepdims=True)
+    eq = dist == m
+    first = jnp.min(jnp.where(eq, iota, dist.shape[-1]), axis=-1)
+    o_ref[0] = first.astype(jnp.float32)
+
+
+def int_argmin():
+    x = jax.ShapeDtypeStruct((2, 64, 512), jnp.float32)
+    return pl.pallas_call(
+        _argmin_kernel,
+        grid=(2, 1),
+        in_specs=[pl.BlockSpec((1, 64, 512), lambda bi, ni: (bi, ni, 0))],
+        out_specs=pl.BlockSpec((1, 64), lambda bi, ni: (bi, ni)),
+        out_shape=jax.ShapeDtypeStruct((2, 64), jnp.float32),
+        interpret=interpret_mode(),
+    )(x)

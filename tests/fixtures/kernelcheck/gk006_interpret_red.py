@@ -1,0 +1,43 @@
+"""RED (GK006): pallas_call sites that leak the interpreter escape hatch.
+
+Parsed, never executed. ``no_kwarg`` omits ``interpret=`` entirely (the
+kernel can never run on CPU tier-1); ``hardcoded`` pins
+``interpret=False`` (same, but looks deliberate); both must route
+through ``pvraft_tpu.ops.pallas.interpret_mode()``.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.compat import import_pallas
+
+pl = import_pallas()
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[0] = x_ref[0]
+
+
+def no_kwarg():
+    x = jax.ShapeDtypeStruct((2, 64, 128), jnp.float32)
+    spec = pl.BlockSpec((1, 64, 128), lambda bi: (bi, 0, 0))
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(2,),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((2, 64, 128), jnp.float32),
+    )(x)
+
+
+def hardcoded():
+    x = jax.ShapeDtypeStruct((2, 64, 128), jnp.float32)
+    spec = pl.BlockSpec((1, 64, 128), lambda bi: (bi, 0, 0))
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(2,),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((2, 64, 128), jnp.float32),
+        interpret=False,
+    )(x)

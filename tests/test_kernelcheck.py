@@ -1,0 +1,600 @@
+"""kernelcheck: model extraction, GK rules red/green over the fixture
+corpus (incl. the PR-5 integer-iota argmin pinned as DETECTED), the
+clean-tree gate, the CLI, the VMEM/roofline planner, and the
+static-vs-Mosaic cross-validation against the committed artifacts.
+Pure host-side — no jax import anywhere (tier-1 on CPU)."""
+
+import ast
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+from pvraft_tpu.analysis.__main__ import main as analysis_main
+from pvraft_tpu.analysis.engine import known_rule_ids
+from pvraft_tpu.analysis.kernels.check import (
+    check_paths,
+    check_source,
+    default_scope,
+    registered_kernel_modules,
+)
+from pvraft_tpu.analysis.kernels.model import (
+    ArrayInfo,
+    KERNEL_BINDINGS,
+    build_module_kernel_model,
+)
+from pvraft_tpu.analysis.kernels.planner import (
+    CROSS_VALIDATION_FACTOR,
+    PLAN_SCHEMA,
+    build_plan,
+    check_plan_file,
+    collect_models,
+    fused_gru_residency,
+    spec_module_map,
+)
+from pvraft_tpu.analysis.kernels.rules import (
+    VMEM_BUDGET_BYTES,
+    all_kernel_rules,
+)
+from pvraft_tpu.programs.compile import validate_kernels_artifact
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "kernelcheck")
+COSTS = os.path.join(REPO, "artifacts", "programs_costs.json")
+KERNELS_ARTIFACT = os.path.join(REPO, "artifacts", "programs_kernels.json")
+PLAN_ARTIFACT = os.path.join(REPO, "artifacts", "kernel_plan.json")
+
+
+def fixture_ids(name, **kw):
+    path = os.path.join(FIXTURES, name)
+    with open(path, "r", encoding="utf-8") as f:
+        findings, notes = check_source(f.read(), path=path, **kw)
+    return [d.rule_id for d in findings], [d.rule_id for d in notes]
+
+
+def model_of(src, path="x.py"):
+    return build_module_kernel_model(ast.parse(src), src, path)
+
+
+# --- model extraction -------------------------------------------------------
+
+def test_array_info_subscripting():
+    a = ArrayInfo((2, 8192, 512, 3))
+    assert a[..., 0].shape == (2, 8192, 512)
+    assert a[..., 0:1].shape == (2, 8192, 512, 1)
+    assert a.nbytes == 2 * 8192 * 512 * 3 * 4
+    assert ArrayInfo((4, 4), "bfloat16").nbytes == 32
+
+
+def test_real_voxel_kernel_models_concretely():
+    """The voxel kernel at the flagship binding: grid, blocks, VMEM and
+    HBM all concrete — the numbers the plan artifact commits."""
+    models = collect_models()
+    kms = models["pvraft_tpu/ops/pallas/voxel_corr.py"]
+    assert len(kms) == 1
+    km = kms[0]
+    assert km.problems == []
+    assert km.grid == (2, 128)
+    assert km.kernel_fn_name == "_voxel_kernel"
+    assert [s.block for s in km.in_specs] == [(1, 64, 512)] * 4
+    assert [s.block for s in km.out_specs] == [(1, 64, 3 * 27)]
+    # 4 in blocks of 128 KiB + 1 out block of 20.25 KiB, double-buffered.
+    assert km.vmem_estimate_bytes() == 2 * (4 * 64 * 512 * 4
+                                            + 64 * 81 * 4)
+    assert km.hbm_operand_bytes() == (4 * 2 * 8192 * 512 * 4,
+                                      2 * 8192 * 81 * 4)
+
+
+def test_real_fused_kernel_models_concretely():
+    """The fused kernel resolves the cross-module `_pick_tile` helper
+    (imported from voxel_corr) and the `[spec]*4 + [spec]*3` list
+    arithmetic."""
+    models = collect_models()
+    km = models["pvraft_tpu/ops/pallas/corr_lookup.py"][0]
+    assert km.problems == []
+    assert km.grid == (2, 128)
+    assert len(km.in_specs) == 7
+    assert [s.block for s in km.in_specs[:4]] == [(1, 64, 512)] * 4
+    assert [s.block for s in km.in_specs[4:]] == [(1, 64, 1)] * 3
+    assert len(km.out_specs) == 5
+    assert km.operands[4].shape == (2, 8192, 1)  # coords[..., 0:1]
+
+
+def test_bindings_cover_every_scanned_kernel_function():
+    """Every real pallas_call site resolves through a KERNEL_BINDINGS
+    row (or would need literal dims) — the clean-tree guarantee."""
+    for suffix, kms in collect_models().items():
+        for km in kms:
+            assert km.problems == [], (suffix, km.func, km.problems)
+            assert any(suffix.endswith(s) and km.func in funcs
+                       for s, funcs in KERNEL_BINDINGS.items()), (
+                f"{suffix}:{km.func} modeled without a binding row?")
+
+
+def test_unmodelable_kernel_is_a_gk000_finding():
+    findings, _ = fixture_ids("gk000_unmodelable_red.py")
+    assert findings and set(findings) == {"GK000"}
+
+
+# --- per-rule red/green -----------------------------------------------------
+
+def test_gk001_red_chosen_tiles():
+    findings, _ = fixture_ids("gk001_chosen_tile_red.py")
+    assert set(findings) == {"GK001"}
+    assert len(findings) == 4  # sublane + lane, each on in and out spec
+
+
+def test_gk001_whole_axis_blocks_are_notes_not_findings():
+    """The 81-cell voxel output / knn=32 blocks are geometry-inherent:
+    layout notes, never gate failures."""
+    findings, notes, _ = check_paths(list(default_scope()))
+    assert [d for d in findings if d.rule_id == "GK001"] == []
+    assert any(d.rule_id == "GK001" and "(1, 64, 81)" in d.message
+               for d in notes)
+
+
+def test_gk001_block_dim_one_is_exempt():
+    src = _inline_kernel(block="(1, 64, 128)", grid="(2, 16)",
+                         shape="(2, 1024, 128)",
+                         index_map="lambda bi, ni: (bi, ni, 0)")
+    findings, notes = check_source(src)
+    assert [d for d in findings if d.rule_id == "GK001"] == []
+
+
+def test_gk002_red_and_budget_number():
+    findings, _ = fixture_ids("gk002_vmem_red.py")
+    assert set(findings) == {"GK002"}
+    assert VMEM_BUDGET_BYTES == 16 * 1024 * 1024
+
+
+def test_gk003_red_under_and_over():
+    findings, _ = fixture_ids("gk003_coverage_red.py")
+    assert set(findings) == {"GK003"}
+    path = os.path.join(FIXTURES, "gk003_coverage_red.py")
+    with open(path) as f:
+        diags, _ = check_source(f.read(), path=path)
+    messages = " | ".join(d.message for d in diags)
+    assert "under-coverage" in messages and "over-coverage" in messages
+
+
+def test_gk004_pr5_int_iota_argmin_stays_detected():
+    """The historical regression: the pre-fix integer-iota argmin must
+    stay DETECTED (the threadcheck fixture discipline)."""
+    findings, _ = fixture_ids("gk004_int_iota_argmin_red.py")
+    assert "GK004" in findings
+    assert set(findings) == {"GK004"}
+
+
+def test_gk004_current_float_iota_shape_stays_clean():
+    findings, _ = fixture_ids("gk004_float_iota_green.py")
+    assert findings == []
+
+
+def test_gk004_cast_iota_in_compound_expression_stays_clean():
+    """The sanctioned fix must survive inside compound expressions: an
+    `.astype(f32)` anywhere above the iota sanctions it, not only as
+    the outermost call of the assignment."""
+    src = _inline_kernel(
+        block="(1, 64, 128)", grid="(2,)", shape="(2, 64, 128)",
+        index_map="lambda bi: (bi, 0, 0)",
+        body=("    idx = lax.broadcasted_iota(\n"
+              "        jnp.int32, (64, 128), 1).astype(jnp.float32) + 0.5\n"
+              "    o_ref[0] = jnp.min(idx, axis=-1, keepdims=True) + "
+              "x_ref[0]\n"))
+    findings, _ = check_source(src)
+    assert [d for d in findings if d.rule_id == "GK004"] == []
+    # And the inline form of the fix, inside the reduction itself.
+    src = _inline_kernel(
+        block="(1, 64, 128)", grid="(2,)", shape="(2, 64, 128)",
+        index_map="lambda bi: (bi, 0, 0)",
+        body=("    o_ref[0] = jnp.min(lax.broadcasted_iota(\n"
+              "        jnp.int32, (64, 128), 1).astype(jnp.float32),\n"
+              "        axis=-1, keepdims=True) + x_ref[0]\n"))
+    findings, _ = check_source(src)
+    assert [d for d in findings if d.rule_id == "GK004"] == []
+
+
+def test_gk004_two_statement_cast_stays_clean():
+    """The fix written as a reassignment (`idx = idx.astype(f32)`) must
+    un-taint the name — the rule's own recommendation split over two
+    statements cannot fail the gate."""
+    src = _inline_kernel(
+        block="(1, 64, 128)", grid="(2,)", shape="(2, 64, 128)",
+        index_map="lambda bi: (bi, 0, 0)",
+        body=("    idx = lax.broadcasted_iota(jnp.int32, (64, 128), 1)\n"
+              "    idx = idx.astype(jnp.float32)\n"
+              "    o_ref[0] = jnp.min(idx, axis=-1, keepdims=True) + "
+              "x_ref[0]\n"))
+    findings, _ = check_source(src)
+    assert [d for d in findings if d.rule_id == "GK004"] == []
+
+
+def test_gk004_hazard_table_1d_iota_and_f64():
+    src = _inline_kernel(
+        body=("    idx = lax.iota(jnp.int32, 128)\n"
+              "    big = x_ref[0].astype(jnp.float64)\n"
+              "    o_ref[0] = big.astype(jnp.float32) + idx[0]\n"))
+    findings, _ = check_source(src)
+    hazards = [d.message for d in findings if d.rule_id == "GK004"]
+    assert any("iota-1d" in m for m in hazards)
+    assert any("float64" in m for m in hazards)
+
+
+def test_gk005_red_green_via_registry_set():
+    path = os.path.join(FIXTURES, "clean_green.py")
+    with open(path) as f:
+        src = f.read()
+    red, _ = check_source(src, path=path, registered_modules=set())
+    assert [d.rule_id for d in red] == ["GK005"]
+    green, _ = check_source(
+        src, path=path,
+        registered_modules={"tests/fixtures/kernelcheck/clean_green.py"})
+    assert green == []
+    # No registry context at all -> GK005 stays silent (unit-test mode).
+    silent, _ = check_source(src, path=path)
+    assert silent == []
+
+
+def test_gk005_registry_set_covers_both_real_kernels():
+    mods = registered_kernel_modules()
+    assert "pvraft_tpu/ops/pallas/voxel_corr.py" in mods
+    assert "pvraft_tpu/ops/pallas/corr_lookup.py" in mods
+
+
+def test_gk006_red_missing_and_hardcoded():
+    findings, _ = fixture_ids("gk006_interpret_red.py")
+    assert findings == ["GK006", "GK006"]
+
+
+def test_gk006_local_variable_spelling_stays_clean():
+    """`interp = interpret_mode()` then `interpret=interp` is the same
+    behavior as the inline call — the model's evaluator resolves it."""
+    src = _inline_kernel(block="(1, 64, 128)", grid="(2, 16)",
+                         shape="(2, 1024, 128)",
+                         index_map="lambda bi, ni: (bi, ni, 0)")
+    src = src.replace("    return pl.pallas_call(",
+                      "    interp = interpret_mode()\n"
+                      "    return pl.pallas_call(")
+    src = src.replace("interpret=interpret_mode(),", "interpret=interp,")
+    findings, _ = check_source(src)
+    assert findings == []
+
+
+def test_evaluator_failures_are_gk000_not_crashes():
+    """TypeErrors/ZeroDivisionErrors inside geometry expressions must
+    surface as GK000 model-incomplete findings, never tracebacks."""
+    for broken in ("grid=(2, 16 // 0),",          # ZeroDivisionError
+                   "grid=(2, (1, 2) * 1.5),"):    # TypeError
+        src = _inline_kernel(block="(1, 64, 128)",
+                             shape="(2, 1024, 128)",
+                             index_map="lambda bi, ni: (bi, ni, 0)",
+                             grid="IGNORED")
+        src = src.replace("grid=IGNORED,", broken)
+        findings, _ = check_source(src)
+        assert any(d.rule_id == "GK000" for d in findings), broken
+
+
+def test_whole_array_specs_are_single_buffered():
+    """A block=None (whole-array resident) spec is not grid-streamed,
+    so it must not be double-buffered in the VMEM estimate."""
+    from pvraft_tpu.analysis.kernels.model import (
+        BlockSpecModel,
+        KernelModel,
+    )
+
+    km = KernelModel(path="x.py", line=1, col=0, func="f")
+    km.in_specs = [BlockSpecModel(block=None, index_map=None,
+                                  line=1, col=0)]
+    km.operands = [ArrayInfo((64, 128))]
+    km.out_specs = [BlockSpecModel(block=(8, 128), index_map=None,
+                                   line=1, col=0)]
+    km.out_info = [ArrayInfo((64, 128))]
+    assert km.vmem_estimate_bytes() == 64 * 128 * 4 + 2 * 8 * 128 * 4
+
+
+def test_clean_fixture_is_clean():
+    findings, notes = fixture_ids("clean_green.py")
+    assert findings == [] and notes == []
+
+
+# --- suppressions + the shared pragma grammar -------------------------------
+
+def _inline_kernel(block="(1, 1024, 2048)", grid="(4,)",
+                   shape="(4, 1024, 2048)",
+                   index_map="lambda bi: (bi, 0, 0)",
+                   body="    o_ref[0] = x_ref[0]\n"):
+    return (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "from pvraft_tpu.compat import import_pallas\n"
+        "from pvraft_tpu.ops.pallas import interpret_mode\n"
+        "pl = import_pallas()\n"
+        "def _k(x_ref, o_ref):\n"
+        f"{body}"
+        "def run():\n"
+        f"    x = jax.ShapeDtypeStruct({shape}, jnp.float32)\n"
+        f"    spec = pl.BlockSpec({block}, {index_map})\n"
+        "    return pl.pallas_call(\n"
+        "        _k,\n"
+        f"        grid={grid},\n"
+        "        in_specs=[spec],\n"
+        "        out_specs=spec,\n"
+        f"        out_shape=jax.ShapeDtypeStruct({shape}, jnp.float32),\n"
+        "        interpret=interpret_mode(),\n"
+        "    )(x)\n")
+
+
+def test_gk_suppression_pragma_applies():
+    src = _inline_kernel()
+    findings, _ = check_source(src)
+    assert [d.rule_id for d in findings] == ["GK002"]
+    line = findings[0].line
+    lines = src.splitlines()
+    lines[line - 1] += "  # graftlint: disable=GK002 -- fixture probe"
+    suppressed, _ = check_source("\n".join(lines) + "\n")
+    assert suppressed == []
+
+
+def test_gk_ids_are_known_to_the_stats_grammar():
+    """`lint --stats` must never flag a GK pragma as unknown: the GK
+    family (plus GK000) lives in the one shared rule-id namespace."""
+    known = known_rule_ids()
+    for rule in all_kernel_rules():
+        assert rule.id in known
+    assert "GK000" in known
+    assert "GK999" not in known
+
+
+def test_reasonless_gk_pragma_fails_stats(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = 1  # graftlint: disable=GK002\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["lint", "--stats", str(tmp_path)])
+    assert rc == 1
+    assert "reason-less suppression" in buf.getvalue()
+    good = tmp_path / "bad.py"
+    good.write_text("x = 1  # graftlint: disable=GK002 -- probe reason\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["lint", "--stats", str(tmp_path)])
+    assert rc == 0
+    assert "unknown" not in buf.getvalue()
+
+
+# --- the clean-tree gate, in test form --------------------------------------
+
+def test_clean_tree_zero_findings():
+    """The lint.sh stage as a test: zero GK findings over ops/pallas.
+    Real violations get FIXED (the deepcheck/threadcheck precedent),
+    not pragma'd — and never silently accumulated."""
+    findings, _notes, nfiles = check_paths(list(default_scope()))
+    assert nfiles >= 3
+    assert findings == [], "\n".join(d.format() for d in findings)
+
+
+# --- CLI --------------------------------------------------------------------
+
+def test_cli_list_rules():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["kernels", "--list-rules"])
+    assert rc == 0
+    out = buf.getvalue()
+    for rule in all_kernel_rules():
+        assert rule.id in out
+    assert len(all_kernel_rules()) >= 6
+
+
+def test_cli_findings_and_select():
+    red = os.path.join(FIXTURES, "gk003_coverage_red.py")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["kernels", red])
+    assert rc == 1
+    assert "GK003" in buf.getvalue()
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["kernels", "--select", "GK001", red])
+    assert rc == 0, buf.getvalue()
+
+
+def test_cli_default_scope_is_clean():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["kernels"])
+    assert rc == 0
+
+
+def test_cli_plan_check_committed_artifact():
+    """The lint.sh plan stage in test form: the committed kernel_plan
+    regenerates byte-identically from the static models + costs."""
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["kernels", "--check", PLAN_ARTIFACT,
+                            "--costs", COSTS])
+    assert rc == 0, buf.getvalue()
+
+
+def test_cli_plan_check_detects_drift(tmp_path):
+    with open(PLAN_ARTIFACT) as f:
+        doc = json.load(f)
+    doc["vmem_budget_bytes"] = 123
+    stale = tmp_path / "kernel_plan.json"
+    stale.write_text(json.dumps(doc))
+    problems = check_plan_file(str(stale), COSTS)
+    assert problems and "drifted" in problems[0]
+
+
+def test_plan_check_rejects_non_object_artifact(tmp_path):
+    """Valid-JSON-but-not-an-object must be a clean diagnostic, not a
+    traceback."""
+    for payload in ("[1, 2]", "\"plan\""):
+        bad = tmp_path / "kernel_plan.json"
+        bad.write_text(payload)
+        problems = check_plan_file(str(bad), COSTS)
+        assert problems and "not a pvraft_kernel_plan/v1 object" \
+            in problems[0]
+
+
+def test_planner_refuses_multi_site_modules():
+    """A second pallas_call in a module would make the single-site plan
+    record silently wrong — the build must refuse loudly."""
+    from pvraft_tpu.analysis.kernels.planner import _kernel_records
+
+    models = collect_models()
+    module = "pvraft_tpu/ops/pallas/voxel_corr.py"
+    models[module] = models[module] * 2
+    with open(COSTS) as f:
+        costs = json.load(f)
+    _, problems = _kernel_records(models, costs)
+    assert any("2 pallas_call sites" in p for p in problems)
+
+
+def test_spec_module_map_derives_from_gk005_inspection():
+    """One catalog inspection feeds both GK005 and the planner — the
+    two coverage views cannot drift."""
+    from pvraft_tpu.analysis.kernels.check import kernel_spec_imports
+
+    imports = kernel_spec_imports()
+    assert set(spec_module_map()) == {n for n, mods in imports.items()
+                                      if mods}
+    assert registered_kernel_modules() == {
+        m for mods in imports.values() for m in mods}
+
+
+# --- planner ----------------------------------------------------------------
+
+def test_plan_schema_and_kernel_coverage():
+    plan = build_plan(COSTS)
+    assert plan["schema"] == PLAN_SCHEMA
+    names = {r["name"] for r in plan["kernels"]}
+    assert names == set(spec_module_map())
+    assert names == {"pallas_voxel_fwd", "pallas_voxel_grad",
+                     "pallas_fused_lookup_fwd", "pallas_fused_lookup_grad"}
+    for rec in plan["kernels"]:
+        assert rec["bound"] in ("memory", "compute")
+        assert rec["static_vmem_bytes"] < VMEM_BUDGET_BYTES
+        assert rec["cross_validated"] is True
+
+
+def test_static_vmem_agrees_with_mosaic_memory_analysis():
+    """The acceptance pin: for EVERY kernel-tag ProgramSpec the static
+    HBM estimate agrees with the real deviceless Mosaic
+    memory_analysis within the pinned factor — and the forward kernels
+    (no XLA DCE in play) agree essentially exactly."""
+    with open(KERNELS_ARTIFACT) as f:
+        compiled = {r["name"]: r for r in json.load(f)["programs"]}
+    plan = build_plan(COSTS)
+    assert set(compiled) == {r["name"] for r in plan["kernels"]}
+    for rec in plan["kernels"]:
+        mem = compiled[rec["name"]]["memory"]
+        mosaic = (mem["argument_size_in_bytes"]
+                  + mem["output_size_in_bytes"])
+        ratio = rec["static_hbm_bytes"] / mosaic
+        assert 1 / CROSS_VALIDATION_FACTOR <= ratio \
+            <= CROSS_VALIDATION_FACTOR, (rec["name"], ratio)
+        if rec["name"].endswith("_fwd"):
+            assert abs(ratio - 1.0) < 1e-3, (rec["name"], ratio)
+
+
+def test_fused_gru_residency_flagship_verdict():
+    """The committed number ROADMAP item 1 cites: at K=512 the fused
+    GRU iteration chain is VMEM-resident at tile=1024 with >= 3.9 MiB
+    headroom — for both the 2048- and 8192-point scenes — and a full
+    8192-point scene can NOT be resident (the tiling is mandatory)."""
+    for n in (2048, 8192):
+        rec = fused_gru_residency(n)
+        assert rec["fits"] is True
+        assert rec["tile_points"] == 1024
+        assert rec["headroom_bytes"] >= 3 * 2**20
+        assert rec["total_bytes"] <= VMEM_BUDGET_BYTES
+        assert rec["full_scene_resident"] is False
+        assert rec["candidate_traffic_reduction_factor"] == 32
+        assert rec["n_points"] % rec["tile_points"] == 0
+
+
+def test_fused_gru_residency_scales_with_k():
+    """Smaller truncated-K buys bigger resident tiles; an absurd budget
+    fits nothing and says so."""
+    k512 = fused_gru_residency(8192, truncate_k=512)
+    k128 = fused_gru_residency(8192, truncate_k=128)
+    assert k128["tile_points"] > k512["tile_points"]
+    broke = fused_gru_residency(8192, budget=1024)
+    assert broke["fits"] is False and "no multiple-of-8" in broke["verdict"]
+
+
+def test_plan_fails_on_cross_validation_breach(tmp_path):
+    """A costs artifact whose compiled memory diverges past the pin
+    must make the plan REFUSE to build (the lint stage's teeth)."""
+    with open(COSTS) as f:
+        doc = json.load(f)
+    for r in doc["programs"]:
+        if r["name"] == "pallas_voxel_fwd":
+            r["memory"]["argument_size_in_bytes"] //= 8
+    bad = tmp_path / "costs.json"
+    bad.write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match="outside the pinned"):
+        build_plan(str(bad))
+
+
+# --- programs_kernels.json coverage pin -------------------------------------
+
+class _FakeSpec:
+    def __init__(self, name, tags=("kernel", "pallas"),
+                 topology="v5e:2x2x1"):
+        self.name = name
+        self.tags = tags
+        self.topology = topology
+
+
+_KERNEL_SPECS = [_FakeSpec(n) for n in (
+    "pallas_voxel_fwd", "pallas_voxel_grad",
+    "pallas_fused_lookup_fwd", "pallas_fused_lookup_grad")]
+
+
+def _kernels_doc():
+    with open(KERNELS_ARTIFACT) as f:
+        return json.load(f)
+
+
+def test_committed_kernels_artifact_covers_registry():
+    """Both directions against the LIVE registry — the lint.sh stage in
+    test form; kernel compile evidence can no longer drift silently."""
+    from pvraft_tpu.programs.compile import validate_kernels_file
+
+    assert validate_kernels_file(KERNELS_ARTIFACT) == []
+
+
+def test_kernels_artifact_missing_record_detected():
+    doc = _kernels_doc()
+    doc["programs"] = [r for r in doc["programs"]
+                       if r["name"] != "pallas_voxel_grad"]
+    problems = validate_kernels_artifact(doc, _KERNEL_SPECS)
+    assert any("pallas_voxel_grad" in p and "no compile record" in p
+               for p in problems)
+
+
+def test_kernels_artifact_stale_record_detected():
+    doc = _kernels_doc()
+    doc["programs"].append({"name": "pallas_ghost_fwd", "ok": True,
+                            "memory": {}})
+    problems = validate_kernels_artifact(doc, _KERNEL_SPECS)
+    assert any("pallas_ghost_fwd" in p and "stale" in p for p in problems)
+
+
+def test_kernels_artifact_failed_compile_detected():
+    doc = _kernels_doc()
+    doc["programs"][0] = dict(doc["programs"][0], ok=False,
+                              error="Mosaic lowering failed")
+    problems = validate_kernels_artifact(doc, _KERNEL_SPECS)
+    assert any("FAILED" in p for p in problems)
+
+
+def test_kernels_artifact_wrong_topology_detected():
+    doc = dict(_kernels_doc(), topology="v5e:8x8")
+    problems = validate_kernels_artifact(doc, _KERNEL_SPECS)
+    assert any("topology" in p for p in problems)
