@@ -34,9 +34,21 @@ covers training and serving telemetry:
     serve_batch    bucket, batch, n, fill, latency_ms [+ queue_depth,
                    replica, device_id]
     serve_reject   reason ("queue_full"|"too_large"|"too_small"|
-                   "bad_request"|"shutdown"|"timeout"|"internal")
-                                                      [+ bucket, queue_depth]
+                   "bad_request"|"shutdown"|"timeout"|"internal"|
+                   "unavailable")                     [+ bucket, queue_depth]
     serve_shutdown served, rejected, drained
+
+Fault-tolerance events (``pvraft_tpu/serve/supervisor.py``,
+``pvraft_tpu/serve/faults.py``) ride the same stream:
+
+    replica_state  replica, state   [+ from_state, reason, device_id] —
+                one supervisor state-machine transition; ``state`` (and
+                ``from_state`` when present) must be one of
+                ``REPLICA_STATES`` (healthy|degraded|quarantined|probing)
+    fault_injected point            [+ replica, bucket, traversal,
+                fires, value] — one deterministic fault-point firing
+                (an armed FaultPlan rule matched this traversal);
+                ``point`` must be one of ``FAULT_POINTS``
 
 Tracing events (``pvraft_tpu/obs/trace.py``) ride the same stream:
 
@@ -112,14 +124,35 @@ EVENT_TYPES: Dict[str, tuple] = {
     "recompile": (("program", "count"),
                   ("baseline", "signature", "context")),
     "device_memory": (("devices",), ("context",)),
+    "replica_state": (("replica", "state"),
+                      ("from_state", "reason", "device_id")),
+    "fault_injected": (("point",),
+                       ("replica", "bucket", "traversal", "fires",
+                        "value")),
 }
 
 # serve_reject.reason vocabulary (validated like divergence.reason).
 # "timeout"/"internal" are accepted-then-failed outcomes (504/500): the
-# request passed submit but never produced a response.
+# request passed submit but never produced a response. "unavailable" is
+# the graceful-degradation shed: every replica is quarantined, so the
+# pool rejects at admission instead of queue-timeout 504s.
 SERVE_REJECT_REASONS = (
     "queue_full", "too_large", "too_small", "bad_request", "shutdown",
-    "timeout", "internal")
+    "timeout", "internal", "unavailable")
+
+# replica_state.state vocabulary — the supervisor's health state machine
+# (serve/supervisor.py): healthy -> degraded -> quarantined -> probing
+# -> healthy. Lives here (with SERVE_REJECT_REASONS) so the jax-free
+# validator pins it without importing the serve package.
+REPLICA_STATES = ("healthy", "degraded", "quarantined", "probing")
+
+# fault_injected.point vocabulary — the named fault points the serve
+# plane threads through its executor/batcher/server (serve/faults.py
+# imports THIS, not the other way round, so the validator stays
+# serve-import-free).
+FAULT_POINTS = (
+    "replica_predict_error", "replica_latency_ms", "replica_wedge",
+    "queue_stall", "compile_trip")
 
 _BASE_FIELDS = ("schema", "type", "time", "seq")
 
@@ -142,6 +175,9 @@ _NUMERIC_FIELDS = {
     "slo_report": ("slo_p99_ms", "max_qps_under_slo", "programs",
                    "requests"),
     "recompile": ("count", "baseline"),
+    "replica_state": ("replica", "device_id"),
+    "fault_injected": ("replica", "bucket", "traversal", "fires",
+                       "value"),
 }
 
 # device_memory per-device row shape: required/optional keys and which
@@ -231,6 +267,26 @@ def validate_event(record: Any, seq: Optional[int] = None) -> List[str]:
         problems.append(
             f"serve_reject: reason {record.get('reason')!r} must be one "
             f"of {SERVE_REJECT_REASONS}")
+    if etype == "replica_state":
+        if record.get("state") not in REPLICA_STATES:
+            problems.append(
+                f"replica_state: state {record.get('state')!r} must be "
+                f"one of {REPLICA_STATES}")
+        if "from_state" in record \
+                and record["from_state"] not in REPLICA_STATES:
+            problems.append(
+                f"replica_state: from_state {record['from_state']!r} "
+                f"must be one of {REPLICA_STATES}")
+        replica = record.get("replica")
+        if _is_number(replica) and isinstance(replica, (int, float)) \
+                and replica < 0:
+            problems.append(
+                f"replica_state: replica {replica} must be >= 0")
+    if etype == "fault_injected" and record.get("point") not in (
+            FAULT_POINTS):
+        problems.append(
+            f"fault_injected: point {record.get('point')!r} must be one "
+            f"of {FAULT_POINTS}")
     if etype == "recompile":
         if not isinstance(record.get("program"), str) or not record.get(
                 "program"):

@@ -130,6 +130,15 @@ class RetraceWatchdog:
         if listener is not None:
             unregister_compile_listener(listener)
 
+    def inject_compile(self) -> None:
+        """Fault-injection hook (serve/faults.py ``compile_trip``):
+        count one simulated backend compile, exactly as the monitoring
+        listener would — so an injected trip exercises the REAL
+        sealed-mode path (dispatch-window check -> recompile event ->
+        strict-mode failure) instead of a parallel fake."""
+        with self._lock:
+            self._global_compiles += 1
+
     def global_compiles(self) -> int:
         """Current process-wide compile count (sealed mode). Dispatchers
         read this BEFORE running a program and pass it to ``check`` as

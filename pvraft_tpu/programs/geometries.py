@@ -121,6 +121,39 @@ SERVE_BF16_REL_EPE_BOUND = 0.15    # same, relative to mean |flow_fp32|
 # the conftest-forced --xla_force_host_platform_device_count.
 SERVE_DEFAULT_REPLICAS = 0
 
+# Replica supervision thresholds (serve/supervisor.py SupervisorConfig
+# reads THESE — the one place the health state machine's trip points
+# live, per the geometry-data discipline above). The state machine:
+# healthy -> degraded -> quarantined -> probing -> healthy.
+SUPERVISOR_DEFAULTS = {
+    # Consecutive hard dispatch failures before a replica is marked
+    # degraded (still serving, visibly unhealthy) / pulled from the
+    # work-stealing rotation entirely.
+    "degraded_after": 1,
+    "quarantine_after": 3,
+    # Latency-outlier signal: a dispatch slower than factor x the
+    # per-bucket EWMA (after min_samples warmup, above the absolute
+    # floor) is an outlier; this many CONSECUTIVE outliers degrade the
+    # replica. Slow is not dead: outliers never quarantine on their own.
+    "latency_outlier_factor": 4.0,
+    "latency_outlier_after": 4,
+    "latency_min_samples": 8,
+    "latency_floor_ms": 1.0,
+    # Probe cadence: how often quarantined replicas get a synthetic
+    # min-points request through their own AOT program (and wedge scans
+    # run). Also the source of the 503 Retry-After header — a shed
+    # client retrying after one probe cycle meets a re-evaluated pool.
+    "probe_interval_s": 0.5,
+    # One probe's budget: a replica that hangs mid-probe (dead device)
+    # costs the supervisor loop at most this long, then counts as a
+    # failed probe — wedge scans and other replicas' revival continue.
+    "probe_timeout_s": 10.0,
+    # A dispatch in flight longer than this is a wedged executor: the
+    # replica is quarantined (capacity visibly down) even though the
+    # stuck thread can't be killed.
+    "wedge_timeout_s": 30.0,
+}
+
 # pc1 is donated to every predict program: the unique input whose
 # (shape, dtype) matches the flow output, so XLA aliases instead of
 # allocating (deepcheck GJ004/GJ005 verify this on the serve.predict

@@ -14,15 +14,25 @@ A trained checkpoint becomes an HTTP service through four layers:
     JSON/msgpack HTTP API (``/predict``, ``/healthz``, ``/metrics``);
   * :mod:`pvraft_tpu.serve.events` — :class:`ServeTelemetry`: serve
     lifecycle on the ``pvraft_events/v1`` stream (one validator for
-    training AND serving).
+    training AND serving);
+  * :mod:`pvraft_tpu.serve.supervisor` — :class:`ReplicaSupervisor`:
+    per-replica health state machine (healthy/degraded/quarantined/
+    probing), background probe revival, retry-once-on-another-replica
+    and healthy-count-scaled admission (graceful degradation);
+  * :mod:`pvraft_tpu.serve.faults` — deterministic fault injection:
+    named fault points armed by an explicit :class:`FaultPlan`
+    (zero-cost when disarmed) — the chaos harness that PROVES the
+    fault-tolerance layer instead of asserting it.
 
 CLI: ``python -m pvraft_tpu.serve serve --ckpt ...`` runs the service;
-``scripts/serve_loadgen.py`` measures it.
+``scripts/serve_loadgen.py`` measures it; ``scripts/serve_chaos.py``
+commits the chaos evidence.
 """
 
 from pvraft_tpu.serve.batcher import (          # noqa: F401
     BatcherConfig,
     MicroBatcher,
+    PoolUnavailableError,
     QueueFullError,
     ShutdownError,
 )
@@ -32,8 +42,17 @@ from pvraft_tpu.serve.engine import (           # noqa: F401
     ServeConfig,
 )
 from pvraft_tpu.serve.events import ServeTelemetry          # noqa: F401
+from pvraft_tpu.serve.faults import (                       # noqa: F401
+    FaultPlan,
+    FaultRule,
+    InjectedFaultError,
+)
 from pvraft_tpu.serve.metrics import ServeMetrics           # noqa: F401
 from pvraft_tpu.serve.server import (                       # noqa: F401
     ServeHTTPServer,
     build_service,
+)
+from pvraft_tpu.serve.supervisor import (                   # noqa: F401
+    ReplicaSupervisor,
+    SupervisorConfig,
 )

@@ -68,6 +68,12 @@ def _cmd_serve(args) -> int:
     for rec in engine.compile_report():
         print(f"[serve]   {rec['name']}: lower {rec['lower_s']}s "
               f"compile {rec['compile_s']}s", flush=True)
+    from pvraft_tpu.serve.supervisor import SupervisorConfig
+
+    supervisor_cfg = None
+    if args.probe_interval is not None:
+        supervisor_cfg = SupervisorConfig(
+            probe_interval_s=args.probe_interval)
     server = build_service(engine, max_wait_ms=args.max_wait_ms,
                            queue_depth=args.queue_depth, host=args.host,
                            port=args.port, telemetry=telemetry,
@@ -75,7 +81,9 @@ def _cmd_serve(args) -> int:
                            trace_sample_every=args.trace_sample,
                            trace_dir=args.trace_dir,
                            strict_retrace=args.strict_retrace,
-                           devmem_interval_s=args.devmem_interval)
+                           devmem_interval_s=args.devmem_interval,
+                           supervise=not args.no_supervise,
+                           supervisor_cfg=supervisor_cfg)
     server.start()
     print(f"[serve] listening on http://{server.host}:{server.port} "
           f"(/predict /healthz /metrics /debug/trace); tracing "
@@ -158,6 +166,17 @@ def main(argv=None) -> int:
                           "the program set; without it the retrace "
                           "watchdog only emits `recompile` events + the "
                           "pvraft_serve_recompiles_total counter")
+    srv.add_argument("--no-supervise", dest="no_supervise",
+                     action="store_true",
+                     help="disable the replica supervisor (health state "
+                          "machine, quarantine + probe revival, "
+                          "retry-once, degraded admission) — the "
+                          "pre-fault-tolerance pool semantics")
+    srv.add_argument("--probe_interval", type=float, default=None,
+                     help="supervisor probe cadence in seconds (default: "
+                          "the declared "
+                          "geometries.SUPERVISOR_DEFAULTS value); also "
+                          "drives the 503 Retry-After header")
     srv.add_argument("--devmem_interval", type=float, default=10.0,
                      help="seconds between device.memory_stats() samples "
                           "(device_memory events + "

@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
+from pvraft_tpu.serve import faults
 from pvraft_tpu.serve.engine import InferenceEngine, RequestError
 
 
@@ -54,6 +55,12 @@ class QueueFullError(RuntimeError):
 
 class ShutdownError(RuntimeError):
     """The batcher is no longer accepting requests (HTTP 503)."""
+
+
+class PoolUnavailableError(RuntimeError):
+    """Every replica is quarantined: graceful degradation rejects at
+    admission (HTTP 503 ``unavailable`` + ``Retry-After``) instead of
+    accepting work that can only become queue-timeout 504s."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,7 +142,8 @@ class MicroBatcher:
     """Bucket collectors -> batch queue -> per-replica executors."""
 
     def __init__(self, engine: InferenceEngine, cfg: BatcherConfig,
-                 telemetry=None, metrics=None, watchdog=None):
+                 telemetry=None, metrics=None, watchdog=None,
+                 supervisor=None):
         largest = max(engine.cfg.batch_sizes)
         if cfg.max_batch > largest:
             raise ValueError(
@@ -153,6 +161,14 @@ class MicroBatcher:
         # and fails the batch loudly (HTTP 500) instead of silently
         # paying a compile stall per request.
         self.watchdog = watchdog
+        # Replica supervisor (serve/supervisor.py), wired by
+        # build_service: dispatch outcomes feed its state machine,
+        # quarantined replicas leave the work-stealing rotation, a
+        # failed batch gets one retry on a different replica, and
+        # admission capacity shrinks with the healthy count. None =
+        # pre-supervision semantics, bit-for-bit (every hook below is a
+        # None check).
+        self.supervisor = supervisor
         # The executor pool: the engine's replicas, or the engine itself
         # as a single executor (test doubles without a pool).
         self.replicas = list(getattr(engine, "replicas", ()) or ()) \
@@ -191,6 +207,8 @@ class MicroBatcher:
         self._replica_inflight = [0] * len(self.replicas)  # guarded-by: _count_lock
         self._replica_batches = [0] * len(self.replicas)   # guarded-by: _count_lock
         self._collectors_live = len(engine.cfg.buckets)    # guarded-by: _count_lock
+        self._executors_live = len(self.replicas)          # guarded-by: _count_lock
+        self._retries = 0                                  # guarded-by: _count_lock
         self._collectors = [
             threading.Thread(target=self._collector, args=(b,),
                              name=f"pvraft-serve-b{b}", daemon=True)
@@ -238,33 +256,62 @@ class MicroBatcher:
         # I/O and must not serialize intake across buckets under the
         # exact overload that makes rejects frequent.
         reject = None
+        effective_depth = self.cfg.queue_depth
         with self._intake_lock:
             if self._stopping.is_set():
                 reject = "shutdown"
-            elif self._queues[bucket].full():
-                # Submitters are serialized by _intake_lock and workers
-                # only remove, so a not-full queue here cannot fill
-                # before the put below — the full() check IS the
-                # admission decision.
-                reject = "queue_full"
             else:
-                # Count the submit BEFORE the enqueue becomes visible to
-                # a worker: otherwise a dispatched response could reach
-                # record_batch first and a concurrent /metrics snapshot
-                # would see responses_total > requests_total. Counter
-                # increments only — no telemetry I/O under the lock.
-                if self.metrics is not None:
-                    self.metrics.record_submit(bucket, n_points=n_points)
-                self._queues[bucket].put_nowait(req)
+                # Graceful degradation: admission capacity scales with
+                # the replicas still in the work-stealing rotation —
+                # with half the pool quarantined, accepting a full
+                # queue's worth of work only converts backlog into
+                # queue-timeout 504s. serving_count() is a locked int
+                # read (no I/O; the supervisor never calls back into
+                # intake, so the edge is one-way).
+                serving = (self.supervisor.serving_count()
+                           if self.supervisor is not None
+                           else len(self.replicas))
+                if serving == 0:
+                    reject = "unavailable"
+                else:
+                    effective_depth = max(
+                        1, (self.cfg.queue_depth * serving
+                            + len(self.replicas) - 1)
+                        // len(self.replicas))
+                    if self._queues[bucket].qsize() >= effective_depth:
+                        # Submitters are serialized by _intake_lock and
+                        # workers only remove, so a below-capacity queue
+                        # here cannot fill before the put below — this
+                        # check IS the admission decision (at full
+                        # health it reduces to the old full() check).
+                        reject = "queue_full"
+                    else:
+                        # Count the submit BEFORE the enqueue becomes
+                        # visible to a worker: otherwise a dispatched
+                        # response could reach record_batch first and a
+                        # concurrent /metrics snapshot would see
+                        # responses_total > requests_total. Counter
+                        # increments only — no telemetry I/O under the
+                        # lock.
+                        if self.metrics is not None:
+                            self.metrics.record_submit(
+                                bucket, n_points=n_points)
+                        self._queues[bucket].put_nowait(req)
         if reject == "shutdown":
             self._reject("shutdown")
             raise ShutdownError("server is shutting down")
+        if reject == "unavailable":
+            self._reject("unavailable", bucket=bucket)
+            raise PoolUnavailableError(
+                "every replica is quarantined; the pool sheds load "
+                "until a probe revives one") from None
         if reject == "queue_full":
             self._reject("queue_full", bucket=bucket,
-                         queue_depth=self.cfg.queue_depth)
+                         queue_depth=effective_depth)
             raise QueueFullError(
                 f"bucket {bucket} queue is full "
-                f"({self.cfg.queue_depth} pending)") from None
+                f"({effective_depth} of {self.cfg.queue_depth} slots "
+                f"admissible at current pool health)") from None
         return req
 
     def record_reject(self, reason: str) -> None:
@@ -316,22 +363,34 @@ class MicroBatcher:
 
     def replica_stats(self) -> List[Dict[str, Any]]:
         """Per-replica visibility for /healthz and Prometheus: device
-        id, requests currently executing, served-batch counter."""
+        id, requests currently executing, served-batch counter — plus
+        the supervisor's health state when one is wired. The supervisor
+        rows are fetched BEFORE _count_lock (each side locks only its
+        own state; never nested)."""
+        health = (self.supervisor.states()
+                  if self.supervisor is not None else None)
         with self._count_lock:
-            return [{"replica": i,
+            rows = [{"replica": i,
                      "device_id": int(getattr(r, "device_id", i)),
                      "in_flight": self._replica_inflight[i],
                      "batches_total": self._replica_batches[i]}
                     for i, r in enumerate(self.replicas)]
+        if health is not None:
+            for row, h in zip(rows, health):
+                row["state"] = h["state"]
+        return rows
 
     # -------------------------------------------------------- collectors --
 
     def _capacity_idle(self) -> bool:
         """True when a formed group would start executing immediately:
-        some executor is free AND no earlier group is already waiting."""
+        some in-rotation executor is free AND no earlier group is
+        already waiting."""
         with self._count_lock:
             busy = self._busy
-        return busy < len(self.replicas) and self._batchq.empty()
+        serving = (self.supervisor.serving_count()
+                   if self.supervisor is not None else len(self.replicas))
+        return busy < serving and self._batchq.empty()
 
     def _collect(self, q: "queue.Queue[_Request]") -> List[_Request]:
         """One group: block briefly for a first request (so the stop flag
@@ -383,6 +442,10 @@ class MicroBatcher:
                 if self._stopping.is_set() and not self._drain:
                     self._fail_group(group)
                     continue
+                # Fault point: a stalled bucket queue (armed FaultPlans
+                # only — disarmed this is one attribute check).
+                faults.fire("queue_stall", bucket=bucket,
+                            on_fire=self._on_fault)
                 if not self._enqueue_batch(bucket, group):
                     continue
         finally:
@@ -405,6 +468,17 @@ class MicroBatcher:
                 if self._stopping.is_set() and not self._drain:
                     self._fail_group(group)
                     return False
+                if self._stopping.is_set():
+                    # Draining, but every executor already exited (all
+                    # replicas quarantined park-and-exit at stop): no
+                    # consumer will ever free the batch queue — serve
+                    # the group inline so the drain contract (every
+                    # accepted request resolves) holds.
+                    with self._count_lock:
+                        executors_done = self._executors_live == 0
+                    if executors_done:
+                        self._dispatch(0, self.replicas[0], bucket, group)
+                        return False
 
     def _fail_group(self, group: List[_Request]) -> None:
         for req in group:
@@ -420,23 +494,47 @@ class MicroBatcher:
 
     def _executor(self, index: int) -> None:
         replica = self.replicas[index]
-        while True:
-            try:
-                bucket, group = self._batchq.get(timeout=0.05)
-            except queue.Empty:
-                if self._stopping.is_set():
-                    with self._count_lock:
-                        collectors_done = self._collectors_live == 0
-                    if collectors_done and self._batchq.empty():
+        try:
+            while True:
+                if (self.supervisor is not None
+                        and not self.supervisor.in_rotation(index)):
+                    # Quarantined/probing: parked out of the
+                    # work-stealing rotation (only the supervisor's
+                    # probe touches this replica). At shutdown a parked
+                    # executor exits immediately — the drain sweep (or
+                    # a live sibling) owns any leftover batches.
+                    if self._stopping.is_set():
                         break
-                continue
-            if self._stopping.is_set() and not self._drain:
-                self._fail_group(group)
-                continue
-            self._dispatch(index, replica, bucket, group)
+                    time.sleep(0.02)
+                    continue
+                try:
+                    bucket, group = self._batchq.get(timeout=0.05)
+                except queue.Empty:
+                    if self._stopping.is_set():
+                        with self._count_lock:
+                            collectors_done = self._collectors_live == 0
+                        if collectors_done and self._batchq.empty():
+                            break
+                    continue
+                if self._stopping.is_set() and not self._drain:
+                    self._fail_group(group)
+                    continue
+                self._dispatch(index, replica, bucket, group)
+        finally:
+            # _enqueue_batch's drain fallback polls this: when every
+            # executor is gone, collectors dispatch inline instead of
+            # blocking on a batch queue nobody reads.
+            with self._count_lock:
+                self._executors_live -= 1
+
+    def _on_fault(self, record: Dict[str, Any]) -> None:
+        """``fault_injected`` telemetry sink for fault points fired on
+        the batcher's paths (the supervisor's probe has its own)."""
+        if self.telemetry is not None:
+            self.telemetry.emit_fault(**record)
 
     def _dispatch(self, index: int, replica, bucket: int,
-                  group: List[_Request]) -> None:
+                  group: List[_Request], retried: bool = False) -> None:
         # Drop requests whose waiter already timed out (504 sent): the
         # engine time would buy an answer nobody reads, and counting
         # them as served would report success for client-visible
@@ -453,20 +551,45 @@ class MicroBatcher:
         with self._count_lock:
             self._busy += 1
             self._replica_inflight[index] += len(group)
+        dispatch_token = None
+        if self.supervisor is not None:
+            # The wedge watch: a dispatch still marked started after
+            # wedge_timeout_s quarantines this replica. Tokened: a
+            # sibling's retry can run on this replica concurrently with
+            # its own executor, and each in-flight dispatch must stay
+            # individually visible.
+            dispatch_token = self.supervisor.note_dispatch_start(index, t0)
+        failure: Optional[BaseException] = None
         try:
+            # Replica fault points (latency sleep / wedge block / error
+            # raise) — the same traversal the supervisor's probe makes,
+            # so an armed fault fails both. Disarmed: one attr check.
+            faults.replica_faults(index, bucket=bucket,
+                                  on_fire=self._on_fault)
             flows = replica.predict_batch(
                 [(r.pc1, r.pc2) for r in group], bucket)
             if self.watchdog is not None:
+                if faults.fire("compile_trip", replica=index,
+                               bucket=bucket, on_fire=self._on_fault):
+                    # The injected "hidden backend compile" flows
+                    # through the real sealed-mode observability path
+                    # (counter -> check -> recompile event / strict 500).
+                    self.watchdog.inject_compile()
                 self._watchdog_check(bucket, len(group), compile_window)
-        except BaseException as e:  # noqa: BLE001 — fail the group, not the executor
-            for req in group:
-                req.fail(e)
-            return
+        except BaseException as e:  # noqa: BLE001 — fail/retry the group, not the executor
+            failure = e
         finally:
+            if self.supervisor is not None:
+                self.supervisor.note_dispatch_end(index, dispatch_token)
             with self._count_lock:
                 self._busy -= 1
                 self._replica_inflight[index] -= len(group)
+        if failure is not None:
+            self._dispatch_failed(index, bucket, group, failure, retried)
+            return
         now = time.monotonic()
+        if self.supervisor is not None:
+            self.supervisor.record_success(index, bucket, now - t0)
         # Re-check abandonment AFTER the engine call: a waiter can 504
         # while predict runs (seconds), and its request must not be
         # counted as served or have its (by-definition over-deadline)
@@ -526,6 +649,42 @@ class MicroBatcher:
                 replica=index, device_id=device_id)
         for req, flow in live:
             req.resolve(flow)
+
+    def _dispatch_failed(self, index: int, bucket: int,
+                         group: List[_Request], error: BaseException,
+                         retried: bool) -> None:
+        """A dispatch raised. Feed the supervisor's failure ledger, then
+        retry the batch EXACTLY once on a *different* in-rotation
+        replica — still within each request's deadline, because the
+        retry dispatch re-drops abandoned (504'd) waiters before paying
+        any engine time. Already-retried groups (or a pool with no
+        healthy sibling) fail outright: the HTTP layer records those
+        accepted-then-failed outcomes, so the accounting identity holds
+        and no request is ever resolved twice (the retry path reuses the
+        one finalize()-token accounting the success path has).
+
+        A strict-mode :class:`~pvraft_tpu.obs.retrace.RetraceError` is
+        NOT a replica failure: the predict itself succeeded and the
+        process-wide compile it reports would fail the retry identically
+        — it fails the group without touching the health ledger."""
+        from pvraft_tpu.obs.retrace import RetraceError
+
+        if self.supervisor is not None \
+                and not isinstance(error, RetraceError):
+            self.supervisor.record_failure(
+                index, reason=type(error).__name__)
+            if not retried:
+                target = self.supervisor.retry_target(exclude=index)
+                if target is not None:
+                    with self._count_lock:
+                        self._retries += 1
+                    if self.metrics is not None:
+                        self.metrics.record_retry()
+                    self._dispatch(target, self.replicas[target], bucket,
+                                   group, retried=True)
+                    return
+        for req in group:
+            req.fail(error)
 
     def _watchdog_check(self, bucket: int, n: int,
                         compile_window: int) -> None:
@@ -609,4 +768,4 @@ class MicroBatcher:
     def counts(self) -> Dict[str, int]:
         with self._count_lock:
             return {"served": self._served, "rejected": self._rejected,
-                    "drained": self._drained}
+                    "drained": self._drained, "retries": self._retries}

@@ -402,6 +402,21 @@ class InferenceEngine:
     def compile_report(self) -> List[Dict[str, Any]]:
         return [p.report() for p in self._programs.values()]
 
+    def probe_request(self) -> Tuple[np.ndarray, int]:
+        """The supervisor's synthetic health-probe payload: a
+        deterministic ``min_points`` cloud strictly inside the
+        coordinate contract, targeted at the smallest bucket — whose
+        program table is always compiled, so a probe can never trigger
+        a backend compile (the sealed retrace watchdog stays quiet).
+        The engine owns the request contract, so the payload is built
+        here, not in the supervisor."""
+        rng = np.random.default_rng(0)
+        scale = min(1.0, 0.5 * self.cfg.coord_limit)
+        cloud = rng.uniform(
+            -scale, scale,
+            (max(self.cfg.min_points, 1), 3)).astype(np.float32)
+        return cloud, self.cfg.buckets[0]
+
     def validate_request(self, pc1: np.ndarray, pc2: np.ndarray) -> int:
         """Check one request against the serve contract; returns its
         bucket. Raises :class:`RequestError` with a telemetry reason."""

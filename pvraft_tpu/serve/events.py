@@ -126,6 +126,46 @@ class ServeTelemetry:
         with self._lock:
             self.events.emit("device_memory", **fields)
 
+    def emit_replica_state(self, replica: int, state: str,
+                           from_state: Optional[str] = None,
+                           reason: Optional[str] = None,
+                           device_id: Optional[int] = None) -> None:
+        """One supervisor state-machine transition
+        (serve/supervisor.py): healthy/degraded/quarantined/probing —
+        the fleet-health ledger an operator replays to see exactly when
+        a replica fell out of (and returned to) the rotation."""
+        fields: Dict[str, Any] = {"replica": replica, "state": state}
+        if from_state is not None:
+            fields["from_state"] = from_state
+        if reason is not None:
+            fields["reason"] = reason
+        if device_id is not None:
+            fields["device_id"] = device_id
+        with self._lock:
+            self.events.emit("replica_state", **fields)
+
+    def emit_fault(self, point: str, replica: Optional[int] = None,
+                   bucket: Optional[int] = None,
+                   traversal: Optional[int] = None,
+                   fires: Optional[int] = None,
+                   value: Optional[float] = None) -> None:
+        """One deterministic fault-point firing (serve/faults.py): the
+        chaos evidence trail — every injected failure is on the stream
+        beside the replica_state transitions it caused."""
+        fields: Dict[str, Any] = {"point": point}
+        if replica is not None:
+            fields["replica"] = replica
+        if bucket is not None:
+            fields["bucket"] = bucket
+        if traversal is not None:
+            fields["traversal"] = traversal
+        if fires is not None:
+            fields["fires"] = fires
+        if value is not None:
+            fields["value"] = value
+        with self._lock:
+            self.events.emit("fault_injected", **fields)
+
     def emit_shutdown(self, served: int, rejected: int,
                       drained: int) -> None:
         with self._lock:

@@ -1,0 +1,296 @@
+"""Deterministic fault injection for the serve plane.
+
+Named **fault points** are threaded through the replica executor, the
+batcher and the server (``FAULT_POINTS``, vocabulary shared with the
+event schema in ``obs/events.py``):
+
+  ``replica_predict_error``   raise from a replica's dispatch/probe path
+  ``replica_latency_ms``      sleep ``value`` ms inside the dispatch
+  ``replica_wedge``           block the dispatch until the plan clears
+  ``queue_stall``             sleep ``value`` ms in a bucket collector
+  ``compile_trip``            simulate a post-seal backend compile
+                              (the call site bumps the retrace watchdog)
+
+A point is **armed** only by an explicitly installed :class:`FaultPlan`
+— a deterministic schedule of :class:`FaultRule` records: fire on the
+``nth`` traversal of the named point (per-replica when the rule names a
+replica, else on the global traversal count), optionally repeating
+``every`` k traversals, capped at ``max_fires``, and only ``after_s``
+seconds past install. Determinism is the whole design: a chaos test
+states *which* dispatch fails, runs real threads, and asserts the
+recovery story — no random sleeps, no flaky kill -9.
+
+Zero-cost when disarmed: :func:`fire` is one attribute read and a
+``None`` check — no counters are allocated, nothing is locked, and no
+fault point lives inside jitted code, so the default path's jaxprs,
+the frozen JSON ``/metrics`` shape and the sanitizer's lock graph are
+untouched (``tests/test_supervisor.py`` gates the zero-residue claim;
+this module never imports jax).
+
+Install/clear are process-global (``install_plan`` / ``clear_plan`` /
+the ``injected`` context manager): the chaos suite arms a plan, builds
+the service, drives load, clears the plan, and watches the supervisor's
+probe revive the quarantined replica.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
+from pvraft_tpu.obs.events import FAULT_POINTS
+
+
+class InjectedFaultError(RuntimeError):
+    """The effect of a fired ``replica_predict_error`` fault point —
+    a distinct type so tests (and the supervisor's failure ledger) can
+    tell an injected failure from a real one."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """One deterministic firing schedule for one fault point.
+
+    ``nth`` is 1-based: the rule first fires on the nth traversal of
+    its point (counted per replica when ``replica`` is set, globally
+    otherwise). ``every=0`` fires exactly once; ``every=k`` re-fires on
+    every k-th traversal after the nth, up to ``max_fires`` total
+    (0 = unlimited). ``after_s`` keeps the rule dormant for that many
+    seconds past plan install. ``value`` is the point's magnitude:
+    milliseconds for ``replica_latency_ms``/``queue_stall``, max block
+    seconds for ``replica_wedge`` (0 = until the plan clears)."""
+
+    point: str
+    nth: int = 1
+    every: int = 0
+    after_s: float = 0.0
+    replica: Optional[int] = None
+    value: float = 0.0
+    max_fires: int = 0
+
+    def __post_init__(self):
+        if self.point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {self.point!r}; known points: "
+                f"{FAULT_POINTS}")
+        if self.nth < 1:
+            raise ValueError("nth is 1-based and must be >= 1")
+        if self.every < 0 or self.max_fires < 0 or self.after_s < 0:
+            raise ValueError("every/max_fires/after_s must be >= 0")
+        if self.value < 0:
+            raise ValueError("value must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An immutable set of fault rules, installed as one unit."""
+
+    rules: Tuple[FaultRule, ...]
+
+    def __init__(self, rules):
+        object.__setattr__(self, "rules", tuple(rules))
+        if not self.rules:
+            raise ValueError("a FaultPlan needs at least one rule")
+        for rule in self.rules:
+            if not isinstance(rule, FaultRule):
+                raise TypeError(f"not a FaultRule: {rule!r}")
+
+    def describe(self) -> List[Dict[str, Any]]:
+        """Plain-data rendering for /healthz and event payloads."""
+        return [dataclasses.asdict(r) for r in self.rules]
+
+
+class _Injector:
+    """Process-global fault-point state. ``_plan`` is the armed flag:
+    written only under ``_lock`` (install/clear), read unlocked on the
+    hot path — the benign-racy-flag idiom (threadcheck GC001 inferred-
+    guard read exemption): a traversal racing a concurrent clear either
+    sees the plan (and fires one last time) or misses it; both are
+    legitimate schedules."""
+
+    def __init__(self):
+        self._lock = ordered_lock("serve.faults._Injector._lock")
+        self._plan: Optional[FaultPlan] = None
+        self._installed_at = 0.0  # guarded-by: _lock
+        # Traversal counts per (point, replica) and (point, None); only
+        # allocated while a plan is armed — the disarmed path never
+        # touches them (the zero-residue guarantee).
+        self._counts: Dict[Tuple[str, Optional[int]], int] = {}  # guarded-by: _lock
+        self._rule_fires: List[int] = []  # guarded-by: _lock
+        self._fired_total = 0  # guarded-by: _lock
+        # Wedge release: replaced at install, set at clear so wedged
+        # threads resume the moment the fault window closes.
+        self._release = threading.Event()
+
+    # ------------------------------------------------------------ arming --
+
+    def install(self, plan: FaultPlan) -> None:
+        with self._lock:
+            if self._plan is not None:
+                raise RuntimeError(
+                    "a FaultPlan is already installed; clear_plan() first "
+                    "(plans are installed as one unit so the schedule "
+                    "stays deterministic)")
+            self._counts = {}
+            self._rule_fires = [0] * len(plan.rules)
+            self._fired_total = 0
+            self._installed_at = time.monotonic()
+            self._release = threading.Event()
+            self._plan = plan
+
+    def clear(self) -> None:
+        with self._lock:
+            self._plan = None
+            # The schedule state dies with its plan: a disarmed injector
+            # is indistinguishable from one that never fired (capture
+            # plan_snapshot() BEFORE clearing when the counts are
+            # evidence — scripts/serve_chaos.py does).
+            self._counts = {}
+            self._rule_fires = []
+            self._fired_total = 0
+            release = self._release
+        release.set()  # unblock any wedged traversal
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Armed state + fire counts for /healthz (plain data)."""
+        with self._lock:
+            plan = self._plan
+            return {
+                "armed": plan is not None,
+                "rules": plan.describe() if plan is not None else [],
+                "fired_total": self._fired_total,
+                "rule_fires": list(self._rule_fires),
+            }
+
+    # ------------------------------------------------------------- firing --
+
+    def _fire(self, point: str, replica: Optional[int],
+              bucket: Optional[int],
+              on_fire: Optional[Callable[[Dict[str, Any]], None]],
+              ) -> Tuple[Dict[str, Any], ...]:
+        now = time.monotonic()
+        fired: List[Tuple[FaultRule, Dict[str, Any]]] = []
+        with self._lock:
+            plan = self._plan
+            if plan is None:  # cleared between the fast check and here
+                return ()
+            release = self._release
+            self._counts[(point, None)] = \
+                self._counts.get((point, None), 0) + 1
+            if replica is not None:
+                self._counts[(point, replica)] = \
+                    self._counts.get((point, replica), 0) + 1
+            for idx, rule in enumerate(plan.rules):
+                if rule.point != point:
+                    continue
+                if rule.replica is not None and rule.replica != replica:
+                    continue
+                n = self._counts[(point, rule.replica
+                                  if rule.replica is not None else None)]
+                if rule.after_s and now - self._installed_at < rule.after_s:
+                    continue
+                if n < rule.nth:
+                    continue
+                if rule.every == 0:
+                    if n != rule.nth:
+                        continue
+                elif (n - rule.nth) % rule.every != 0:
+                    continue
+                if rule.max_fires and self._rule_fires[idx] >= rule.max_fires:
+                    continue
+                self._rule_fires[idx] += 1
+                self._fired_total += 1
+                fired.append((rule, {
+                    "point": point,
+                    "traversal": n,
+                    "fires": self._fired_total,
+                    **({"replica": replica} if replica is not None else {}),
+                    **({"bucket": bucket} if bucket is not None else {}),
+                    **({"value": rule.value} if rule.value else {}),
+                }))
+        # Effects OUTSIDE the lock: a sleeping/wedged fault must not
+        # stall unrelated fault points (or the install/clear path).
+        records = tuple(rec for _, rec in fired)
+        for _, rec in fired:
+            if on_fire is not None:
+                on_fire(rec)
+        for rule, rec in fired:
+            if point in ("replica_latency_ms", "queue_stall"):
+                time.sleep(rule.value / 1000.0)
+            elif point == "replica_wedge":
+                # Block until the plan clears (or the rule's own bound);
+                # 60 s hard ceiling so a forgotten plan cannot hang a
+                # test session forever.
+                release.wait(rule.value if rule.value > 0 else 60.0)
+            elif point == "replica_predict_error":
+                raise InjectedFaultError(
+                    f"injected fault: {point} (traversal "
+                    f"{rec['traversal']}, replica {replica})")
+            # compile_trip has no intrinsic effect: the call site bumps
+            # the retrace watchdog so the trip flows through the real
+            # recompile-observability path.
+        return records
+
+
+_INJECTOR = _Injector()
+
+
+def fire(point: str, replica: Optional[int] = None,
+         bucket: Optional[int] = None,
+         on_fire: Optional[Callable[[Dict[str, Any]], None]] = None,
+         ) -> Tuple[Dict[str, Any], ...]:
+    """Traverse one named fault point. Disarmed (the default): one
+    attribute read + ``None`` check, returns ``()`` — nothing counted,
+    nothing locked. Armed: counts the traversal, fires every matching
+    rule (``on_fire(record)`` per fire, then the effect — which for
+    ``replica_predict_error`` is raising :class:`InjectedFaultError`)."""
+    if _INJECTOR._plan is None:
+        return ()
+    return _INJECTOR._fire(point, replica, bucket, on_fire)
+
+
+def replica_faults(replica: int, bucket: Optional[int] = None,
+                   on_fire: Optional[Callable[[Dict[str, Any]], None]] = None,
+                   ) -> None:
+    """The replica-executor fault points, in deterministic order:
+    latency (sleep) -> wedge (block) -> error (raise). Shared by the
+    batcher's dispatch AND the supervisor's probe, so an armed replica
+    fault fails the probe too — a quarantined replica is only revived
+    once the fault actually clears."""
+    if _INJECTOR._plan is None:
+        return
+    fire("replica_latency_ms", replica=replica, bucket=bucket,
+         on_fire=on_fire)
+    fire("replica_wedge", replica=replica, bucket=bucket, on_fire=on_fire)
+    fire("replica_predict_error", replica=replica, bucket=bucket,
+         on_fire=on_fire)
+
+
+def install_plan(plan: FaultPlan) -> None:
+    """Arm the process-global fault plan (exactly one at a time)."""
+    _INJECTOR.install(plan)
+
+
+def clear_plan() -> None:
+    """Disarm: traversals stop counting, wedged threads release."""
+    _INJECTOR.clear()
+
+
+def plan_snapshot() -> Dict[str, Any]:
+    """Armed state + fire counts (surfaced on ``/healthz``)."""
+    return _INJECTOR.snapshot()
+
+
+@contextlib.contextmanager
+def injected(plan: FaultPlan):
+    """``with injected(FaultPlan([...])):`` — install for the block,
+    always clear (tests must not leak an armed plan into the next)."""
+    install_plan(plan)
+    try:
+        yield
+    finally:
+        clear_plan()

@@ -161,6 +161,22 @@ def validate_load_artifact(doc: Any,
                     problems.append(
                         f"{path}: per_request[{i}].trace_id must be a "
                         f"string or null")
+                elif "attempts" in r:
+                    # Client-retry evidence (--retries): every attempt's
+                    # status/ms, final attempt == the entry's own status.
+                    atts = r["attempts"]
+                    if (not isinstance(atts, list) or len(atts) < 2
+                            or not all(isinstance(a, dict)
+                                       and isinstance(a.get("status"), int)
+                                       for a in atts)):
+                        problems.append(
+                            f"{path}: per_request[{i}].attempts must be "
+                            f">= 2 objects each carrying an int status")
+                    elif atts[-1]["status"] != r["status"]:
+                        problems.append(
+                            f"{path}: per_request[{i}] status "
+                            f"{r['status']} != final attempt status "
+                            f"{atts[-1]['status']}")
             if isinstance(reqs, dict) and isinstance(
                     reqs.get("total"), int) and len(
                     doc["per_request"]) != reqs["total"]:
@@ -246,8 +262,18 @@ def _post_json(host: str, port: int, path: str, doc: Dict[str, Any],
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         body = resp.read()
+        retry_after = resp.getheader("Retry-After")
+        try:
+            # The serve stack always sends integer seconds; a foreign
+            # proxy could legally send an HTTP-date — treat that as
+            # "no hint" rather than crash the client thread.
+            retry_after = (float(retry_after)
+                           if retry_after is not None else None)
+        except ValueError:
+            retry_after = None
         return {"status": resp.status, "body": json.loads(body),
-                "trace_id": resp.getheader("X-Pvraft-Trace")}
+                "trace_id": resp.getheader("X-Pvraft-Trace"),
+                "retry_after": retry_after}
     finally:
         conn.close()
 
@@ -272,10 +298,24 @@ def run_load(
     point_counts: List[int],
     seed: int = 0,
     coord_scale: float = 1.0,
+    retries: int = 0,
+    backoff_ms: float = 50.0,
 ) -> Dict[str, Any]:
     """Issue ``n_requests`` over ``concurrency`` client threads against a
     running server; returns the raw measurement dict (no schema fields).
-    Point counts cycle through ``point_counts`` so every bucket is hit."""
+    Point counts cycle through ``point_counts`` so every bucket is hit.
+
+    ``retries`` (default 0 — committed pre-chaos artifacts keep their
+    exact semantics) bounds client-side re-attempts of 503 responses:
+    each retry backs off by the server's ``Retry-After`` when present
+    (else an exponential ``backoff_ms`` ladder), jittered 0.5-1.5x with
+    a per-request deterministic RNG so two shed clients don't re-arrive
+    in lockstep. Every attempt is recorded: a retried request's
+    ``per_request`` entry carries an ``attempts`` list (schema-additive)
+    and its top-level status/ms are the FINAL attempt's — a request that
+    eventually succeeds counts ``ok``."""
+    import random
+
     rng = np.random.default_rng(seed)
     # Pre-generate the request payloads so client threads measure the
     # server, not numpy.
@@ -299,16 +339,37 @@ def run_load(
                 if i >= n_requests:
                     return
                 cursor["i"] = i + 1
-            t0 = time.monotonic()
-            try:
-                r = _post_json(server.host, server.port, "/predict",
-                               payloads[i])
-                ms = (time.monotonic() - t0) * 1000.0
-                results[i] = {"status": r["status"], "ms": ms,
+            jitter = random.Random((seed + 1) * 100003 + i)
+            attempts: List[Dict[str, Any]] = []
+            for attempt in range(retries + 1):
+                t0 = time.monotonic()
+                retry_after = None
+                try:
+                    r = _post_json(server.host, server.port, "/predict",
+                                   payloads[i])
+                    ms = (time.monotonic() - t0) * 1000.0
+                    retry_after = r.get("retry_after")
+                    attempts.append({"status": r["status"],
+                                     "ms": round(ms, 3)})
+                    result = {"status": r["status"], "ms": ms,
                               "trace_id": r["trace_id"]}
-            except Exception as e:  # noqa: BLE001 — a client error is data
-                results[i] = {"status": -1, "ms": None, "trace_id": None,
+                except Exception as e:  # noqa: BLE001 — a client error is data
+                    attempts.append({"status": -1, "ms": None})
+                    result = {"status": -1, "ms": None, "trace_id": None,
                               "error": f"{type(e).__name__}: {e}"}
+                if result["status"] != 503 or attempt == retries:
+                    break
+                # Bounded retry of explicit backpressure only (503):
+                # honor Retry-After when the server derives one from its
+                # probe cadence, else the exponential ladder; jittered
+                # so shed clients spread out, capped so a chaos run's
+                # wall clock stays bounded.
+                base = (retry_after if retry_after is not None
+                        else (backoff_ms / 1000.0) * (2 ** attempt))
+                time.sleep(min(base, 5.0) * (0.5 + jitter.random()))
+            if len(attempts) > 1:
+                result["attempts"] = attempts
+            results[i] = result
 
     threads = [threading.Thread(target=client, daemon=True)
                for _ in range(concurrency)]
@@ -362,7 +423,10 @@ def run_load(
             {"status": r["status"],
              "ms": round(r["ms"], 3) if r["ms"] is not None else None,
              "n": sizes[i],
-             "trace_id": r.get("trace_id")}
+             "trace_id": r.get("trace_id"),
+             # Per-attempt record of retried requests (absent when the
+             # request went through in one attempt — schema-additive).
+             **({"attempts": r["attempts"]} if "attempts" in r else {})}
             for i, r in enumerate(results)],
         "request_points": {"edges": [int(e) for e in POINT_EDGES],
                            "counts": list(size_hist.counts)},

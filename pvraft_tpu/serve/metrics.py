@@ -123,6 +123,9 @@ class ServeMetrics:
         # Prometheus-only, fed by the serve pool's monitor/watchdog.
         self.device_memory: List[Dict[str, Any]] = []  # guarded-by: _lock
         self.recompiles_total = 0  # guarded-by: _lock
+        # Batches re-dispatched on a different replica after a dispatch
+        # failure (serve/supervisor.py retry-once) — Prometheus-only.
+        self.retries_total = 0  # guarded-by: _lock
 
     def current_in_flight(self) -> int:
         """Locked read of the in-flight gauge for external surfaces
@@ -163,6 +166,12 @@ class ServeMetrics:
         """One retrace-watchdog trip (obs/retrace.py)."""
         with self._lock:
             self.recompiles_total += 1
+
+    def record_retry(self) -> None:
+        """One failed batch re-dispatched on a different replica
+        (serve/batcher.py retry-once-on-other-replica)."""
+        with self._lock:
+            self.retries_total += 1
 
     def record_reject(self, reason: str) -> None:
         with self._lock:
@@ -345,6 +354,21 @@ def render_prometheus(metrics: "ServeMetrics",
                        row["batches_total"],
                        {"replica": row["replica"],
                         "device": row["device_id"]})
+        if any("state" in row for row in replica_stats):
+            from pvraft_tpu.obs.events import REPLICA_STATES
+
+            doc.family("pvraft_serve_replica_state", "gauge",
+                       "Supervisor health state per replica: 1 for the "
+                       "current state, 0 otherwise (serve/supervisor.py "
+                       "state machine).")
+            for row in replica_stats:
+                if "state" not in row:
+                    continue
+                for state in REPLICA_STATES:
+                    doc.sample(
+                        "pvraft_serve_replica_state",
+                        1 if row["state"] == state else 0,
+                        {"replica": row["replica"], "state": state})
     if metrics.device_memory:
         doc.family("pvraft_device_hbm_bytes", "gauge",
                    "Device bytes in use, latest device.memory_stats() "
@@ -366,6 +390,10 @@ def render_prometheus(metrics: "ServeMetrics",
                "the AOT program set sealed (each also rides the event "
                "stream as a `recompile` record).")
     doc.sample("pvraft_serve_recompiles_total", metrics.recompiles_total)
+    doc.family("pvraft_serve_retries_total", "counter",
+               "Failed micro-batches re-dispatched once on a different "
+               "replica (supervisor retry path).")
+    doc.sample("pvraft_serve_retries_total", metrics.retries_total)
     doc.family("pvraft_serve_latency_ms", "histogram",
                "End-to-end request latency (enqueue to resolve), ms.")
     doc.histogram("pvraft_serve_latency_ms", metrics.latency)

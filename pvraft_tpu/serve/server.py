@@ -56,9 +56,11 @@ import numpy as np
 
 from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
 from pvraft_tpu.obs.trace import Tracer
+from pvraft_tpu.serve import faults
 from pvraft_tpu.serve.batcher import (
     BatcherConfig,
     MicroBatcher,
+    PoolUnavailableError,
     QueueFullError,
     ShutdownError,
 )
@@ -122,6 +124,9 @@ class _Handler(BaseHTTPRequestHandler):
     predict_timeout_s: float = 60.0
     max_body_bytes: int = 1 << 24
     quiet: bool = True
+    # 503 Retry-After seconds: one supervisor probe cycle when a
+    # supervisor is wired (build_service), else the default.
+    retry_after_s: int = 1
 
     protocol_version = "HTTP/1.1"
 
@@ -158,6 +163,7 @@ class _Handler(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             tracer = self.tracer
+            supervisor = self.batcher.supervisor
             self._reply_json(200, {
                 "status": "ok",
                 "buckets": list(self.batcher.engine.cfg.buckets),
@@ -165,8 +171,19 @@ class _Handler(BaseHTTPRequestHandler):
                 "min_points": self.batcher.engine.cfg.min_points,
                 "dtype": getattr(self.batcher.engine.cfg, "dtype",
                                  "float32"),
+                # Pool fault-tolerance summary (ISSUE 13): serving
+                # replica count + overall state (ok/degraded/
+                # unavailable) and the probe cadence behind Retry-After;
+                # None when no supervisor is wired.
+                "pool": (supervisor.pool_health()
+                         if supervisor is not None else None),
+                # Armed fault-plan state (chaos runs are operations too:
+                # an operator must be able to SEE that failures are
+                # injected, not real).
+                "faults": faults.plan_snapshot(),
                 # Per-replica visibility (ISSUE 9 satellite): device id,
-                # in-flight count, served-batch counter per replica.
+                # in-flight count, served-batch counter per replica —
+                # plus the supervisor's health state when wired.
                 "replicas": self.batcher.replica_stats(),
                 "in_flight": (self.metrics.current_in_flight()
                               if self.metrics is not None else None),
@@ -350,10 +367,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._finish_trace(trace, code)
             return
         except QueueFullError as e:
+            # Every 503 carries Retry-After (ISSUE 13 satellite): one
+            # supervisor probe cycle — the moment pool health can next
+            # have changed. Well-behaved clients (loadgen --retries)
+            # back off exactly that long.
+            self._extra_headers.append(
+                ("Retry-After", str(self.retry_after_s)))
             self._reply_error(503, "queue_full", str(e))
             self._finish_trace(trace, 503)
             return
+        except PoolUnavailableError as e:
+            # Graceful degradation terminal state: every replica
+            # quarantined — an explicit, immediate shed instead of
+            # accepting work that can only become a queue-timeout 504.
+            self._extra_headers.append(
+                ("Retry-After", str(self.retry_after_s)))
+            self._reply_error(503, "unavailable", str(e))
+            self._finish_trace(trace, 503)
+            return
         except ShutdownError as e:
+            self._extra_headers.append(
+                ("Retry-After", str(self.retry_after_s)))
             self._reply_error(503, "shutting_down", str(e))
             self._finish_trace(trace, 503)
             return
@@ -413,13 +447,16 @@ class ServeHTTPServer:
                  port: int = 8000, metrics=None,
                  predict_timeout_s: float = 60.0, quiet: bool = True,
                  tracer: Optional[Tracer] = None, telemetry=None,
-                 trace_dir: str = "", devmem_monitor=None):
+                 trace_dir: str = "", devmem_monitor=None,
+                 supervisor=None):
         self.batcher = batcher
         self.tracer = tracer
         # Performance-plane hooks (build_service wires them): the
         # device-memory sampler thread and — via the batcher — the
-        # sealed retrace watchdog; shutdown() releases both.
+        # sealed retrace watchdog; shutdown() releases both. The
+        # replica supervisor's probe loop rides the same lifecycle.
         self.devmem_monitor = devmem_monitor
+        self.supervisor = supervisor
         # 64 B/coordinate bounds any JSON float spelling (msgpack raw f32
         # is 4 B); anything past this cannot fit the largest bucket and
         # would only be buffered to be 413'd after parsing.
@@ -438,6 +475,8 @@ class ServeHTTPServer:
             "predict_timeout_s": predict_timeout_s,
             "max_body_bytes": max_body,
             "quiet": quiet,
+            "retry_after_s": (supervisor.cfg.retry_after_s
+                              if supervisor is not None else 1),
         })
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -452,8 +491,15 @@ class ServeHTTPServer:
         self._thread.start()
         if self.devmem_monitor is not None:
             self.devmem_monitor.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
 
     def shutdown(self, drain: bool = True) -> None:
+        # Stop the probe loop FIRST: a probe mid-drain would race the
+        # batcher's inline sweep for the same replica (harmless but
+        # noisy — probes during teardown prove nothing).
+        if self.supervisor is not None:
+            self.supervisor.stop()
         self.batcher.shutdown(drain=drain)
         if self.devmem_monitor is not None:
             self.devmem_monitor.stop()
@@ -476,7 +522,9 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
                   trace_dir: str = "",
                   eager_when_idle: bool = True,
                   strict_retrace: bool = False,
-                  devmem_interval_s: float = 10.0) -> ServeHTTPServer:
+                  devmem_interval_s: float = 10.0,
+                  supervise: bool = True,
+                  supervisor_cfg=None) -> ServeHTTPServer:
     """The one canonical engine -> metrics -> batcher -> HTTP assembly,
     shared by ``python -m pvraft_tpu.serve`` and the load generator so
     the two serving surfaces cannot drift: ``max_batch`` is always the
@@ -495,11 +543,24 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
     ``device.memory_stats()`` every ``devmem_interval_s`` seconds into
     ``device_memory`` events and the ``pvraft_device_hbm_bytes{device}``
     gauge (0 disables; CPU backends sample to nothing either way).
+
+    Fault tolerance (ISSUE 13): ``supervise=True`` (the default) wires a
+    :class:`~pvraft_tpu.serve.supervisor.ReplicaSupervisor` — per-replica
+    health state machine, quarantine + background probe revival,
+    retry-once-on-another-replica, admission capacity scaled to the
+    healthy count, 503s with ``Retry-After``; ``supervisor_cfg``
+    overrides the declared thresholds
+    (``programs/geometries.SUPERVISOR_DEFAULTS``). ``supervise=False``
+    restores the pre-supervision pool bit-for-bit.
     Returns an unstarted server (``.start()`` / ``.shutdown()``)."""
     from pvraft_tpu.obs.device_memory import DeviceMemoryMonitor
     from pvraft_tpu.obs.retrace import RetraceWatchdog
+    from pvraft_tpu.serve.supervisor import ReplicaSupervisor
 
     metrics = ServeMetrics(engine.cfg.buckets)
+    supervisor = (ReplicaSupervisor(engine, cfg=supervisor_cfg,
+                                    telemetry=telemetry)
+                  if supervise else None)
     watchdog = RetraceWatchdog(
         emit=telemetry.emit_recompile if telemetry is not None else None,
         strict=strict_retrace, context="serve")
@@ -520,7 +581,8 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
         BatcherConfig(max_batch=max(engine.cfg.batch_sizes),
                       max_wait_ms=max_wait_ms, queue_depth=queue_depth,
                       eager_when_idle=eager_when_idle),
-        telemetry=telemetry, metrics=metrics, watchdog=watchdog)
+        telemetry=telemetry, metrics=metrics, watchdog=watchdog,
+        supervisor=supervisor)
     tracer = Tracer(
         sample_every=trace_sample_every,
         emit=telemetry.emit_span if telemetry is not None else None)
@@ -530,4 +592,5 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
     return ServeHTTPServer(batcher, host=host, port=port, metrics=metrics,
                            predict_timeout_s=predict_timeout_s, quiet=quiet,
                            tracer=tracer, telemetry=telemetry,
-                           trace_dir=trace_dir, devmem_monitor=devmem)
+                           trace_dir=trace_dir, devmem_monitor=devmem,
+                           supervisor=supervisor)

@@ -1,0 +1,455 @@
+"""Per-replica health supervision: the serve plane's fault-tolerance
+state machine.
+
+Each replica walks ``healthy -> degraded -> quarantined -> probing ->
+healthy`` (``obs.events.REPLICA_STATES``), driven by two signals the
+batcher's dispatch loop reports:
+
+* **hard failures** — a dispatch raised (engine error, injected fault):
+  ``degraded_after`` consecutive failures mark the replica degraded,
+  ``quarantine_after`` pull it from the work-stealing rotation;
+* **latency outliers** — a dispatch slower than ``latency_outlier_factor``
+  x the per-bucket EWMA (after warmup, above an absolute floor):
+  ``latency_outlier_after`` consecutive outliers degrade the replica.
+  Slow is not dead — outliers never quarantine on their own.
+
+A third signal needs no report at all: a dispatch still in flight after
+``wedge_timeout_s`` is a **wedged** executor (the device hung, the
+thread cannot be killed) — the probe loop quarantines it so the
+capacity loss is visible and admission shrinks accordingly.
+
+Quarantined replicas are revived only by the background **probe**: a
+synthetic ``min_points`` request through the replica's own AOT program
+(the smallest bucket's compiled predict — no new compile, the sealed
+retrace watchdog stays quiet). The probe traverses the same replica
+fault points the dispatch path does (``faults.replica_faults``), so an
+armed fault fails the probe too and revival happens only once the fault
+actually clears.
+
+Every transition is a ``replica_state`` event on the ``pvraft_events/v1``
+stream and a ``pvraft_serve_replica_state{replica,state}`` Prometheus
+series; ``/healthz`` reports the per-replica rows plus the pool summary
+(healthy count drives admission capacity and the all-quarantined
+``rejected[unavailable]`` degradation — ``serve/batcher.py``).
+
+Thresholds are declared data (``programs/geometries.SUPERVISOR_DEFAULTS``),
+not literals here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
+from pvraft_tpu.obs.events import REPLICA_STATES
+from pvraft_tpu.programs.geometries import SUPERVISOR_DEFAULTS
+from pvraft_tpu.serve import faults
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    """The state machine's trip points — defaults are the registry's
+    declared data (``geometries.SUPERVISOR_DEFAULTS``); tests tighten
+    them, production overrides ride the serve CLI flags."""
+
+    degraded_after: int = SUPERVISOR_DEFAULTS["degraded_after"]
+    quarantine_after: int = SUPERVISOR_DEFAULTS["quarantine_after"]
+    latency_outlier_factor: float = \
+        SUPERVISOR_DEFAULTS["latency_outlier_factor"]
+    latency_outlier_after: int = SUPERVISOR_DEFAULTS["latency_outlier_after"]
+    latency_min_samples: int = SUPERVISOR_DEFAULTS["latency_min_samples"]
+    latency_floor_ms: float = SUPERVISOR_DEFAULTS["latency_floor_ms"]
+    probe_interval_s: float = SUPERVISOR_DEFAULTS["probe_interval_s"]
+    probe_timeout_s: float = SUPERVISOR_DEFAULTS["probe_timeout_s"]
+    wedge_timeout_s: float = SUPERVISOR_DEFAULTS["wedge_timeout_s"]
+
+    def __post_init__(self):
+        if self.degraded_after < 1 or self.quarantine_after < 1:
+            raise ValueError("failure thresholds must be >= 1")
+        if self.quarantine_after < self.degraded_after:
+            raise ValueError(
+                "quarantine_after must be >= degraded_after (the state "
+                "machine escalates, never skips backwards)")
+        if self.latency_outlier_factor <= 1.0:
+            raise ValueError("latency_outlier_factor must be > 1")
+        if self.probe_interval_s < 0 or self.wedge_timeout_s <= 0 \
+                or self.probe_timeout_s <= 0:
+            raise ValueError(
+                "probe_interval_s/probe_timeout_s/wedge_timeout_s invalid")
+
+    @property
+    def retry_after_s(self) -> int:
+        """What a 503's ``Retry-After`` header advertises: one probe
+        cycle (rounded up, >= 1 s) — a client retrying then meets a
+        pool whose health was re-evaluated at least once."""
+        import math
+
+        return max(1, int(math.ceil(self.probe_interval_s)))
+
+
+def _transition(states: List[str], replicas, i: int, new: str,
+                reason: str) -> Dict[str, Any]:
+    """Apply one state transition and build its ``replica_state``
+    record. Module-level on purpose: callers hold the supervisor lock,
+    and the lexical lock analysis (threadcheck GC001) then sees every
+    state mutation at a locked call site instead of inside an
+    un-annotatable helper method."""
+    assert new in REPLICA_STATES
+    old = states[i]
+    states[i] = new
+    return {
+        "replica": i, "state": new, "from_state": old, "reason": reason,
+        "device_id": int(getattr(replicas[i], "device_id", i)),
+    }
+
+
+def _observe_latency(ewma: Dict[int, List[float]], cfg: SupervisorConfig,
+                     bucket: int, seconds: float) -> bool:
+    """Outlier decision + EWMA update for one dispatch (caller holds
+    the supervisor lock — module-level for the same reason as
+    :func:`_transition`). The EWMA is fed by non-outlier samples only:
+    outliers must not drag the baseline toward the pathology they
+    measure. Below the absolute floor nothing is an outlier (sub-ms
+    scheduler noise must not degrade a replica)."""
+    slot = ewma.setdefault(int(bucket), [0.0, 0])
+    mean, count = slot[0], int(slot[1])
+    outlier = (
+        count >= cfg.latency_min_samples
+        and seconds * 1000.0 > cfg.latency_floor_ms
+        and seconds > cfg.latency_outlier_factor * mean)
+    if not outlier:
+        slot[0] = seconds if count == 0 else 0.8 * mean + 0.2 * seconds
+        slot[1] = count + 1
+    return outlier
+
+
+class ReplicaSupervisor:
+    """Health state per replica + the background probe/wedge-scan loop.
+
+    Thread-safe: the batcher's executors report dispatch outcomes
+    concurrently while the probe thread transitions states. Transitions
+    are decided under ``_lock`` and EMITTED after release (telemetry
+    does file I/O behind its own lock — never nest ours over it)."""
+
+    def __init__(self, engine, cfg: Optional[SupervisorConfig] = None,
+                 telemetry=None):
+        self.engine = engine
+        self.cfg = cfg or SupervisorConfig()
+        self.telemetry = telemetry
+        self.replicas = list(getattr(engine, "replicas", ()) or ()) \
+            or [engine]
+        n = len(self.replicas)
+        self._lock = ordered_lock("ReplicaSupervisor._lock")
+        self._state = ["healthy"] * n            # guarded-by: _lock
+        self._fail_streak = [0] * n              # guarded-by: _lock
+        self._outlier_streak = [0] * n           # guarded-by: _lock
+        # bucket -> [ewma_seconds, samples]; fed by non-outlier
+        # dispatches only (outliers must not drag the baseline toward
+        # the pathology they measure).
+        self._ewma: Dict[int, List[float]] = {}  # guarded-by: _lock
+        # Per-replica in-flight dispatch start times, keyed by the token
+        # note_dispatch_start returns. A replica can run >1 dispatch at
+        # once (its executor plus a sibling's retry), so one slot would
+        # be clobbered — a wedged dispatch silently untracked.
+        self._dispatch_started: List[Dict[int, float]] = \
+            [{} for _ in range(n)]               # guarded-by: _lock
+        self._dispatch_tokens = 0                # guarded-by: _lock
+        self._transitions = 0                    # guarded-by: _lock
+        self._probes = 0                         # guarded-by: _lock
+        self._probe_failures = 0                 # guarded-by: _lock
+        # Probe payload built once, before any thread exists. The engine
+        # owns the request contract, so it builds the payload
+        # (InferenceEngine.probe_request); the fallback covers pool
+        # doubles that only expose the config surface.
+        probe = getattr(engine, "probe_request", None)
+        if probe is not None:
+            self._probe_cloud, self._probe_bucket = probe()
+        else:
+            ecfg = self.engine.cfg
+            n_pts = max(int(getattr(ecfg, "min_points", 4)), 1)
+            scale = min(1.0,
+                        0.5 * float(getattr(ecfg, "coord_limit", 100.0)))
+            rng = np.random.default_rng(0)
+            self._probe_cloud = rng.uniform(
+                -scale, scale, (n_pts, 3)).astype(np.float32)
+            self._probe_bucket = int(ecfg.buckets[0])
+        self._probe_bucket = int(self._probe_bucket)
+        # Probe-loop lifecycle (the DeviceMemoryMonitor pattern,
+        # threadcheck GC003): start/stop swap the thread field under one
+        # lock so concurrent callers cannot double-start or join a
+        # replaced thread.
+        self._stop = threading.Event()
+        self._state_lock = ordered_lock("ReplicaSupervisor._state_lock")
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _state_lock
+
+    # -------------------------------------------------------- transitions --
+
+    def _emit(self, transitions: List[Dict[str, Any]]) -> None:
+        for t in transitions:
+            if self.telemetry is not None:
+                self.telemetry.emit_replica_state(**t)
+
+    def _on_fault(self, record: Dict[str, Any]) -> None:
+        """Probe-side fault_injected sink (the batcher has its own)."""
+        if self.telemetry is not None:
+            self.telemetry.emit_fault(**record)
+
+    # ------------------------------------------------------------ signals --
+
+    def record_success(self, i: int, bucket: int, seconds: float) -> None:
+        """A dispatch on replica ``i`` completed in ``seconds``. Resets
+        the failure streak; feeds the latency-outlier signal; recovers
+        a degraded replica. A quarantined/probing replica's straggler
+        dispatch (started before the quarantine) changes nothing — only
+        the probe revives."""
+        transitions: List[Dict[str, Any]] = []
+        with self._lock:
+            if self._state[i] in ("quarantined", "probing"):
+                pass
+            elif _observe_latency(self._ewma, self.cfg, bucket, seconds):
+                self._outlier_streak[i] += 1
+                if (self._state[i] == "healthy"
+                        and self._outlier_streak[i]
+                        >= self.cfg.latency_outlier_after):
+                    transitions.append(_transition(
+                        self._state, self.replicas, i, "degraded",
+                        "latency_outlier"))
+                    self._transitions += 1
+            else:
+                self._fail_streak[i] = 0
+                self._outlier_streak[i] = 0
+                if self._state[i] == "degraded":
+                    transitions.append(_transition(
+                        self._state, self.replicas, i, "healthy",
+                        "recovered"))
+                    self._transitions += 1
+        self._emit(transitions)
+
+    def record_failure(self, i: int, reason: str = "dispatch_error") -> None:
+        """A dispatch on replica ``i`` raised. Escalates healthy ->
+        degraded -> quarantined on consecutive failures."""
+        transitions: List[Dict[str, Any]] = []
+        with self._lock:
+            if self._state[i] not in ("quarantined", "probing"):
+                self._fail_streak[i] += 1
+                if self._fail_streak[i] >= self.cfg.quarantine_after:
+                    transitions.append(_transition(
+                        self._state, self.replicas, i, "quarantined",
+                        reason))
+                    self._transitions += 1
+                elif (self._fail_streak[i] >= self.cfg.degraded_after
+                      and self._state[i] == "healthy"):
+                    transitions.append(_transition(
+                        self._state, self.replicas, i, "degraded", reason))
+                    self._transitions += 1
+        self._emit(transitions)
+
+    def note_dispatch_start(self, i: int, t: float) -> int:
+        """Track one in-flight dispatch; returns the token the matching
+        :meth:`note_dispatch_end` must pass back (concurrent dispatches
+        on one replica — its executor plus a sibling's retry — each get
+        their own entry, so a wedged one stays visible)."""
+        with self._lock:
+            self._dispatch_tokens += 1
+            token = self._dispatch_tokens
+            self._dispatch_started[i][token] = t
+        return token
+
+    def note_dispatch_end(self, i: int, token: int) -> None:
+        with self._lock:
+            self._dispatch_started[i].pop(token, None)
+
+    # ------------------------------------------------------------ queries --
+
+    def state_of(self, i: int) -> str:
+        with self._lock:
+            return self._state[i]
+
+    def in_rotation(self, i: int) -> bool:
+        """May this replica's executor pull work? Degraded replicas keep
+        serving (visibly); quarantined/probing ones are out."""
+        with self._lock:
+            return self._state[i] in ("healthy", "degraded")
+
+    def serving_count(self) -> int:
+        """Replicas still in the work-stealing rotation — the admission
+        capacity the batcher scales by."""
+        with self._lock:
+            return sum(1 for s in self._state
+                       if s in ("healthy", "degraded"))
+
+    def retry_target(self, exclude: int) -> Optional[int]:
+        """A different in-rotation replica for the one retry a failed
+        batch gets (healthy preferred over degraded), or None."""
+        with self._lock:
+            for want in ("healthy", "degraded"):
+                for i, s in enumerate(self._state):
+                    if i != exclude and s == want:
+                        return i
+        return None
+
+    def states(self) -> List[Dict[str, Any]]:
+        """Per-replica health rows for ``/healthz`` and Prometheus."""
+        with self._lock:
+            return [{"replica": i, "state": self._state[i],
+                     "fail_streak": self._fail_streak[i],
+                     "outlier_streak": self._outlier_streak[i]}
+                    for i in range(len(self.replicas))]
+
+    def pool_health(self) -> Dict[str, Any]:
+        """The ``/healthz`` pool summary: serving count + overall state
+        (``ok`` / ``degraded`` capacity / ``unavailable``)."""
+        with self._lock:
+            serving = sum(1 for s in self._state
+                          if s in ("healthy", "degraded"))
+            total = len(self._state)
+        state = ("unavailable" if serving == 0
+                 else "degraded" if serving < total else "ok")
+        return {"state": state, "healthy_replicas": serving,
+                "replicas_total": total,
+                "probe_interval_s": self.cfg.probe_interval_s,
+                "retry_after_s": self.cfg.retry_after_s}
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {"transitions": self._transitions,
+                    "probes": self._probes,
+                    "probe_failures": self._probe_failures}
+
+    # ------------------------------------------------------------- probes --
+
+    def poll(self) -> None:
+        """One supervision pass: wedge scan, then probe every
+        quarantined replica. Public so tests drive the state machine
+        deterministically without the background thread."""
+        self._scan_wedged()
+        self._probe_quarantined()
+
+    def _scan_wedged(self) -> None:
+        now = time.monotonic()
+        transitions: List[Dict[str, Any]] = []
+        with self._lock:
+            for i, starts in enumerate(self._dispatch_started):
+                if (starts
+                        and now - min(starts.values())
+                        > self.cfg.wedge_timeout_s
+                        and self._state[i] not in ("quarantined",
+                                                   "probing")):
+                    transitions.append(_transition(
+                        self._state, self.replicas, i, "quarantined",
+                        "wedged"))
+                    self._transitions += 1
+        self._emit(transitions)
+
+    def _probe_quarantined(self) -> None:
+        with self._lock:
+            # Skip replicas that still have a dispatch in flight (the
+            # wedged case): the device is demonstrably stuck, so a probe
+            # would only wedge the supervisor loop beside it — the
+            # replica becomes probe-eligible once the stuck dispatch
+            # actually returns (e.g. the injected wedge released).
+            targets = [i for i, s in enumerate(self._state)
+                       if s == "quarantined"
+                       and not self._dispatch_started[i]]
+        for i in targets:
+            with self._lock:
+                # Re-check under the lock: a concurrent poll() (tests
+                # drive it directly) may already be probing this one.
+                if self._state[i] != "quarantined":
+                    continue
+                transitions = [_transition(
+                    self._state, self.replicas, i, "probing", "probe")]
+                self._transitions += 1
+                self._probes += 1
+            self._emit(transitions)
+            ok = self._probe(i)
+            with self._lock:
+                if self._state[i] != "probing":
+                    continue
+                if ok:
+                    self._fail_streak[i] = 0
+                    self._outlier_streak[i] = 0
+                    transitions = [_transition(
+                        self._state, self.replicas, i, "healthy",
+                        "probe_ok")]
+                else:
+                    self._probe_failures += 1
+                    transitions = [_transition(
+                        self._state, self.replicas, i, "quarantined",
+                        "probe_failed")]
+                self._transitions += 1
+            self._emit(transitions)
+
+    def _probe(self, i: int) -> bool:
+        """Synthetic min-points request through the replica's own AOT
+        program (the smallest bucket — always compiled, so no new
+        backend compile and the sealed retrace watchdog stays quiet).
+        Traverses the replica fault points first: an armed fault fails
+        the probe, exactly like a dispatch.
+
+        The probe runs on a bounded worker thread: a replica that hangs
+        mid-probe (a genuinely dead device) must cost ONE
+        ``probe_timeout_s``, not the whole supervisor loop — wedge scans
+        and every other replica's revival keep running. A timed-out
+        probe counts as failed; its late completion (the daemon thread
+        eventually returning) transitions nothing, because only this
+        loop consumes the result."""
+        result: Dict[str, bool] = {}
+
+        def run() -> None:
+            try:
+                faults.replica_faults(i, bucket=self._probe_bucket,
+                                      on_fire=self._on_fault)
+                self.replicas[i].predict_batch(
+                    [(self._probe_cloud, self._probe_cloud)],
+                    self._probe_bucket)
+                result["ok"] = True
+            except BaseException:  # noqa: BLE001 — a failed probe is a state, not a crash
+                result["ok"] = False
+
+        worker = threading.Thread(target=run, daemon=True,
+                                  name=f"pvraft-serve-probe-r{i}")
+        worker.start()
+        worker.join(self.cfg.probe_timeout_s)
+        return bool(result.get("ok"))
+
+    # ---------------------------------------------------------- lifecycle --
+
+    def start(self) -> None:
+        with self._state_lock:
+            if self.cfg.probe_interval_s <= 0 or self._thread is not None:
+                return
+            self._stop.clear()  # restartable: stop() leaves the flag set
+            self._thread = threading.Thread(
+                target=self._run, name="pvraft-serve-supervisor",
+                daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll()
+            except Exception:  # noqa: BLE001 — supervise, never crash serving
+                pass
+            self._stop.wait(self.cfg.probe_interval_s)
+
+    def stop(self) -> None:
+        # Join under the lifecycle lock: the probe thread never takes
+        # it, so no deadlock — this only serializes a concurrent
+        # start() against the swap (the DeviceMemoryMonitor pattern).
+        with self._state_lock:
+            thread = self._thread
+            if thread is None:
+                return
+            self._thread = None
+            self._stop.set()
+            # Join INSIDE the lock: a concurrent start() must not clear
+            # the stop flag while the old thread is still polling it
+            # (it would survive and run beside the replacement).
+            thread.join(10.0)

@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Chaos-run evidence: kill one replica mid-load, commit the recovery.
+
+Stands up the full fault-tolerant serve stack (2-replica AOT pool,
+supervisor, tracing) on virtual CPU devices, then runs the ISSUE-13
+acceptance scenario as a four-phase load:
+
+  1. healthy baseline load;
+  2. an armed :class:`FaultPlan` permanently fails every dispatch on
+     replica 1 — the batcher retries each failed batch once on replica
+     0, the supervisor walks replica 1 to quarantined, admission
+     capacity shrinks;
+  3. the fault clears; a background probe (through replica 1's own AOT
+     program) revives it;
+  4. recovery load on the full pool.
+
+The script REFUSES to write evidence unless the acceptance properties
+actually held: every request resolved (ok + rejected + errors ==
+total), the quarantine and the probe revival were observed, the final
+server metrics reconcile, and the sealed retrace watchdog counted ZERO
+recompiles end to end.
+
+Committed artifacts (validated by ``scripts/lint.sh``'s existing
+validate-load / validate-events / validate-trace globs):
+
+    artifacts/serve_chaos.json          pvraft_serve_load/v1 (merged
+                                        phases; config.chaos documents
+                                        the plan + observed walk)
+    artifacts/serve_chaos.events.jsonl  pvraft_events/v1 incl.
+                                        replica_state + fault_injected
+    artifacts/serve_chaos.trace.json    pvraft_trace/v1
+
+    python scripts/serve_chaos.py --out artifacts/serve_chaos.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pvraft_tpu import parse_int_list as _parse_ints  # noqa: E402 — needs the path hack
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/serve_chaos.json")
+    ap.add_argument("--events", default="",
+                    help="events path (default: <out stem>.events.jsonl)")
+    ap.add_argument("--buckets", default="128")
+    ap.add_argument("--batch_sizes", default="1,4")
+    ap.add_argument("--truncate_k", type=int, default=32)
+    ap.add_argument("--graph_k", type=int, default=8)
+    ap.add_argument("--corr_knn", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=16,
+                    help="requests PER PHASE (three measured phases)")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--retries", type=int, default=2,
+                    help="client retries during the fault phase")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--probe_interval", type=float, default=0.1)
+    args = ap.parse_args()
+
+    from pvraft_tpu.serve.loadgen import force_host_device_count
+
+    force_host_device_count(2)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models.raft import PVRaft
+    from pvraft_tpu.serve import (
+        FaultPlan,
+        FaultRule,
+        InferenceEngine,
+        ServeConfig,
+        ServeTelemetry,
+        build_service,
+        faults,
+    )
+    from pvraft_tpu.serve.loadgen import (
+        SCHEMA_VERSION,
+        merge_measurements,
+        run_load,
+        write_load_and_trace,
+    )
+    from pvraft_tpu.serve.supervisor import SupervisorConfig
+
+    model = ModelConfig(truncate_k=args.truncate_k, graph_k=args.graph_k,
+                        corr_knn=args.corr_knn)
+    cfg = ServeConfig(model=model, buckets=_parse_ints(args.buckets),
+                      batch_sizes=_parse_ints(args.batch_sizes),
+                      num_iters=args.iters, dtype="float32", replicas=2)
+    sup_cfg = SupervisorConfig(degraded_after=1, quarantine_after=2,
+                               probe_interval_s=args.probe_interval)
+    events_path = args.events or (
+        os.path.splitext(args.out)[0] + ".events.jsonl")
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    if os.path.exists(events_path):
+        os.unlink(events_path)
+    telemetry = ServeTelemetry(events_path, cfg=cfg)
+
+    m = PVRaft(model)
+    rng = np.random.default_rng(args.seed)
+    pc = jax.numpy.asarray(
+        rng.uniform(-1, 1, (1, cfg.buckets[0], 3)).astype(np.float32))
+    params = m.init(jax.random.key(args.seed), pc, pc, 2)
+    print(f"[chaos] compiling the 2-replica pool "
+          f"(buckets={cfg.buckets}, batch_sizes={cfg.batch_sizes})...",
+          flush=True)
+    engine = InferenceEngine(params, cfg, telemetry=telemetry)
+
+    server = build_service(engine, max_wait_ms=5, queue_depth=64,
+                           telemetry=telemetry, trace_sample_every=1,
+                           supervisor_cfg=sup_cfg)
+    server.start()
+    sup = server.supervisor
+    print(f"[chaos] serving on port {server.port}; "
+          f"probe every {sup_cfg.probe_interval_s}s", flush=True)
+
+    def poll(predicate, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.05)
+        return predicate()
+
+    counts = [max(engine.cfg.min_points, int(0.75 * cfg.buckets[0])),
+              max(engine.cfg.min_points, int(0.95 * cfg.buckets[0]))]
+    observed = {"quarantined": False, "revived": False}
+    rounds = []
+
+    # Phase 1: healthy baseline.
+    print("[chaos] phase 1: healthy baseline", flush=True)
+    rounds.append(run_load(server, n_requests=args.requests,
+                           concurrency=args.concurrency,
+                           point_counts=counts, seed=args.seed))
+
+    # Phase 2: replica 1 permanently fails mid-load.
+    plan = FaultPlan([FaultRule("replica_predict_error", nth=1, every=1,
+                                replica=1)])
+    plan_doc = plan.describe()
+    print("[chaos] phase 2: fault armed — replica 1 fails every dispatch",
+          flush=True)
+    faults.install_plan(plan)
+    rounds.append(run_load(server, n_requests=args.requests,
+                           concurrency=args.concurrency,
+                           point_counts=counts, seed=args.seed + 1,
+                           retries=args.retries))
+    observed["quarantined"] = poll(
+        lambda: sup.state_of(1) == "quarantined")
+    fault_evidence = faults.plan_snapshot()
+    print(f"[chaos]   replica 1 state: {sup.state_of(1)}; "
+          f"fault fires: {fault_evidence['fired_total']}", flush=True)
+
+    # Phase 3: fault clears; the probe revives replica 1.
+    faults.clear_plan()
+    observed["revived"] = poll(lambda: sup.state_of(1) == "healthy")
+    print(f"[chaos] phase 3: fault cleared — replica 1 state: "
+          f"{sup.state_of(1)} after "
+          f"{sup.counts['probes']} probe(s)", flush=True)
+
+    # Phase 4: recovery load on the full pool.
+    print("[chaos] phase 4: recovery load", flush=True)
+    rounds.append(run_load(server, n_requests=args.requests,
+                           concurrency=args.concurrency,
+                           point_counts=counts, seed=args.seed + 2))
+
+    supervisor_counts = sup.counts
+    retries_total = server.batcher.counts["retries"]
+    server.shutdown(drain=True)
+    telemetry.close()
+
+    merged = merge_measurements(rounds)
+    sm = merged["server_metrics"]
+
+    # --- acceptance gate: refuse to commit evidence that proves nothing.
+    problems = []
+    req = merged["requests"]
+    if req["ok"] + req["rejected"] + req["errors"] != req["total"]:
+        problems.append(f"requests do not reconcile: {req}")
+    if not observed["quarantined"]:
+        problems.append("replica 1 was never quarantined")
+    if not observed["revived"]:
+        problems.append("replica 1 was never revived by a probe")
+    if sm["requests_total"] != sm["responses_total"] + \
+            sum(sm["rejected"].values()):
+        problems.append(f"server metrics do not reconcile: {sm}")
+    recompiles = sum(1 for line in open(events_path, encoding="utf-8")
+                     if '"recompile"' in line
+                     and json.loads(line)["type"] == "recompile")
+    if recompiles:
+        problems.append(f"{recompiles} recompile event(s): the sealed "
+                        "watchdog fired — recovery was not compile-free")
+    if problems:
+        for p in problems:
+            print(f"[chaos] ACCEPTANCE FAILURE: {p}", file=sys.stderr)
+        return 1
+
+    artifact = {
+        "schema": SCHEMA_VERSION,
+        "config": {
+            "buckets": list(cfg.buckets),
+            "batch_sizes": list(cfg.batch_sizes),
+            "num_iters": cfg.num_iters,
+            "truncate_k": model.truncate_k,
+            "graph_k": model.graph_k,
+            "corr_knn": model.corr_knn,
+            "compute_dtype": cfg.dtype,
+            "requests": args.requests * 3,
+            "concurrency": args.concurrency,
+            "retries": args.retries,
+            "point_counts": counts,
+            "weights": "random_init",
+            "platform": jax.devices()[0].platform,
+            "replicas": len(engine.replicas),
+            "eager_when_idle": True,
+            "chaos": {
+                "plan": plan_doc,
+                "phases": ["healthy", "replica_1_failed", "recovered"],
+                "supervisor": {
+                    "degraded_after": sup_cfg.degraded_after,
+                    "quarantine_after": sup_cfg.quarantine_after,
+                    "probe_interval_s": sup_cfg.probe_interval_s,
+                },
+                "observed": {
+                    **observed,
+                    "fault_fires": fault_evidence["fired_total"],
+                    "probes": supervisor_counts["probes"],
+                    "probe_failures": supervisor_counts["probe_failures"],
+                    "transitions": supervisor_counts["transitions"],
+                    "batch_retries": retries_total,
+                    "recompiles": 0,
+                },
+            },
+        },
+        "compile": engine.compile_report(),
+        **merged,
+    }
+    trace_path, trace_doc = write_load_and_trace(args.out, artifact,
+                                                 events_path,
+                                                 log_prefix="chaos")
+    print(f"[chaos] wrote {args.out}, {events_path} and {trace_path}")
+    print(f"[chaos] traces: {trace_doc['counts']}")
+    print(json.dumps({
+        "ok": req["ok"], "rejected": req["rejected"],
+        "errors": req["errors"],
+        "quarantined_then_revived": True,
+        "batch_retries": retries_total,
+        "probes": supervisor_counts["probes"],
+        "recompiles": 0,
+        "p50_ms": merged["latency_ms"]["p50"],
+        "throughput_rps": merged["throughput_rps"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

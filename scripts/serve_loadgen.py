@@ -72,6 +72,12 @@ def main() -> int:
     ap.add_argument("--no-eager", action="store_true",
                     help="PR-7 baseline batching: always wait out "
                          "max_wait_ms (the A/B control leg)")
+    ap.add_argument("--retries", type=int, default=0,
+                    help="client-side bounded retries of 503 responses "
+                         "(jittered backoff honoring Retry-After; every "
+                         "attempt recorded in per_request[].attempts). "
+                         "Default 0 keeps committed artifacts' exact "
+                         "semantics")
     args = ap.parse_args()
 
     # Virtual device count must land before the backend initializes
@@ -158,7 +164,8 @@ def main() -> int:
 
     measurement = run_load(server, n_requests=args.requests,
                            concurrency=args.concurrency,
-                           point_counts=counts, seed=args.seed)
+                           point_counts=counts, seed=args.seed,
+                           retries=args.retries)
     server.shutdown(drain=True)
     telemetry.close()
 
@@ -181,6 +188,7 @@ def main() -> int:
             "platform": jax.devices()[0].platform,
             "replicas": len(engine.replicas),
             "eager_when_idle": not args.no_eager,
+            "retries": args.retries,
         },
         "compile": engine.compile_report(),
         **measurement,

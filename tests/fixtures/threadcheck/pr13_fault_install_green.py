@@ -1,0 +1,43 @@
+"""CURRENT shape of the ISSUE-13 fault-injector install path (clean).
+
+The exclusivity check, the schedule-state reset and the plan assignment
+are ONE critical section under the injector lock — concurrent
+installers serialize, exactly one wins, and no traversal can observe a
+half-reset schedule. The armed-flag fast path reads ``_plan`` unlocked
+(the benign-racy-flag idiom: a traversal racing a clear either sees the
+plan or misses it, both legitimate schedules); every WRITE is locked.
+"""
+
+import threading
+
+
+class Injector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plan = None
+        self._counts = {}
+        self._fired_total = 0
+
+    def install(self, plan):
+        with self._lock:
+            if self._plan is not None:
+                raise RuntimeError("a plan is already installed")
+            self._counts = {}
+            self._fired_total = 0
+            self._plan = plan
+
+    def clear(self):
+        with self._lock:
+            self._plan = None
+            self._counts = {}
+            self._fired_total = 0
+
+    def fire(self, point):
+        if self._plan is None:         # benign-racy armed check (read)
+            return ()
+        with self._lock:
+            if self._plan is None:     # re-check under the lock
+                return ()
+            self._counts[point] = self._counts.get(point, 0) + 1
+            self._fired_total += 1
+        return (point,)

@@ -1,0 +1,42 @@
+"""PRE-fix shape of the ISSUE-13 fault-injector install race (detected:
+GC003).
+
+The fault injector is process-global: the chaos suite arms and clears
+plans while batcher executors traverse fault points concurrently. The
+naive ``install`` tests ``self._plan`` and assigns it later with no
+lock — two concurrent installers both pass the exclusivity check and
+both install, so the "exactly one deterministic schedule" contract
+silently becomes last-writer-wins with interleaved counter resets (a
+traversal between the two resets fires against half-initialized
+state). Found during the design review of ``serve/faults.py``; the
+shipped shape runs the whole check-reset-assign transition under the
+injector lock.
+"""
+
+import threading
+
+
+class Injector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plan = None
+        self._counts = {}
+        self._fired_total = 0
+
+    def install(self, plan):
+        if self._plan is not None:     # check...
+            raise RuntimeError("a plan is already installed")
+        self._counts = {}
+        self._fired_total = 0
+        self._plan = plan              # ...then act, no lock held
+
+    def clear(self):
+        self._plan = None
+
+    def fire(self, point):
+        if self._plan is None:
+            return ()
+        with self._lock:
+            self._counts[point] = self._counts.get(point, 0) + 1
+            self._fired_total += 1
+        return (point,)

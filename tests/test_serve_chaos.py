@@ -1,0 +1,495 @@
+"""Chaos acceptance suite (ISSUE 13): kill a replica mid-load under a
+deterministic FaultPlan and prove the pool survives.
+
+The flagship test drives a REAL 2-replica AOT engine over HTTP while an
+armed FaultPlan permanently fails one replica's dispatches: every
+request must resolve, the ``requests_total == responses_total +
+Σrejected + in_flight`` identity must hold at every polled snapshot
+(parsed from ONE atomic Prometheus render), the failed replica must be
+quarantined and then revived by a probe after the fault clears, and
+post-recovery throughput returns with ZERO recompiles (the sealed
+retrace watchdog stays quiet — the probe runs through an
+already-compiled program).
+
+The deterministic-thread tests (degradation to ``rejected[unavailable]``,
+Retry-After headers, compile_trip through the watchdog, client-side
+loadgen retries) use a fake pool — real sockets and real threads, no
+XLA. Multi-replica reality rides the conftest-pinned virtual device
+count, like test_serve_pool.
+"""
+
+import json
+import re
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.config import ModelConfig
+from pvraft_tpu.models import PVRaft
+from pvraft_tpu.serve import (
+    FaultPlan,
+    FaultRule,
+    InferenceEngine,
+    ServeConfig,
+    ServeTelemetry,
+    build_service,
+    faults,
+)
+from pvraft_tpu.serve.engine import RequestError
+from pvraft_tpu.serve.loadgen import run_load, validate_load_artifact
+from pvraft_tpu.serve.supervisor import SupervisorConfig
+
+TINY_MODEL = ModelConfig(truncate_k=16, corr_knn=8, graph_k=4)
+CHAOS_SERVE = ServeConfig(model=TINY_MODEL, buckets=(32,),
+                          batch_sizes=(1, 2), num_iters=2,
+                          dtype="float32", replicas=2)
+TIGHT = SupervisorConfig(degraded_after=1, quarantine_after=2,
+                         probe_interval_s=0.05, wedge_timeout_s=30.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.clear_plan()
+
+
+@pytest.fixture(scope="module")
+def chaos_pool():
+    """One 2-replica fp32 AOT engine for the module (the replica > 0
+    table compiles against the in-process executable cache, so this
+    costs ~one table of wall clock — the test_serve_pool discipline)."""
+    rng = np.random.default_rng(0)
+    model = PVRaft(TINY_MODEL)
+    pc = jnp.asarray(rng.uniform(-1, 1, (1, 24, 3)).astype(np.float32))
+    params = model.init(jax.random.key(0), pc, pc, 2)
+    return InferenceEngine(params, CHAOS_SERVE)
+
+
+def _pc(n, seed=0):
+    return np.random.default_rng(seed).uniform(
+        -1, 1, (n, 3)).astype(np.float32)
+
+
+def _poll(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def _http(method, host, port, path, body=None,
+          ctype="application/json"):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    try:
+        headers = {"Content-Type": ctype} if body is not None else {}
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def _get_json(server, path):
+    return json.loads(_http("GET", server.host, server.port, path)[1])
+
+
+def _prom_counters(text):
+    """{metric: value} for the identity's unlabeled samples plus the
+    summed rejected counter — all read from ONE exposition render (the
+    handler holds the metrics lock for the whole render, so this IS an
+    atomic snapshot)."""
+    out = {}
+    for name in ("pvraft_serve_requests_total",
+                 "pvraft_serve_responses_total",
+                 "pvraft_serve_in_flight",
+                 "pvraft_serve_recompiles_total",
+                 "pvraft_serve_retries_total"):
+        m = re.search(rf"^{name} (\S+)$", text, re.M)
+        out[name] = float(m.group(1)) if m else 0.0
+    out["rejected"] = sum(
+        float(v) for v in re.findall(
+            r'^pvraft_serve_rejected_total\{[^}]*\} (\S+)$', text, re.M))
+    return out
+
+
+# ------------------------------------------------- the acceptance test --
+
+
+def test_chaos_replica_failure_quarantine_probe_recovery(
+        chaos_pool, tmp_path):
+    """THE ISSUE-13 acceptance scenario, on the real AOT pool."""
+    events_path = str(tmp_path / "chaos.events.jsonl")
+    telemetry = ServeTelemetry(events_path, cfg=CHAOS_SERVE)
+    server = build_service(chaos_pool, max_wait_ms=2, queue_depth=32,
+                           telemetry=telemetry, trace_sample_every=0,
+                           supervisor_cfg=TIGHT)
+    server.start()
+    sup = server.supervisor
+    assert sup is not None
+
+    identity_violations = []
+    stop_poll = threading.Event()
+
+    def poller():
+        while not stop_poll.is_set():
+            _, body, _ = _http("GET", server.host, server.port,
+                               "/metrics?format=prometheus")
+            c = _prom_counters(body.decode())
+            if c["pvraft_serve_requests_total"] != (
+                    c["pvraft_serve_responses_total"] + c["rejected"]
+                    + c["pvraft_serve_in_flight"]):
+                identity_violations.append(c)
+            time.sleep(0.01)
+
+    poll_thread = threading.Thread(target=poller, daemon=True)
+    poll_thread.start()
+    statuses = []
+
+    def drive(n, concurrency=3, seed=0):
+        lock = threading.Lock()
+        cursor = [0]
+
+        def client():
+            while True:
+                with lock:
+                    i = cursor[0]
+                    if i >= n:
+                        return
+                    cursor[0] = i + 1
+                pc1 = _pc(20, seed * 1000 + i)
+                status, _, _ = _http(
+                    "POST", server.host, server.port, "/predict",
+                    json.dumps({"pc1": pc1.tolist(),
+                                "pc2": (pc1 + 0.01).tolist()}))
+                with lock:
+                    statuses.append(status)
+
+        threads = [threading.Thread(target=client) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    try:
+        # Phase A: healthy pool baseline.
+        drive(6, seed=1)
+        assert statuses.count(200) == 6
+
+        # Phase B: permanently fail replica 1 mid-load. Every dispatch
+        # that lands there raises; the batcher retries on replica 0, so
+        # clients still see 200s while the supervisor walks replica 1
+        # to quarantined.
+        faults.install_plan(FaultPlan([
+            FaultRule("replica_predict_error", nth=1, every=1,
+                      replica=1)]))
+        drive(12, seed=2)
+        assert _poll(lambda: sup.state_of(1) == "quarantined"), \
+            sup.states()
+        health = _get_json(server, "/healthz")
+        assert health["replicas"][1]["state"] == "quarantined"
+        assert health["pool"]["state"] == "degraded"
+        assert health["pool"]["healthy_replicas"] == 1
+        assert health["faults"]["armed"] is True
+        # The fault evidence is on the ledger, not folklore.
+        assert health["faults"]["fired_total"] >= 2
+
+        # Quarantined = out of rotation: the pool keeps answering.
+        drive(6, seed=3)
+
+        # Phase C: the fault clears; a probe (through replica 1's OWN
+        # AOT program) revives it.
+        faults.clear_plan()
+        assert _poll(lambda: sup.state_of(1) == "healthy"), sup.states()
+        health = _get_json(server, "/healthz")
+        assert health["pool"]["state"] == "ok"
+        assert health["pool"]["healthy_replicas"] == 2
+        assert health["faults"]["armed"] is False
+
+        # Phase D: post-recovery throughput, both replicas serving.
+        drive(6, seed=4)
+
+        # Every submitted request resolved as a 2xx (retry-once absorbed
+        # every injected failure: replica 0 stayed healthy throughout).
+        assert statuses.count(200) == len(statuses) == 30
+
+        # Zero recompiles end to end: AOT programs + probe reuse, the
+        # sealed watchdog never fired.
+        _, body, _ = _http("GET", server.host, server.port,
+                           "/metrics?format=prometheus")
+        counters = _prom_counters(body.decode())
+        assert counters["pvraft_serve_recompiles_total"] == 0
+        assert counters["pvraft_serve_retries_total"] >= 1
+        # Final reconciliation at quiescence.
+        assert counters["pvraft_serve_requests_total"] == 30
+        assert counters["pvraft_serve_responses_total"] == 30
+        assert counters["rejected"] == 0
+        assert counters["pvraft_serve_in_flight"] == 0
+
+        # JSON /metrics stays byte-frozen in SHAPE with the supervisor
+        # wired (fault tolerance is Prometheus/healthz-only).
+        snap = _get_json(server, "/metrics")
+        assert set(snap) == {
+            "requests_total", "responses_total", "rejected",
+            "batches_total", "batch_fill_mean", "per_bucket_requests",
+            "latency", "queue_depth"}
+    finally:
+        stop_poll.set()
+        poll_thread.join(5)
+        server.shutdown(drain=True)
+        telemetry.close()
+
+    # The identity held at EVERY polled snapshot, not just quiescence.
+    assert identity_violations == []
+
+    # The full story is on the event stream and validates.
+    from pvraft_tpu.obs.events import validate_events_file
+
+    assert validate_events_file(events_path) == []
+    recs = [json.loads(line) for line in open(events_path,
+                                              encoding="utf-8")]
+    states = [(r["from_state"], r["state"], r["reason"])
+              for r in recs if r["type"] == "replica_state"]
+    assert ("degraded", "quarantined", "InjectedFaultError") in states
+    assert ("probing", "healthy", "probe_ok") in states
+    injected = [r for r in recs if r["type"] == "fault_injected"]
+    assert injected and all(
+        r["point"] == "replica_predict_error" and r["replica"] == 1
+        for r in injected)
+    assert not [r for r in recs if r["type"] == "recompile"]
+
+
+# ------------------------------------------------ fake pool (no XLA) --
+
+
+class _Replica:
+    def __init__(self, index):
+        self.index = index
+        self.device_id = index
+        self.calls = 0
+
+    def predict_batch(self, requests, bucket):
+        self.calls += 1
+        return [np.asarray(pc2[: pc1.shape[0]] - pc1, np.float32)
+                for pc1, pc2 in requests]
+
+
+class _Engine:
+    def __init__(self, buckets=(32,), batch_sizes=(1, 2), n=2):
+        self.cfg = SimpleNamespace(
+            buckets=buckets, batch_sizes=batch_sizes, min_points=4,
+            coord_limit=100.0, dtype="float32")
+        self.replicas = [_Replica(i) for i in range(n)]
+
+    def validate_request(self, pc1, pc2):
+        m = max(pc1.shape[0], pc2.shape[0])
+        for b in self.cfg.buckets:
+            if m <= b:
+                return b
+        raise RequestError("too_large", "too large")
+
+    def batch_size_for(self, n):
+        for bs in self.cfg.batch_sizes:
+            if n <= bs:
+                return bs
+        return self.cfg.batch_sizes[-1]
+
+    def compile_report(self):
+        return []
+
+
+def _fake_service(tmp_path, supervisor_cfg=TIGHT, queue_depth=16,
+                  **kw):
+    telemetry = ServeTelemetry(str(tmp_path / "chaos.events.jsonl"))
+    server = build_service(_Engine(), max_wait_ms=2,
+                           queue_depth=queue_depth, telemetry=telemetry,
+                           trace_sample_every=0,
+                           supervisor_cfg=supervisor_cfg, **kw)
+    server.start()
+    return server, telemetry
+
+
+def test_all_replicas_down_degrades_to_unavailable(tmp_path):
+    """Both replicas fail -> both quarantined -> 503 ``unavailable``
+    with Retry-After (explicit shed, not a queue-timeout 504); clearing
+    the fault lets the probes revive the whole pool."""
+    cfg = SupervisorConfig(degraded_after=1, quarantine_after=1,
+                           probe_interval_s=0.05)
+    server, telemetry = _fake_service(tmp_path, supervisor_cfg=cfg)
+    sup = server.supervisor
+    try:
+        with faults.injected(FaultPlan([
+                FaultRule("replica_predict_error", nth=1, every=1)])):
+            # First request: dispatch fails, the one retry fails on the
+            # sibling -> 500; both replicas hit quarantine_after=1.
+            pc = _pc(20)
+            status, _, _ = _http(
+                "POST", server.host, server.port, "/predict",
+                json.dumps({"pc1": pc.tolist(), "pc2": pc.tolist()}))
+            assert status == 500
+            assert _poll(lambda: sup.serving_count() == 0), sup.states()
+            assert _get_json(server, "/healthz")["pool"]["state"] == \
+                "unavailable"
+            # Degraded pool sheds at admission: explicit 503
+            # unavailable + Retry-After, immediately.
+            status, body, headers = _http(
+                "POST", server.host, server.port, "/predict",
+                json.dumps({"pc1": pc.tolist(), "pc2": pc.tolist()}))
+            assert status == 503
+            assert json.loads(body)["error"] == "unavailable"
+            assert headers.get("Retry-After") == str(cfg.retry_after_s)
+        # Fault cleared: probes bring the pool back without a restart.
+        assert _poll(lambda: sup.serving_count() == 2), sup.states()
+        status, _, _ = _http(
+            "POST", server.host, server.port, "/predict",
+            json.dumps({"pc1": pc.tolist(), "pc2": pc.tolist()}))
+        assert status == 200
+        snap = _get_json(server, "/metrics")
+        # Identity at quiescence: 3 requests = 1 response + internal +
+        # unavailable... plus the 200 -> 2 responses? No: 500 counted
+        # rejected[internal], 503 rejected[unavailable], 200 response.
+        assert snap["requests_total"] == 3
+        assert snap["responses_total"] == 1
+        assert snap["rejected"] == {"internal": 1, "unavailable": 1}
+    finally:
+        server.shutdown(drain=True)
+        telemetry.close()
+
+
+def test_queue_full_503_carries_retry_after(tmp_path):
+    """Backpressure 503s advertise the probe cadence too: a shed client
+    knows exactly when the pool's health is next re-evaluated."""
+    cfg = SupervisorConfig(probe_interval_s=2.5)   # Retry-After: 3
+    server, telemetry = _fake_service(tmp_path, supervisor_cfg=cfg,
+                                      queue_depth=1)
+    try:
+        with faults.injected(FaultPlan([
+                FaultRule("replica_latency_ms", nth=1, every=1,
+                          value=400.0)])):
+            # Saturate: 2 slow executors + batch queue + 1-deep bucket
+            # queue; later submits shed.
+            results = []
+
+            def client(seed):
+                pc = _pc(20, seed)
+                results.append(_http(
+                    "POST", server.host, server.port, "/predict",
+                    json.dumps({"pc1": pc.tolist(), "pc2": pc.tolist()})))
+
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        shed = [(s, h) for s, _, h in results if s == 503]
+        assert shed, [s for s, _, _ in results]
+        assert all(h.get("Retry-After") == "3" for _, h in shed)
+    finally:
+        server.shutdown(drain=True)
+        telemetry.close()
+
+
+def test_compile_trip_flows_through_sealed_watchdog(tmp_path):
+    """The ``compile_trip`` fault point simulates a hidden post-seal
+    backend compile THROUGH the real watchdog: the Prometheus counter
+    bumps and a ``recompile`` event lands, exactly as a genuine retrace
+    would report."""
+    server, telemetry = _fake_service(tmp_path)
+    try:
+        with faults.injected(FaultPlan([
+                FaultRule("compile_trip", nth=1)])):
+            pc = _pc(20)
+            status, _, _ = _http(
+                "POST", server.host, server.port, "/predict",
+                json.dumps({"pc1": pc.tolist(), "pc2": pc.tolist()}))
+            assert status == 200                 # observe-only mode
+        _, body, _ = _http("GET", server.host, server.port,
+                           "/metrics?format=prometheus")
+        assert _prom_counters(
+            body.decode())["pvraft_serve_recompiles_total"] == 1
+    finally:
+        server.shutdown(drain=True)
+        telemetry.close()
+    recs = [json.loads(line)
+            for line in open(str(tmp_path / "chaos.events.jsonl"),
+                             encoding="utf-8")]
+    trips = [r for r in recs if r["type"] == "recompile"]
+    assert len(trips) == 1 and trips[0]["program"].startswith(
+        "serve_dispatch_b32")
+    fired = [r for r in recs if r["type"] == "fault_injected"]
+    assert [r["point"] for r in fired] == ["compile_trip"]
+
+
+def test_strict_retrace_failure_not_attributed_to_replica(tmp_path):
+    """Strict mode: an injected post-seal compile fails the batch (500)
+    — but it is a PROCESS-wide event, not the replica's fault: no
+    health transition, no retry (the retry would trip identically)."""
+    server, telemetry = _fake_service(tmp_path, strict_retrace=True)
+    sup = server.supervisor
+    try:
+        with faults.injected(FaultPlan([
+                FaultRule("compile_trip", nth=1)])):
+            pc = _pc(20)
+            status, body, _ = _http(
+                "POST", server.host, server.port, "/predict",
+                json.dumps({"pc1": pc.tolist(), "pc2": pc.tolist()}))
+        assert status == 500
+        assert json.loads(body)["detail"].startswith("RetraceError")
+        assert [r["state"] for r in sup.states()] == \
+            ["healthy", "healthy"]
+        assert server.batcher.counts["retries"] == 0
+    finally:
+        server.shutdown(drain=True)
+        telemetry.close()
+
+
+def test_loadgen_client_retries_record_attempts(tmp_path):
+    """The loadgen satellite: ``retries`` re-attempts 503s with backoff
+    honoring Retry-After, records every attempt per request
+    (schema-additive), and keeps ok+rejected+errors == total."""
+    cfg = SupervisorConfig(degraded_after=1, quarantine_after=1,
+                           probe_interval_s=60.0)  # probes never revive
+    server, telemetry = _fake_service(tmp_path, supervisor_cfg=cfg)
+    sup = server.supervisor
+    try:
+        faults.install_plan(FaultPlan([
+            FaultRule("replica_predict_error", nth=1, every=1)]))
+        # Quarantine the whole pool first (one request's dispatch +
+        # retry fail both replicas).
+        pc = _pc(20)
+        _http("POST", server.host, server.port, "/predict",
+              json.dumps({"pc1": pc.tolist(), "pc2": pc.tolist()}))
+        assert _poll(lambda: sup.serving_count() == 0)
+        t0 = time.monotonic()
+        m = run_load(server, n_requests=3, concurrency=3,
+                     point_counts=[20], retries=1)
+        elapsed = time.monotonic() - t0
+    finally:
+        faults.clear_plan()
+        server.shutdown(drain=True)
+        telemetry.close()
+    # Every request: attempt 1 503-unavailable, jittered backoff (>=
+    # 0.5 x Retry-After=1s), attempt 2 503 -> final status 503, counted
+    # rejected; identity by construction.
+    assert m["requests"] == {"total": 3, "ok": 0, "rejected": 3,
+                             "errors": 0}
+    assert elapsed >= 0.4
+    for r in m["per_request"]:
+        assert r["status"] == 503
+        assert [a["status"] for a in r["attempts"]] == [503, 503]
+    artifact = {"schema": "pvraft_serve_load/v1", "config": {},
+                "compile": [], **m}
+    assert validate_load_artifact(artifact) == []
+    # The validator rejects a forged attempts trail.
+    artifact["per_request"][0]["attempts"][-1]["status"] = 200
+    assert validate_load_artifact(artifact)

@@ -1,0 +1,588 @@
+"""Replica supervision + deterministic fault injection (ISSUE 13):
+the FaultPlan schedule semantics, the zero-residue disarmed path, the
+health state machine (threshold trips, probe revival, wedge scan), and
+the batcher's retry-once-on-another-replica with exactly-once outcome
+accounting.
+
+Everything here is host-side (fake replicas, real threads, no XLA) —
+the state machine must be testable at state-machine cost. The real-AOT
+end-to-end story lives in tests/test_serve_chaos.py.
+"""
+
+import ast
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pvraft_tpu.obs.events import FAULT_POINTS, REPLICA_STATES
+from pvraft_tpu.serve import faults
+from pvraft_tpu.serve.batcher import BatcherConfig, MicroBatcher
+from pvraft_tpu.serve.engine import RequestError
+from pvraft_tpu.serve.faults import FaultPlan, FaultRule, InjectedFaultError
+from pvraft_tpu.serve.metrics import ServeMetrics
+from pvraft_tpu.serve.supervisor import ReplicaSupervisor, SupervisorConfig
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """A test that fails mid-plan must not poison its neighbors."""
+    yield
+    faults.clear_plan()
+
+
+# ----------------------------------------------------------- fake pool --
+
+
+class _Replica:
+    """Fake single-device executor; fails when its flag is set (real
+    failures, distinct from injected ones)."""
+
+    def __init__(self, index):
+        self.index = index
+        self.device_id = index
+        self.calls = 0
+        self.fail = False
+
+    def predict_batch(self, requests, bucket):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError(f"replica {self.index} broke")
+        return [np.asarray(pc2[: pc1.shape[0]] - pc1, np.float32)
+                for pc1, pc2 in requests]
+
+
+class _Engine:
+    def __init__(self, buckets=(32, 64), batch_sizes=(1, 2), n=2):
+        self.cfg = SimpleNamespace(
+            buckets=buckets, batch_sizes=batch_sizes, min_points=4,
+            coord_limit=100.0, dtype="float32")
+        self.replicas = [_Replica(i) for i in range(n)]
+
+    def validate_request(self, pc1, pc2):
+        m = max(pc1.shape[0], pc2.shape[0])
+        for b in self.cfg.buckets:
+            if m <= b:
+                return b
+        raise RequestError("too_large", "too large")
+
+    def batch_size_for(self, n):
+        for bs in self.cfg.batch_sizes:
+            if n <= bs:
+                return bs
+        return self.cfg.batch_sizes[-1]
+
+    def compile_report(self):
+        return []
+
+
+def _pc(n, seed=0):
+    return np.random.default_rng(seed).uniform(
+        -1, 1, (n, 3)).astype(np.float32)
+
+
+def _poll(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+TIGHT = SupervisorConfig(degraded_after=1, quarantine_after=2,
+                         probe_interval_s=0, wedge_timeout_s=0.2,
+                         latency_min_samples=3, latency_outlier_after=2,
+                         latency_outlier_factor=3.0)
+
+
+# ------------------------------------------------------- fault schedule --
+
+
+def test_fault_rule_validation():
+    with pytest.raises(ValueError):
+        FaultRule("not_a_point")
+    with pytest.raises(ValueError):
+        FaultRule("queue_stall", nth=0)
+    with pytest.raises(ValueError):
+        FaultRule("queue_stall", every=-1)
+    with pytest.raises(ValueError):
+        FaultPlan([])
+    assert FAULT_POINTS == tuple(
+        FaultRule(p).point for p in FAULT_POINTS)
+
+
+def test_fire_nth_once_every_max():
+    fired = []
+    plan = FaultPlan([
+        FaultRule("queue_stall", nth=2),                    # once, at 2
+        FaultRule("compile_trip", nth=1, every=2,
+                  max_fires=2),                             # 1, 3 then capped
+    ])
+    with faults.injected(plan):
+        for _ in range(6):
+            fired.extend(r["traversal"] for r in faults.fire("queue_stall"))
+        assert fired == [2]
+        trips = []
+        for _ in range(6):
+            trips.extend(r["traversal"] for r in faults.fire("compile_trip"))
+        assert trips == [1, 3]                              # max_fires=2
+
+
+def test_fire_counts_per_replica():
+    plan = FaultPlan([FaultRule("replica_predict_error", nth=2, replica=1)])
+    with faults.injected(plan):
+        # Replica 0 traversals never advance replica 1's schedule.
+        for _ in range(5):
+            faults.fire("replica_predict_error", replica=0)
+        faults.fire("replica_predict_error", replica=1)     # traversal 1
+        with pytest.raises(InjectedFaultError):
+            faults.fire("replica_predict_error", replica=1)  # traversal 2
+
+
+def test_fire_after_s_window():
+    plan = FaultPlan([FaultRule("queue_stall", nth=1, every=1,
+                                after_s=30.0)])
+    with faults.injected(plan):
+        assert faults.fire("queue_stall") == ()             # still dormant
+
+
+def test_install_is_exclusive_and_clear_unblocks_wedge():
+    plan = FaultPlan([FaultRule("replica_wedge", nth=1, replica=0)])
+    faults.install_plan(plan)
+    with pytest.raises(RuntimeError):
+        faults.install_plan(plan)
+    released = threading.Event()
+
+    def wedged():
+        faults.fire("replica_wedge", replica=0)             # blocks
+        released.set()
+
+    t = threading.Thread(target=wedged, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not released.is_set()
+    faults.clear_plan()                                     # releases
+    assert released.wait(5)
+    t.join(5)
+
+
+def test_disarmed_zero_residue():
+    """No FaultPlan installed: fire() is inert — returns (), allocates
+    no counters, leaves no observable state. The fault points live in
+    host-side code only (this module never imports jax), so the
+    default-path jaxpr guarantee is structural, not incidental."""
+    for point in FAULT_POINTS:
+        assert faults.fire(point, replica=0) == ()
+    snap = faults.plan_snapshot()
+    assert snap == {"armed": False, "rules": [], "fired_total": 0,
+                    "rule_fires": []}
+    # Structural jaxpr guarantee: faults.py is jax-free by construction.
+    import pvraft_tpu.serve.faults as mod
+
+    tree = ast.parse(open(mod.__file__, encoding="utf-8").read())
+    imports = [n.names[0].name for n in ast.walk(tree)
+               if isinstance(n, ast.Import)] + \
+              [n.module for n in ast.walk(tree)
+               if isinstance(n, ast.ImportFrom)]
+    assert not any(name == "jax" or name.startswith("jax.")
+                   for name in imports if name)
+
+
+# --------------------------------------------------------- state machine --
+
+
+def test_supervisor_config_validation():
+    with pytest.raises(ValueError):
+        SupervisorConfig(degraded_after=0)
+    with pytest.raises(ValueError):
+        SupervisorConfig(degraded_after=3, quarantine_after=2)
+    with pytest.raises(ValueError):
+        SupervisorConfig(latency_outlier_factor=1.0)
+    assert SupervisorConfig(probe_interval_s=0.3).retry_after_s == 1
+    assert SupervisorConfig(probe_interval_s=2.5).retry_after_s == 3
+
+
+def test_failure_streak_degrades_then_quarantines():
+    engine = _Engine()
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    assert [r["state"] for r in sup.states()] == ["healthy", "healthy"]
+    sup.record_failure(1)
+    assert sup.state_of(1) == "degraded"
+    assert sup.in_rotation(1)                   # degraded still serves
+    sup.record_failure(1)
+    assert sup.state_of(1) == "quarantined"
+    assert not sup.in_rotation(1)
+    assert sup.serving_count() == 1
+    assert sup.retry_target(exclude=0) is None  # 1 is out, no one else
+    assert sup.retry_target(exclude=1) == 0
+    # A success on a quarantined replica (straggler dispatch) does NOT
+    # revive it — only the probe may.
+    sup.record_success(1, 32, 0.001)
+    assert sup.state_of(1) == "quarantined"
+    health = sup.pool_health()
+    assert health["state"] == "degraded"
+    assert health["healthy_replicas"] == 1
+
+
+def test_success_resets_streak_and_recovers_degraded():
+    engine = _Engine()
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    sup.record_failure(0)
+    assert sup.state_of(0) == "degraded"
+    sup.record_success(0, 32, 0.001)
+    assert sup.state_of(0) == "healthy"
+    # Streak reset: one more failure degrades again but does not
+    # quarantine (the consecutive count restarted).
+    sup.record_failure(0)
+    assert sup.state_of(0) == "degraded"
+
+
+def test_latency_outliers_degrade_but_never_quarantine():
+    engine = _Engine()
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    for _ in range(TIGHT.latency_min_samples):  # EWMA warmup ~10ms
+        sup.record_success(0, 32, 0.010)
+    for _ in range(TIGHT.latency_outlier_after):
+        sup.record_success(0, 32, 0.200)        # 20x the baseline
+    assert sup.state_of(0) == "degraded"
+    for _ in range(10):                         # keep being slow
+        sup.record_success(0, 32, 0.200)
+    assert sup.state_of(0) == "degraded"        # slow is not dead
+    sup.record_success(0, 32, 0.010)
+    assert sup.state_of(0) == "healthy"         # normal sample recovers
+
+
+def test_probe_revives_quarantined_replica():
+    engine = _Engine()
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    engine.replicas[1].fail = True
+    sup.record_failure(1)
+    sup.record_failure(1)
+    assert sup.state_of(1) == "quarantined"
+    sup.poll()                                  # probe fails: still broken
+    assert sup.state_of(1) == "quarantined"
+    assert sup.counts["probe_failures"] == 1
+    engine.replicas[1].fail = False
+    sup.poll()                                  # probe succeeds: revived
+    assert sup.state_of(1) == "healthy"
+    assert sup.counts["probes"] == 2
+    # The probe ran a real synthetic request through the replica.
+    assert engine.replicas[1].calls >= 2
+
+
+def test_probe_traverses_fault_points():
+    """An armed replica fault fails the probe too: revival happens only
+    once the fault actually clears (the chaos-recovery contract)."""
+    engine = _Engine()
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    sup.record_failure(1)
+    sup.record_failure(1)
+    with faults.injected(FaultPlan([
+            FaultRule("replica_predict_error", nth=1, every=1,
+                      replica=1)])):
+        sup.poll()
+        assert sup.state_of(1) == "quarantined"  # probe hit the fault
+    sup.poll()                                   # fault cleared
+    assert sup.state_of(1) == "healthy"
+
+
+def test_wedge_scan_quarantines_stuck_dispatch():
+    engine = _Engine()
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)   # wedge_timeout_s=0.2
+    token = sup.note_dispatch_start(0, time.monotonic() - 1.0)
+    sup._scan_wedged()
+    assert sup.state_of(0) == "quarantined"
+    # The stuck dispatch eventually finishing must not auto-revive.
+    sup.note_dispatch_end(0, token)
+    sup.record_success(0, 32, 0.5)
+    assert sup.state_of(0) == "quarantined"
+
+
+def test_wedge_survives_concurrent_dispatch_on_same_replica():
+    """Review-found (ISSUE 13 code review): a sibling executor's retry
+    runs on this replica concurrently with its own dispatch — with one
+    start slot, the retry's note_dispatch_end clobbered the wedged
+    dispatch's record and the wedge was never detected. Tokened
+    tracking keeps every in-flight dispatch individually visible."""
+    engine = _Engine()
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)   # wedge_timeout_s=0.2
+    wedged = sup.note_dispatch_start(0, time.monotonic() - 1.0)
+    retry = sup.note_dispatch_start(0, time.monotonic())
+    sup.note_dispatch_end(0, retry)              # the quick retry ends
+    sup._scan_wedged()
+    assert sup.state_of(0) == "quarantined"      # the wedge is still seen
+    sup.note_dispatch_end(0, wedged)
+
+
+def test_probe_skips_replica_with_stuck_dispatch():
+    """Review-found (ISSUE 13 code review): probing a replica whose
+    dispatch is still wedged would hang the supervisor loop on the same
+    stuck device — the probe waits until the in-flight set drains."""
+    engine = _Engine()
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    token = sup.note_dispatch_start(0, time.monotonic() - 1.0)
+    sup.poll()                                   # wedge scan quarantines
+    assert sup.state_of(0) == "quarantined"
+    calls = engine.replicas[0].calls
+    sup.poll()                                   # still stuck: no probe
+    assert engine.replicas[0].calls == calls
+    assert sup.state_of(0) == "quarantined"
+    sup.note_dispatch_end(0, token)              # the dispatch returns
+    sup.poll()                                   # now probe-eligible
+    assert sup.state_of(0) == "healthy"
+    assert engine.replicas[0].calls == calls + 1
+
+
+def test_hung_probe_is_bounded_and_does_not_block_siblings():
+    """Review-found (ISSUE 13 code review): a probe against a device
+    that hangs BETWEEN dispatches must cost one probe_timeout_s, not
+    the supervisor loop — other quarantined replicas still get probed
+    and revived in the same pass."""
+    engine = _Engine()
+    hang = threading.Event()
+    orig = engine.replicas[0].predict_batch
+
+    def hanging_predict(requests, bucket):
+        hang.wait(30)
+        return orig(requests, bucket)
+
+    engine.replicas[0].predict_batch = hanging_predict
+    cfg = SupervisorConfig(degraded_after=1, quarantine_after=1,
+                           probe_interval_s=0, probe_timeout_s=0.2)
+    sup = ReplicaSupervisor(engine, cfg=cfg)
+    sup.record_failure(0)
+    sup.record_failure(1)
+    t0 = time.monotonic()
+    sup.poll()
+    elapsed = time.monotonic() - t0
+    hang.set()
+    assert elapsed < 2.0                         # bounded, not 30 s
+    assert sup.state_of(0) == "quarantined"      # timed out = failed
+    assert sup.state_of(1) == "healthy"          # sibling still revived
+
+
+def test_transitions_ride_the_event_stream(tmp_path):
+    from pvraft_tpu.obs.events import validate_events_file
+    from pvraft_tpu.serve.events import ServeTelemetry
+
+    telemetry = ServeTelemetry(str(tmp_path / "sup.events.jsonl"))
+    engine = _Engine()
+    sup = ReplicaSupervisor(engine, cfg=TIGHT, telemetry=telemetry)
+    engine.replicas[1].fail = True
+    sup.record_failure(1, reason="boom")
+    sup.record_failure(1, reason="boom")
+    sup.poll()                                   # probing -> probe_failed
+    engine.replicas[1].fail = False
+    sup.poll()                                   # probing -> healthy
+    telemetry.close()
+    path = str(tmp_path / "sup.events.jsonl")
+    assert validate_events_file(path) == []
+    import json
+
+    recs = [json.loads(line) for line in open(path, encoding="utf-8")
+            if '"replica_state"' in line]
+    walk = [(r["from_state"], r["state"], r["reason"]) for r in recs]
+    assert walk == [
+        ("healthy", "degraded", "boom"),
+        ("degraded", "quarantined", "boom"),
+        ("quarantined", "probing", "probe"),
+        ("probing", "quarantined", "probe_failed"),
+        ("quarantined", "probing", "probe"),
+        ("probing", "healthy", "probe_ok"),
+    ]
+    assert all(r["state"] in REPLICA_STATES for r in recs)
+
+
+def test_probe_thread_lifecycle_restartable():
+    engine = _Engine()
+    sup = ReplicaSupervisor(
+        engine, cfg=SupervisorConfig(probe_interval_s=0.02))
+    engine.replicas[0].fail = True
+    sup.record_failure(0)
+    sup.record_failure(0)
+    sup.record_failure(0)
+    assert sup.state_of(0) == "quarantined"
+    sup.start()
+    try:
+        assert _poll(lambda: sup.counts["probes"] >= 1)
+        sup.stop()
+        n = sup.counts["probes"]
+        time.sleep(0.1)
+        assert sup.counts["probes"] == n         # really stopped
+        engine.replicas[0].fail = False
+        sup.start()                              # restartable
+        assert _poll(lambda: sup.state_of(0) == "healthy")
+    finally:
+        sup.stop()
+
+
+# ------------------------------------------- batcher retry + degradation --
+
+
+def test_retry_once_on_other_replica_no_double_resolve():
+    """A dispatch failing on one replica is retried exactly once on a
+    different one: the client still gets its flow, the retry counter
+    bumps, nothing is double-resolved, and the metrics identity holds
+    with zero rejects."""
+    engine = _Engine(n=2)
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8),
+        metrics=metrics,
+        supervisor=ReplicaSupervisor(engine, cfg=TIGHT))
+    plan = FaultPlan([FaultRule("replica_predict_error", nth=1, every=1,
+                                replica=1)])
+    with faults.injected(plan):
+        served = 0
+        for seed in range(6):
+            h = batcher.submit(_pc(20, seed), _pc(20, seed))
+            flow = h.wait(10)                    # retried if it hit r1
+            assert flow.shape == (20, 3)
+            served += 1
+    batcher.shutdown(drain=True)
+    counts = batcher.counts
+    assert counts["served"] == served == 6
+    assert counts["rejected"] == 0
+    # Work-stealing is nondeterministic, but any dispatch that landed on
+    # replica 1 was retried — and replica 0 answered every request.
+    snap = metrics.snapshot()
+    assert snap["requests_total"] == 6
+    assert snap["responses_total"] == 6
+    assert metrics.in_flight == 0
+    assert counts["retries"] == metrics.retries_total >= 0
+
+
+def test_failed_retry_fails_group_once():
+    """Both replicas broken: the one retry fails too, the request
+    errors exactly once (no infinite retry loop), and the supervisor
+    walks both replicas toward quarantine."""
+    engine = _Engine(n=2)
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8),
+        supervisor=sup)
+    plan = FaultPlan([FaultRule("replica_predict_error", nth=1, every=1)])
+    with faults.injected(plan):
+        h = batcher.submit(_pc(20), _pc(20))
+        with pytest.raises(InjectedFaultError):
+            h.wait(10)
+        assert batcher.counts["retries"] == 1
+    batcher.shutdown(drain=True)
+
+
+def test_quarantined_replica_leaves_rotation():
+    """Once quarantined, a replica's executor pulls no more work: every
+    subsequent request is served by the healthy sibling."""
+    engine = _Engine(n=2)
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=16),
+        supervisor=sup)
+    plan = FaultPlan([FaultRule("replica_predict_error", nth=1, every=1,
+                                replica=1)])
+    with faults.injected(plan):
+        for seed in range(12):
+            batcher.submit(_pc(20, seed), _pc(20, seed)).wait(10)
+        assert _poll(lambda: sup.state_of(1) == "quarantined")
+        calls_at_quarantine = engine.replicas[1].calls
+        for seed in range(12, 20):
+            batcher.submit(_pc(20, seed), _pc(20, seed)).wait(10)
+        # Parked: no new dispatches reached replica 1 (the executor
+        # checks rotation before pulling).
+        assert engine.replicas[1].calls == calls_at_quarantine
+    batcher.shutdown(drain=True)
+
+
+def test_all_quarantined_rejects_unavailable():
+    from pvraft_tpu.serve.batcher import PoolUnavailableError
+
+    engine = _Engine(n=2)
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8),
+        metrics=metrics, supervisor=sup)
+    for i in range(2):
+        sup.record_failure(i)
+        sup.record_failure(i)
+    assert sup.pool_health()["state"] == "unavailable"
+    with pytest.raises(PoolUnavailableError):
+        batcher.submit(_pc(20), _pc(20))
+    snap = metrics.snapshot()
+    assert snap["rejected"] == {"unavailable": 1}
+    # Identity: the shed request was counted, nothing is in flight.
+    assert snap["requests_total"] == 1
+    assert metrics.in_flight == 0
+    batcher.shutdown(drain=True)
+
+
+def test_degraded_pool_shrinks_admission():
+    """Admission capacity scales with the serving-replica count: with
+    half the pool quarantined, the effective queue depth halves."""
+    from pvraft_tpu.serve.batcher import QueueFullError
+
+    engine = _Engine(n=2)
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    # Block both replicas' executors from draining: park them by
+    # quarantining replica 1 and wedging the queue with a stopped
+    # collector? Simpler: no executors at all — submit-only batcher via
+    # a full queue. Use queue_depth=4 and a gate-less engine whose
+    # replicas are slow by fault latency.
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=4),
+        supervisor=sup)
+    sup.record_failure(1)
+    sup.record_failure(1)                        # quarantined: 1 of 2
+    assert sup.serving_count() == 1
+    with faults.injected(FaultPlan([
+            FaultRule("replica_latency_ms", nth=1, every=1, replica=0,
+                      value=300.0)])):
+        accepted, shed = 0, 0
+        for seed in range(8):                    # flood faster than drain
+            try:
+                batcher.submit(_pc(20, seed), _pc(20, seed))
+                accepted += 1
+            except QueueFullError as e:
+                shed += 1
+                # The reject names the SCALED capacity (2 of 4 slots).
+                assert "2 of 4" in str(e)
+        assert shed >= 1
+    batcher.shutdown(drain=True)
+
+
+def test_replica_stats_carry_state_and_prometheus_series():
+    engine = _Engine(n=2)
+    sup = ReplicaSupervisor(engine, cfg=TIGHT)
+    metrics = ServeMetrics(engine.cfg.buckets)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8),
+        metrics=metrics, supervisor=sup)
+    sup.record_failure(1)
+    sup.record_failure(1)
+    rows = batcher.replica_stats()
+    assert [r["state"] for r in rows] == ["healthy", "quarantined"]
+    text = metrics.prometheus(replica_stats=rows)
+    assert ('pvraft_serve_replica_state{replica="1",'
+            'state="quarantined"} 1') in text
+    assert ('pvraft_serve_replica_state{replica="1",'
+            'state="healthy"} 0') in text
+    assert "pvraft_serve_retries_total 0" in text
+    batcher.shutdown(drain=True)
+
+
+def test_unsupervised_batcher_unchanged():
+    """supervisor=None: replica_stats rows keep the pre-supervision
+    shape (no state key) and admission is the plain queue_depth check —
+    the None path is the PR-8 batcher bit-for-bit."""
+    engine = _Engine(n=2)
+    batcher = MicroBatcher(
+        engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8))
+    h = batcher.submit(_pc(20), _pc(20))
+    assert h.wait(10).shape == (20, 3)
+    assert all(set(r) == {"replica", "device_id", "in_flight",
+                          "batches_total"}
+               for r in batcher.replica_stats())
+    batcher.shutdown(drain=True)

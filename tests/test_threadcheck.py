@@ -338,6 +338,10 @@ CORPUS = {
     "pr8_in_flight": "GC001",
     "pr5_mid_predict_504": "GC003",
     "pr9_monitor_restart": "GC003",
+    # ISSUE 13: the fault-injector's naive install tested self._plan and
+    # assigned it with no lock — two concurrent installers both pass the
+    # exclusivity check (design-review find, serve/faults.py).
+    "pr13_fault_install": "GC003",
 }
 
 
