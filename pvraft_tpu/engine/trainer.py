@@ -153,6 +153,14 @@ class Trainer:
         self.best_epe = float("inf")
         self.begin_epoch = 0
         self.step_count = 0
+        # Cost-surface honesty block (ISSUE 14): epoch_summary reports
+        # measured step time against the committed inventory's
+        # flagship-geometry prediction. False = not yet loaded; None =
+        # unavailable (missing/stale artifact — training never fails
+        # over an observability lookup). Host-side only: the jitted
+        # step programs (and the telemetry-off jaxpr guarantee) are
+        # untouched.
+        self._cost_surface: Any = False
 
         self.train_ds, self.val_ds, self.test_ds = build_datasets(cfg)
         # batch_size is PER-DEVICE (the reference's DataParallel splits its
@@ -541,6 +549,47 @@ class Trainer:
             return e
         return None
 
+    def _step_cost_report(self, step_s: float) -> Optional[Dict[str, Any]]:
+        """The epoch_summary ``cost`` block: measured step seconds next
+        to the committed inventory's flagship train-step prediction
+        (``CostSurface.lookup_train_step``) and the flops-from-inventory
+        hardware-utilization estimate. Pure host-side observability —
+        the jitted step (and its telemetry-off jaxpr guarantee) never
+        sees any of this, and every failure path degrades to None
+        rather than touching training. ``comparable`` follows the
+        pvraft_bench/v1 rule: a CPU step time is recorded against the
+        TPU-topology prediction but never enforceable (and the record
+        is the FLAGSHIP-geometry spec — a differently-shaped run reads
+        the ratio as scale evidence, not a pass/fail)."""
+        if self._cost_surface is False:
+            try:
+                from pvraft_tpu.programs.costs import CostSurface
+
+                self._cost_surface = CostSurface.load()
+            except Exception:  # noqa: BLE001 — observability must not fail training
+                self._cost_surface = None
+        surface = self._cost_surface
+        if surface is None or step_s <= 0:
+            return None
+        from pvraft_tpu.programs.costs import hardware_utilization
+
+        dtype = self.cfg.model.compute_dtype or "float32"
+        rec = surface.lookup_train_step(dtype)
+        if rec is None or rec.device_seconds <= 0:
+            return None
+        platform = jax.devices()[0].platform
+        util = hardware_utilization(rec.flops, step_s, dtype)
+        return {
+            "program": rec.name,
+            "basis": rec.basis,
+            "predicted_step_ms": round(rec.device_seconds * 1e3, 3),
+            "step_ratio": round(step_s / rec.device_seconds, 4),
+            "hw_utilization": (round(util, 6)
+                               if util is not None else None),
+            "platform": platform,
+            "comparable": platform == "tpu" and rec.comparable,
+        }
+
     def training(self, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
         timer = StepTimer()
@@ -651,9 +700,11 @@ class Trainer:
         mean_loss = float(np.mean(losses))
         mean_epe = float(np.mean(epes))
         step_ms = timer.mean / n_steps * 1e3
+        cost = self._step_cost_report(step_ms / 1e3)
         self.telemetry.emit_epoch_summary(
             epoch, steps=n_steps, loss=mean_loss, epe=mean_epe,
             step_ms=round(step_ms, 3),
+            **({"cost": cost} if cost is not None else {}),
         )
         self.log.info(
             f"epoch {epoch}: loss {mean_loss:.4f} epe {mean_epe:.4f} "

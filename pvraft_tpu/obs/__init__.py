@@ -23,13 +23,32 @@ Five pillars (ISSUEs 3 + 7 / ROADMAP "run-health telemetry"):
   ``pvraft_device_hbm_bytes`` gauge), and :mod:`pvraft_tpu.obs.bench`
   (the ``pvraft_bench/v1`` schema behind ``scripts/bench_compare.py``;
   the cost/HBM inventory lives with the registry in
-  ``pvraft_tpu/programs/costs.py``).
+  ``pvraft_tpu/programs/costs.py``);
+* the cost-calibration plane (ISSUE 14):
+  :mod:`pvraft_tpu.obs.capacity` (the ``pvraft_capacity/v1`` committed
+  capacity plan — chips-needed-at-SLO as a pure function of the cost
+  surface + committed traffic/SLO evidence),
+  :mod:`pvraft_tpu.obs.calibration` (the
+  ``pvraft_cost_calibration/v1`` predicted-vs-measured evidence
+  schema), and :mod:`pvraft_tpu.obs.loading` (the shared committed-
+  artifact file-contract loader every validator reads through).
 """
 
 from pvraft_tpu.obs.bench import (  # noqa: F401
     BENCH_SCHEMA,
     validate_bench,
     validate_bench_file,
+)
+from pvraft_tpu.obs.calibration import (  # noqa: F401
+    CALIBRATION_SCHEMA,
+    validate_calibration,
+    validate_calibration_file,
+)
+from pvraft_tpu.obs.capacity import (  # noqa: F401
+    CAPACITY_SCHEMA,
+    build_capacity_report,
+    validate_capacity,
+    validate_capacity_file,
 )
 from pvraft_tpu.obs.device_memory import (  # noqa: F401
     DeviceMemoryMonitor,

@@ -4,11 +4,15 @@
     python -m pvraft_tpu.obs validate-trace artifacts/*.trace.json
     python -m pvraft_tpu.obs validate-slo artifacts/*.slo.json
     python -m pvraft_tpu.obs validate-bench artifacts/bench_baseline.json
+    python -m pvraft_tpu.obs validate-capacity artifacts/capacity_report.json
+    python -m pvraft_tpu.obs validate-calibration artifacts/serve_calibration.json
 
-Each subcommand exits non-zero on any schema problem — all four are
-wired into ``scripts/lint.sh`` so a malformed committed event log,
-trace artifact, SLO report or bench artifact fails the standing gate,
-same as a lint finding.
+Each subcommand exits non-zero on any schema problem — all are wired
+into ``scripts/lint.sh`` so a malformed committed event log, trace
+artifact, SLO report, bench artifact, capacity plan or calibration
+evidence fails the standing gate, same as a lint finding. (The
+capacity plan's regenerate-and-compare half lives in
+``scripts/capacity_report.py --check``.)
 """
 
 from __future__ import annotations
@@ -17,6 +21,8 @@ import argparse
 import sys
 
 from pvraft_tpu.obs.bench import validate_bench_file
+from pvraft_tpu.obs.calibration import validate_calibration_file
+from pvraft_tpu.obs.capacity import validate_capacity_file
 from pvraft_tpu.obs.events import validate_events_file
 from pvraft_tpu.obs.slo import validate_slo_report_file
 from pvraft_tpu.obs.trace import validate_trace_artifact_file
@@ -57,6 +63,15 @@ def main(argv=None) -> int:
         "validate-bench", help="validate pvraft_bench/v1 artifacts")
     bench.add_argument("paths", nargs="+", help="bench artifacts")
     bench.set_defaults(validate=validate_bench_file)
+    cap = sub.add_parser(
+        "validate-capacity", help="validate pvraft_capacity/v1 plans")
+    cap.add_argument("paths", nargs="+", help="capacity plans")
+    cap.set_defaults(validate=validate_capacity_file)
+    cal = sub.add_parser(
+        "validate-calibration",
+        help="validate pvraft_cost_calibration/v1 evidence")
+    cal.add_argument("paths", nargs="+", help="calibration artifacts")
+    cal.set_defaults(validate=validate_calibration_file)
     args = parser.parse_args(argv)
     return _run(args.paths, args.validate)
 

@@ -27,7 +27,6 @@ validate-bench``) are separate processes where that import is fine.
 
 from __future__ import annotations
 
-import json
 from typing import Any, Dict, List, Tuple
 
 BENCH_SCHEMA = "pvraft_bench/v1"
@@ -117,25 +116,16 @@ def validate_bench(doc: Any, path: str = "<bench>") -> List[str]:
 
 
 def load_bench_file(path: str):
-    """``(doc, problems)``: the ONE-JSON-line file contract, in one
-    place — ``validate_bench_file`` and ``scripts/bench_compare.py``
-    must agree on what parses, so they share this loader. ``doc`` is
-    None when ``problems`` is non-empty; schema validation is separate
-    (``validate_bench``)."""
-    try:
-        with open(path, "r", encoding="utf-8") as f:
-            text = f.read().strip()
-    except OSError as e:
-        return None, [f"{path}: unreadable: {e}"]
+    """``(doc, problems)``: the ONE-JSON-line file contract —
+    ``validate_bench_file`` and ``scripts/bench_compare.py`` must agree
+    on what parses, so both ride the shared artifact loader
+    (``obs/loading.py``, where the capacity/calibration validators read
+    their files too). ``doc`` is None when ``problems`` is non-empty;
+    schema validation is separate (``validate_bench``)."""
+    from pvraft_tpu.obs.loading import load_json_artifact
+
     # bench.py prints ONE JSON line; an artifact file holds exactly it.
-    lines = [l for l in text.splitlines() if l.strip()]
-    if len(lines) != 1:
-        return None, [
-            f"{path}: expected exactly one JSON line, got {len(lines)}"]
-    try:
-        return json.loads(lines[0]), []
-    except ValueError as e:
-        return None, [f"{path}: not valid JSON: {e}"]
+    return load_json_artifact(path, one_line=True)
 
 
 def validate_bench_file(path: str) -> List[str]:

@@ -1,0 +1,116 @@
+"""Calibration evidence: the ``pvraft_cost_calibration/v1`` artifact.
+
+The committed proof that the cost surface's predictions were measured
+against a REAL loadgen run (``scripts/serve_calibration.py``): one
+record per (bucket, batch, dtype) with predicted vs measured
+device-seconds, the prediction's basis/extrapolation flags, and the
+platform-honesty ``comparable`` flag — plus the identity ledger: the
+``requests == responses + Σrejected + in_flight`` reconciliation was
+polled from atomic Prometheus renders THROUGHOUT the run and must have
+held at every snapshot (``identity.violations == 0`` is a schema
+requirement, not a hope).
+
+Platform honesty is structural (the ``pvraft_bench/v1`` lesson carried
+through ISSUE 14): ``comparable: true`` is valid ONLY on platform
+"tpu" — a CPU wall clock recorded beside an XLA optimal-seconds
+prediction is evidence the machinery works, never evidence the model is
+calibrated, and the validator makes the confusion unrepresentable.
+
+``python -m pvraft_tpu.obs validate-calibration`` is the CLI (a
+``scripts/lint.sh`` stage over the committed artifact).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+CALIBRATION_SCHEMA = "pvraft_cost_calibration/v1"
+
+_REQUIRED = ("schema", "surface", "platform", "dtype", "identity",
+             "records", "config")
+_RECORD_REQUIRED = ("bucket", "batch", "dtype", "n", "predicted_s",
+                    "measured_s", "ratio", "comparable")
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_calibration(doc: Any,
+                         path: str = "<calibration>") -> List[str]:
+    """Schema problems of one calibration artifact ([] = valid)."""
+    if not isinstance(doc, dict):
+        return [f"{path}: artifact is {type(doc).__name__}, not an object"]
+    problems: List[str] = []
+    if doc.get("schema") != CALIBRATION_SCHEMA:
+        problems.append(
+            f"{path}: schema {doc.get('schema')!r} != "
+            f"{CALIBRATION_SCHEMA!r}")
+    for key in _REQUIRED:
+        if key not in doc:
+            problems.append(f"{path}: missing field {key!r}")
+    if problems:
+        return problems
+    if not isinstance(doc["platform"], str) or not doc["platform"]:
+        problems.append(f"{path}: platform must be a non-empty string")
+    identity = doc["identity"]
+    if not isinstance(identity, dict) \
+            or not isinstance(identity.get("snapshots"), int) \
+            or not isinstance(identity.get("violations"), int):
+        problems.append(
+            f"{path}: identity must carry int snapshots/violations")
+    else:
+        if identity["snapshots"] < 1:
+            problems.append(
+                f"{path}: identity.snapshots {identity['snapshots']} — "
+                "evidence with no polled snapshots proves nothing")
+        if identity["violations"] != 0:
+            problems.append(
+                f"{path}: identity.violations "
+                f"{identity['violations']} != 0 — the reconciliation "
+                "identity must hold at EVERY polled snapshot")
+    records = doc["records"]
+    if not isinstance(records, list) or not records:
+        problems.append(f"{path}: records must be a non-empty list")
+        return problems
+    for i, rec in enumerate(records):
+        where = f"{path}: records[{i}]"
+        if not isinstance(rec, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        for key in _RECORD_REQUIRED:
+            if key not in rec:
+                problems.append(f"{where}: missing {key!r}")
+        if not isinstance(rec.get("comparable"), bool):
+            problems.append(f"{where}: comparable must be a bool")
+        elif rec["comparable"] and doc.get("platform") != "tpu":
+            problems.append(
+                f"{where}: comparable=true on platform "
+                f"{doc.get('platform')!r} — only TPU measurements may "
+                "be enforced against the TPU-topology prediction")
+        for key in ("predicted_s", "measured_s"):
+            if key in rec and (not _is_num(rec[key]) or rec[key] < 0):
+                problems.append(
+                    f"{where}: {key}={rec.get(key)!r} must be a "
+                    "number >= 0")
+        # The ratio is recomputed, not trusted.
+        if all(_is_num(rec.get(k)) for k in ("predicted_s", "measured_s",
+                                             "ratio")) \
+                and rec["predicted_s"] > 0:
+            want = rec["measured_s"] / rec["predicted_s"]
+            if abs(rec["ratio"] - want) > max(1e-3, 1e-3 * want):
+                problems.append(
+                    f"{where}: ratio {rec['ratio']} != measured/"
+                    f"predicted = {want:.4f}")
+        if isinstance(rec.get("n"), int) and rec["n"] < 1:
+            problems.append(f"{where}: n must be >= 1")
+    return problems
+
+
+def validate_calibration_file(path: str) -> List[str]:
+    from pvraft_tpu.obs.loading import load_json_artifact
+
+    doc, problems = load_json_artifact(path)
+    if problems:
+        return problems
+    return validate_calibration(doc, path=path)

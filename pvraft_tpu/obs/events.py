@@ -18,7 +18,12 @@ Event types and their required fields:
                 {platform, device_count, process_index, process_count},
                 versions{jax}
     step        epoch, step, loss, epe        [+ telemetry{...}]
-    epoch_summary  epoch, steps               [+ loss, epe, step_ms]
+    epoch_summary  epoch, steps               [+ loss, epe, step_ms,
+                cost{program, basis, predicted_step_ms, step_ratio,
+                hw_utilization, platform, comparable} — the cost-
+                surface honesty block: measured step time vs the
+                inventory's flagship-geometry prediction; comparable
+                may be true only on platform "tpu"]
     eval        mode, epoch, scenes, metrics
     checkpoint  epoch, kind                   [+ path]
     trace_window  action ("start"|"stop"), trace_dir, epoch
@@ -37,6 +42,15 @@ covers training and serving telemetry:
                    "bad_request"|"shutdown"|"timeout"|"internal"|
                    "unavailable")                     [+ bucket, queue_depth]
     serve_shutdown served, rejected, drained
+    cost_calibration bucket, batch, dtype, predicted_s, measured_s,
+                   platform, comparable  [+ replica, basis, extrapolated,
+                   program] — one dispatch priced through the cost
+                   surface (serve/costing.py) next to its measured
+                   wall-seconds. ``comparable`` may be true ONLY on
+                   platform "tpu" (the pvraft_bench/v1 lesson: a CPU
+                   wall clock next to an XLA optimal-seconds prediction
+                   is recorded but never enforceable — the schema makes
+                   the silent-CPU-fallback comparison unrepresentable)
 
 Fault-tolerance events (``pvraft_tpu/serve/supervisor.py``,
 ``pvraft_tpu/serve/faults.py``) ride the same stream:
@@ -104,7 +118,8 @@ EVENT_TYPES: Dict[str, tuple] = {
     "run_header": (
         ("run_id", "mode", "config", "git", "devices", "versions"), ()),
     "step": (("epoch", "step", "loss", "epe"), ("telemetry",)),
-    "epoch_summary": (("epoch", "steps"), ("loss", "epe", "step_ms")),
+    "epoch_summary": (("epoch", "steps"),
+                      ("loss", "epe", "step_ms", "cost")),
     "eval": (("mode", "epoch", "scenes", "metrics"), ()),
     "checkpoint": (("epoch", "kind"), ("path",)),
     "trace_window": (("action", "trace_dir", "epoch"), ()),
@@ -117,6 +132,9 @@ EVENT_TYPES: Dict[str, tuple] = {
                     ("queue_depth", "replica", "device_id")),
     "serve_reject": (("reason",), ("bucket", "queue_depth")),
     "serve_shutdown": (("served", "rejected", "drained"), ()),
+    "cost_calibration": (("bucket", "batch", "dtype", "predicted_s",
+                          "measured_s", "platform", "comparable"),
+                         ("replica", "basis", "extrapolated", "program")),
     "span": (("trace_id", "span_id", "name", "start_ms", "end_ms"),
              ("parent_id", "attrs")),
     "slo_report": (("path", "slo_p99_ms"),
@@ -171,6 +189,8 @@ _NUMERIC_FIELDS = {
                     "queue_depth", "replica", "device_id"),
     "serve_reject": ("bucket", "queue_depth"),
     "serve_shutdown": ("served", "rejected", "drained"),
+    "cost_calibration": ("bucket", "batch", "predicted_s", "measured_s",
+                         "replica"),
     "span": ("start_ms", "end_ms"),
     "slo_report": ("slo_p99_ms", "max_qps_under_slo", "programs",
                    "requests"),
@@ -282,6 +302,55 @@ def validate_event(record: Any, seq: Optional[int] = None) -> List[str]:
                 and replica < 0:
             problems.append(
                 f"replica_state: replica {replica} must be >= 0")
+    if etype == "epoch_summary" and "cost" in record:
+        cost = record["cost"]
+        if not isinstance(cost, dict):
+            problems.append("epoch_summary: cost must be an object")
+        else:
+            if not isinstance(cost.get("comparable"), bool):
+                problems.append(
+                    "epoch_summary: cost.comparable must be a bool")
+            if cost.get("comparable") is True \
+                    and cost.get("platform") != "tpu":
+                problems.append(
+                    f"epoch_summary: cost.comparable=true on platform "
+                    f"{cost.get('platform')!r} — only a TPU step time "
+                    "may be enforced against the inventory prediction")
+    if etype == "cost_calibration":
+        if not isinstance(record.get("comparable"), bool):
+            problems.append(
+                "cost_calibration: comparable must be a bool (the "
+                "platform-honesty flag is first-class, never inferred)")
+        if not isinstance(record.get("platform"), str) \
+                or not record.get("platform"):
+            problems.append(
+                "cost_calibration: platform must be a non-empty string")
+        if record.get("comparable") is True \
+                and record.get("platform") != "tpu":
+            problems.append(
+                f"cost_calibration: comparable=true on platform "
+                f"{record.get('platform')!r} — only a TPU measurement "
+                "may be enforced against the TPU-topology prediction "
+                "(the pvraft_bench/v1 rule)")
+        for key in ("predicted_s", "measured_s"):
+            v = record.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v < 0:
+                problems.append(
+                    f"cost_calibration: {key}={v} must be >= 0")
+        if not isinstance(record.get("dtype"), str) \
+                or not record.get("dtype"):
+            problems.append(
+                "cost_calibration: dtype must be a non-empty string")
+        if "basis" in record and record["basis"] not in (
+                "xla_optimal", "roofline"):
+            problems.append(
+                f"cost_calibration: basis {record['basis']!r} must be "
+                "'xla_optimal' or 'roofline'")
+        if "extrapolated" in record \
+                and not isinstance(record["extrapolated"], bool):
+            problems.append(
+                "cost_calibration: extrapolated must be a bool")
     if etype == "fault_injected" and record.get("point") not in (
             FAULT_POINTS):
         problems.append(

@@ -1,0 +1,49 @@
+"""Shared committed-artifact loaders: the file contracts, in ONE place.
+
+Every validator and compare script in this repo reads a committed JSON
+artifact off disk before judging it, and until now each grew its own
+``open``/``json.loads`` wrapper — ``obs/bench.load_bench_file`` (the
+ONE-JSON-line bench contract), ``obs/slo.validate_slo_report_file``,
+``serve/loadgen.validate_load_artifact_file``, and the capacity /
+calibration loaders would have been the next siblings. A drifted copy
+of the line contract is exactly how a validator and its compare script
+end up disagreeing about what parses, so both contracts live here:
+
+* :func:`load_json_artifact` — a whole-file JSON document (the common
+  committed-report shape); unreadable / malformed files come back as
+  ``(None, [problem])``, never as a traceback (the lint gate runs these
+  on hand-editable files, and a traceback is not a verdict);
+* the same function with ``one_line=True`` — the ``bench.py`` contract:
+  the file must hold EXACTLY one non-blank JSON line (a second line is
+  a corrupted artifact, not extra data).
+
+Pure stdlib (no jax, no numpy): safe to import from the jax-free CLIs
+and from ``serve/loadgen.py`` alike.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List, Optional, Tuple
+
+
+def load_json_artifact(path: str, one_line: bool = False
+                       ) -> Tuple[Optional[Any], List[str]]:
+    """``(doc, problems)`` for one committed JSON artifact. ``doc`` is
+    None exactly when ``problems`` is non-empty; schema validation is
+    the caller's job — this owns only the file/parse contract."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return None, [f"{path}: unreadable: {e}"]
+    if one_line:
+        lines = [line for line in text.splitlines() if line.strip()]
+        if len(lines) != 1:
+            return None, [
+                f"{path}: expected exactly one JSON line, got {len(lines)}"]
+        text = lines[0]
+    try:
+        return json.loads(text), []
+    except ValueError as e:
+        return None, [f"{path}: not valid JSON: {e}"]

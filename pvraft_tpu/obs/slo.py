@@ -39,7 +39,6 @@ is the CLI.
 
 from __future__ import annotations
 
-import json
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from pvraft_tpu.obs.trace import SERVE_STAGES, trace_shape
@@ -278,9 +277,9 @@ def validate_slo_report(doc: Any, path: str = "<report>") -> List[str]:
 
 
 def validate_slo_report_file(path: str) -> List[str]:
-    try:
-        with open(path, "r", encoding="utf-8") as f:
-            doc = json.load(f)
-    except (OSError, ValueError) as e:
-        return [f"{path}: unreadable: {e}"]
+    from pvraft_tpu.obs.loading import load_json_artifact
+
+    doc, problems = load_json_artifact(path)
+    if problems:
+        return problems
     return validate_slo_report(doc, path=path)

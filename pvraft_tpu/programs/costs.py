@@ -43,11 +43,19 @@ persistent XLA cache (``artifacts/xla_cache``).
 
 from __future__ import annotations
 
+import dataclasses
+import json
+import math
 import os
+import re
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from pvraft_tpu.programs.geometries import HBM_BYTES, TOPOLOGY
+from pvraft_tpu.programs.geometries import (
+    HBM_BYTES,
+    SERVE_DTYPES,
+    TOPOLOGY,
+)
 from pvraft_tpu.programs.spec import ProgramSpec
 
 COSTS_SCHEMA = "pvraft_costs/v1"
@@ -310,13 +318,11 @@ def check_coverage(doc: Dict[str, Any],
 
 def validate_costs_file(path: str,
                         coverage: bool = False) -> List[str]:
-    import json
+    from pvraft_tpu.obs.loading import load_json_artifact
 
-    try:
-        with open(path, "r", encoding="utf-8") as f:
-            doc = json.load(f)
-    except (OSError, ValueError) as e:
-        return [f"{path}: unreadable: {e}"]
+    doc, problems = load_json_artifact(path)
+    if problems:
+        return problems
     problems = validate_costs(doc, path=path)
     if coverage and not problems:
         from pvraft_tpu.programs import load_catalog, specs as registry
@@ -324,3 +330,244 @@ def validate_costs_file(path: str,
         load_catalog()
         problems = check_coverage(doc, list(registry().values()), path=path)
     return problems
+
+
+# ------------------------------------------------------------ CostSurface --
+#
+# The READ side of the inventory (ISSUE 14): until now pvraft_costs/v1
+# was write-only evidence — committed, validated, and queried by nobody.
+# CostSurface turns the committed artifact into the runtime's cost
+# model: the serve plane prices every dispatched batch through it (and
+# measures itself against the prediction), the bucket advisor scores
+# proposals in predicted device-seconds, and the capacity planner
+# (obs/capacity.py) turns traffic histograms into chips-needed numbers.
+# jax-free (this module stays importable before a backend is pinned);
+# the v5e roofline constants come from the kernel planner — the one
+# place the chip's peak numbers are declared.
+
+from pvraft_tpu.analysis.kernels.planner import (  # noqa: E402 — grouped with its consumer
+    HBM_BYTES_PER_S,
+    PEAK_FLOPS_BF16,
+    PEAK_FLOPS_F32,
+)
+
+# Registry serve-record names: serve_predict_<variant>_b<bucket>_bs<bs>
+# (programs/catalog.py registers one per SERVE_CERTIFIED geometry).
+_SERVE_RECORD_RE = re.compile(
+    r"^serve_predict_(?P<variant>[a-z0-9_]+?)_b(?P<bucket>\d+)"
+    r"_bs(?P<bs>\d+)$")
+
+
+def _normalize_dtype(dtype: Optional[str]) -> str:
+    """The config layer's compute-dtype aliases, honored here too:
+    ``config.compute_dtype`` accepts 'f32'/None as float32 spellings,
+    and a run configured with the alias must not silently lose its
+    cost block."""
+    if dtype in ("f32", None):
+        return "float32"
+    if dtype == "bf16":
+        return "bfloat16"
+    return dtype
+
+
+def peak_flops_for(dtype: str) -> float:
+    """v5e peak MXU throughput for a compute dtype ('bfloat16' runs the
+    full MXU rate; anything else the fp32 half-rate)."""
+    return PEAK_FLOPS_BF16 if _normalize_dtype(dtype) == "bfloat16" \
+        else PEAK_FLOPS_F32
+
+
+def hardware_utilization(flops: float, measured_s: float,
+                         dtype: str) -> Optional[float]:
+    """Fraction of the chip's peak the measured seconds achieved for
+    ``flops`` of work (None when the measurement carries no signal)."""
+    if measured_s <= 0 or flops <= 0:
+        return None
+    return flops / (measured_s * peak_flops_for(dtype))
+
+
+@dataclasses.dataclass(frozen=True)
+class CostEstimate:
+    """One queryable prediction off the committed inventory.
+
+    ``device_seconds`` is the surface's prediction for one execution:
+    the XLA ``optimal_seconds`` when the record carries a positive one
+    (``basis="xla_optimal"``), else the v5e roofline bound
+    ``max(flops/peak, bytes/bandwidth)`` (``basis="roofline"`` — XLA
+    occasionally reports nonsensical negative optimal_seconds, e.g. the
+    committed ``pallas_fused_lookup_grad`` record, and a cost model must
+    not propagate a negative second). ``comparable`` is the platform
+    honesty flag (the ``pvraft_bench/v1`` lesson): True only for
+    records compiled against the real TPU topology — host-target
+    records predict shape-level cost and may be recorded against CPU
+    wall clock but never *enforced*. ``extrapolated`` marks estimates
+    linearly scaled from a neighboring certified geometry
+    (``reference``/``scale`` say from where and by how much)."""
+
+    name: str
+    target: str
+    flops: float
+    bytes_accessed: float
+    device_seconds: float
+    basis: str
+    comparable: bool
+    optimal_seconds: Optional[float] = None
+    live_bytes_estimate: Optional[float] = None
+    extrapolated: bool = False
+    scale: float = 1.0
+    reference: Optional[str] = None
+
+
+def default_costs_path() -> str:
+    """The committed inventory, repo-relative (the regenerate command
+    and the lint gate both name this exact file)."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "artifacts", "programs_costs.json")
+
+
+class CostSurface:
+    """Queryable view over one ``pvraft_costs/v1`` artifact.
+
+    Lookups return :class:`CostEstimate` (or None when the registry
+    never certified the geometry); nothing here compiles, traces or
+    imports jax — the surface is safe on the serve dispatch path and in
+    backend-free CLIs alike."""
+
+    def __init__(self, doc: Dict[str, Any], path: str = "<costs>"):
+        if not isinstance(doc, dict) or doc.get("schema") != COSTS_SCHEMA:
+            raise ValueError(
+                f"{path}: not a {COSTS_SCHEMA} artifact "
+                f"(schema={doc.get('schema') if isinstance(doc, dict) else type(doc).__name__!r})")
+        self.path = path
+        self._records: Dict[str, Dict[str, Any]] = {
+            r["name"]: r for r in doc.get("programs", ())
+            if isinstance(r, dict) and isinstance(r.get("name"), str)
+            and r.get("ok")}
+        # (variant, bucket, batch) -> record name, for the serve table.
+        self._serve_index: Dict[Tuple[str, int, int], str] = {}
+        for name in self._records:
+            m = _SERVE_RECORD_RE.match(name)
+            if m:
+                self._serve_index[(m.group("variant"), int(m.group("bucket")),
+                                   int(m.group("bs")))] = name
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "CostSurface":
+        """Load the committed inventory (default:
+        ``artifacts/programs_costs.json``). Raises OSError/ValueError on
+        a missing or malformed file. Arming the surface is an EXPLICIT
+        opt-in everywhere it happens (``build_service(cost_surface=...)``,
+        the serve ``--cost_surface`` flag), so a bad path fails loudly
+        there — silently serving unpriced would defeat the plane; only
+        the trainer's background lookup (an implicit default-on
+        convenience) catches and degrades to None."""
+        path = path or default_costs_path()
+        with open(path, "r", encoding="utf-8") as f:
+            return cls(json.load(f), path=path)
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    # ------------------------------------------------------------ lookups --
+
+    def lookup(self, program_name: str) -> Optional[CostEstimate]:
+        """Predicted cost of one registered program, by registry name."""
+        rec = self._records.get(program_name)
+        return None if rec is None else self._estimate(rec)
+
+    def _estimate(self, rec: Dict[str, Any]) -> CostEstimate:
+        flops = float(rec.get("flops", 0.0) or 0.0)
+        bytes_accessed = float(rec.get("bytes_accessed", 0.0) or 0.0)
+        optimal = rec.get("optimal_seconds")
+        dtype = "bfloat16" if "bf16" in rec["name"] else "float32"
+        if isinstance(optimal, (int, float)) and optimal > 0:
+            seconds, basis = float(optimal), "xla_optimal"
+        else:
+            seconds = max(flops / peak_flops_for(dtype),
+                          bytes_accessed / HBM_BYTES_PER_S)
+            basis = "roofline"
+        mem = rec.get("memory") or {}
+        return CostEstimate(
+            name=rec["name"], target=rec.get("target", ""),
+            flops=flops, bytes_accessed=bytes_accessed,
+            device_seconds=seconds, basis=basis,
+            comparable=rec.get("target") != "host",
+            optimal_seconds=(float(optimal)
+                             if isinstance(optimal, (int, float)) else None),
+            live_bytes_estimate=mem.get("live_bytes_estimate"))
+
+    def _variants_for(self, dtype: str) -> List[str]:
+        short = SERVE_DTYPES.get(_normalize_dtype(dtype), dtype)
+        return sorted({v for v, _, _ in self._serve_index
+                       if v == short or v.startswith(short + "_")})
+
+    def serve_coverage(self, dtype: str) -> List[Tuple[int, int]]:
+        """The (bucket, batch) geometries the registry certified for
+        this serving dtype — exactly what :meth:`lookup_serve` answers."""
+        variants = set(self._variants_for(dtype))
+        return sorted({(b, bs) for v, b, bs in self._serve_index
+                       if v in variants})
+
+    def lookup_serve(self, bucket: int, batch: int,
+                     dtype: str) -> Optional[CostEstimate]:
+        """Exact lookup of one certified serve geometry (None when the
+        registry holds no record for this (bucket, batch, dtype))."""
+        for variant in self._variants_for(dtype):
+            name = self._serve_index.get((variant, int(bucket), int(batch)))
+            if name is not None:
+                return self._estimate(self._records[name])
+        return None
+
+    def estimate_serve(self, bucket: int, batch: int,
+                       dtype: str) -> Optional[CostEstimate]:
+        """Predicted cost of one serve dispatch geometry: the exact
+        certified record when it exists, else a LINEAR-in-(bucket *
+        batch) scaling of the nearest certified geometry for the same
+        dtype — explicitly flagged ``extrapolated`` with its reference
+        and scale, so an uncertified-geometry prediction can never pass
+        itself off as AOT evidence. None when the dtype has no serve
+        records at all."""
+        exact = self.lookup_serve(bucket, batch, dtype)
+        if exact is not None:
+            return exact
+        covered = self.serve_coverage(dtype)
+        if not covered:
+            return None
+        work = float(bucket) * float(batch)
+        ref_bucket, ref_bs = min(
+            covered,
+            key=lambda g: abs(math.log(work / (float(g[0]) * float(g[1])))))
+        base = self.lookup_serve(ref_bucket, ref_bs, dtype)
+        assert base is not None
+        scale = work / (float(ref_bucket) * float(ref_bs))
+        return dataclasses.replace(
+            base,
+            flops=base.flops * scale,
+            bytes_accessed=base.bytes_accessed * scale,
+            device_seconds=base.device_seconds * scale,
+            optimal_seconds=None,
+            extrapolated=True, scale=scale, reference=base.name)
+
+    def serve_seconds_per_request(self, bucket: int,
+                                  dtype: str) -> Optional[float]:
+        """Predicted device-seconds ONE request costs in this bucket:
+        the best (lowest per-slot) certified batch size's seconds
+        divided by its batch. Exact coverage only (None otherwise) —
+        the bucket advisor's fallback contract wants a hard answer to
+        'does the surface cover this bucket', not an extrapolation."""
+        per_request = [
+            self.lookup_serve(b, bs, dtype).device_seconds / bs
+            for b, bs in self.serve_coverage(dtype) if b == int(bucket)]
+        return min(per_request) if per_request else None
+
+    def lookup_train_step(self, dtype: str) -> Optional[CostEstimate]:
+        """The flagship train-step record matching a compute dtype —
+        the training side's honesty metric (epoch_summary's
+        predicted-vs-measured ratio) reads this."""
+        short = SERVE_DTYPES.get(_normalize_dtype(dtype), dtype)
+        names = sorted(
+            n for n in self._records
+            if n.startswith("flagship_train_step") and short in n)
+        return self.lookup(names[0]) if names else None

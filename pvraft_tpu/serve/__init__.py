@@ -41,6 +41,7 @@ from pvraft_tpu.serve.engine import (           # noqa: F401
     RequestError,
     ServeConfig,
 )
+from pvraft_tpu.serve.costing import ServeCostModel         # noqa: F401
 from pvraft_tpu.serve.events import ServeTelemetry          # noqa: F401
 from pvraft_tpu.serve.faults import (                       # noqa: F401
     FaultPlan,

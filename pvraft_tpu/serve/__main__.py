@@ -56,6 +56,16 @@ def _cmd_serve(args) -> int:
     )
     telemetry = (ServeTelemetry(args.events, cfg=cfg)
                  if args.events else None)
+    # Load the cost surface BEFORE the engine compiles its program
+    # table: a typo'd --cost_surface path must fail in milliseconds,
+    # not after minutes of AOT compiles.
+    cost_surface = None
+    if args.cost_surface:
+        from pvraft_tpu.programs.costs import CostSurface
+
+        cost_surface = CostSurface.load(args.cost_surface)
+        print(f"[serve] cost surface armed: {args.cost_surface} "
+              f"({len(cost_surface)} program records)", flush=True)
     print(f"[serve] compiling {len(cfg.buckets) * len(cfg.batch_sizes)} "
           f"predict programs (buckets={cfg.buckets}, "
           f"batch_sizes={cfg.batch_sizes}, dtype={cfg.dtype}, "
@@ -83,7 +93,8 @@ def _cmd_serve(args) -> int:
                            strict_retrace=args.strict_retrace,
                            devmem_interval_s=args.devmem_interval,
                            supervise=not args.no_supervise,
-                           supervisor_cfg=supervisor_cfg)
+                           supervisor_cfg=supervisor_cfg,
+                           cost_surface=cost_surface)
     server.start()
     print(f"[serve] listening on http://{server.host}:{server.port} "
           f"(/predict /healthz /metrics /debug/trace); tracing "
@@ -181,6 +192,17 @@ def main(argv=None) -> int:
                      help="seconds between device.memory_stats() samples "
                           "(device_memory events + "
                           "pvraft_device_hbm_bytes gauge; 0 disables)")
+    srv.add_argument("--cost_surface", "--cost-surface",
+                     dest="cost_surface", default="",
+                     help="arm the cost-calibration plane from a "
+                          "committed pvraft_costs/v1 inventory (e.g. "
+                          "artifacts/programs_costs.json): every "
+                          "dispatch is priced in predicted "
+                          "device-seconds and measured against the "
+                          "price (Prometheus counters, "
+                          "cost_calibration events, /healthz cost "
+                          "block). Empty (default) = disarmed, "
+                          "zero dispatch-path residue")
     srv.add_argument("--platform", default="",
                      help="force a jax platform (e.g. cpu)")
     srv.add_argument("--verbose", action="store_true",

@@ -16,8 +16,17 @@ This module turns that histogram into a proposed bucket table:
   bins' upper edges (anything between two edges is unsupported by the
   data, anything above the top non-empty edge is pure waste);
 * choosing ``n_buckets`` of those candidates to minimize the expected
-  *device points per request* (``sum_i count_i * bucket_for(bin_i)``)
-  is a classic contiguous-partition DP, exact in O(bins^2 * n_buckets);
+  *cost per request* is a classic contiguous-partition DP, exact in
+  O(bins^2 * n_buckets);
+* the DP's objective is **predicted device-seconds** when a
+  :class:`~pvraft_tpu.programs.costs.CostSurface` covers every
+  candidate bucket exactly (ISSUE 14 / ROADMAP items 3+5: an
+  8192-point bucket and a 2048-point bucket are not the same unit of
+  work, and the certified cost records say by how much) — and falls
+  back to the PR-8 *expected device points* proxy with a loud
+  ``objective.note`` when the surface does not cover the proposal
+  geometry (scoring uncertified buckets in certified seconds would be
+  fiction);
 * the same cost model scores the CURRENT table
   (``programs/geometries.SERVE_DEFAULT_BUCKETS``) on the same
   histogram, so the report is a cross-check, not just a proposal —
@@ -50,14 +59,32 @@ def _bins(edges: Sequence[float],
             for i, c in enumerate(counts[:-1]) if c]
 
 
+def _cost_keys(bucket_cost: Optional[Dict[int, float]]
+               ) -> Tuple[str, str, int]:
+    """(per-request key, ideal key, rounding digits) for the active
+    objective: device points (the PR-8 proxy) or predicted
+    device-seconds (ISSUE 14, when a cost table is supplied)."""
+    if bucket_cost is None:
+        return "points_per_request", "ideal_points_per_request", 2
+    return ("device_seconds_per_request",
+            "ideal_device_seconds_per_request", 6)
+
+
 def propose_buckets(edges: Sequence[float], counts: Sequence[int],
                     n_buckets: int,
-                    min_bucket: int = 0) -> Dict[str, Any]:
+                    min_bucket: int = 0,
+                    bucket_cost: Optional[Dict[int, float]] = None
+                    ) -> Dict[str, Any]:
     """The optimal ``n_buckets``-entry bucket table for this histogram
-    under the expected-device-points cost model (exact DP). Buckets
-    below ``min_bucket`` (the engine's ``min_points`` floor or a
-    hardware tile constraint) are disallowed; bins below it are served
-    by the smallest legal bucket."""
+    (exact DP). The objective is expected device POINTS per request by
+    default; ``bucket_cost`` (candidate bucket -> predicted
+    device-seconds one request costs there, from
+    ``CostSurface.serve_seconds_per_request``) swaps it to expected
+    device-SECONDS — it must cover every candidate value, which the
+    caller guarantees (``build_advisor_report`` falls back to points
+    otherwise). Buckets below ``min_bucket`` (the engine's
+    ``min_points`` floor or a hardware tile constraint) are disallowed;
+    bins below it are served by the smallest legal bucket."""
     if n_buckets < 1:
         raise ValueError("n_buckets must be >= 1")
     bins = _bins(edges, counts)
@@ -71,6 +98,13 @@ def propose_buckets(edges: Sequence[float], counts: Sequence[int],
     for edge, count in bins:
         weight[max(edge, min_bucket)] += count
     values = candidates
+    if bucket_cost is not None:
+        missing = [v for v in values if v not in bucket_cost]
+        if missing:
+            raise ValueError(
+                f"bucket_cost does not cover candidate buckets "
+                f"{missing} — fall back to the device-points objective "
+                "instead of pricing uncertified geometry")
     w = [weight[v] for v in values]
     n = len(values)
     k_max = min(n_buckets, n)
@@ -83,9 +117,12 @@ def propose_buckets(edges: Sequence[float], counts: Sequence[int],
     for x in w:
         prefix.append(prefix[-1] + x)
 
-    def seg(j: int, i: int) -> int:
+    def unit_cost(v: int) -> float:
+        return float(v) if bucket_cost is None else float(bucket_cost[v])
+
+    def seg(j: int, i: int) -> float:
         """Cost of bins j..i all served by values[i]."""
-        return (prefix[i + 1] - prefix[j]) * values[i]
+        return (prefix[i + 1] - prefix[j]) * unit_cost(values[i])
 
     dp = [[inf] * n for _ in range(k_max + 1)]
     choice = [[-1] * n for _ in range(k_max + 1)]
@@ -109,11 +146,13 @@ def propose_buckets(edges: Sequence[float], counts: Sequence[int],
         k -= 1
     buckets.reverse()
     total = sum(w)
-    ideal = sum(cw * v for v, cw in zip(values, w))  # one bucket per bin
+    ideal = sum(cw * unit_cost(v)                    # one bucket per bin
+                for v, cw in zip(values, w))
+    per_key, ideal_key, digits = _cost_keys(bucket_cost)
     return {
         "buckets": buckets,
-        "points_per_request": round(cost / total, 2),
-        "ideal_points_per_request": round(ideal / total, 2),
+        per_key: round(cost / total, digits),
+        ideal_key: round(ideal / total, digits),
         "overhead_vs_ideal": round(cost / ideal - 1.0, 4) if ideal else None,
         "requests": total,
         "overflow_requests": overflow,
@@ -121,15 +160,25 @@ def propose_buckets(edges: Sequence[float], counts: Sequence[int],
 
 
 def score_buckets(buckets: Sequence[int], edges: Sequence[float],
-                  counts: Sequence[int]) -> Dict[str, Any]:
-    """Expected device points per request of an EXISTING bucket table on
-    this histogram (same cost model as :func:`propose_buckets`), plus
-    the fraction of observed traffic it rejects (bins whose upper edge
-    exceeds the largest bucket, and the overflow bin)."""
+                  counts: Sequence[int],
+                  bucket_cost: Optional[Dict[int, float]] = None
+                  ) -> Dict[str, Any]:
+    """Expected cost per request of an EXISTING bucket table on this
+    histogram (same objective switch as :func:`propose_buckets` —
+    device points, or device-seconds when ``bucket_cost`` covers the
+    table), plus the fraction of observed traffic it rejects (bins
+    whose upper edge exceeds the largest bucket, and the overflow
+    bin)."""
     bins = _bins(edges, counts)
     overflow = int(counts[-1])
     table = sorted(buckets)
-    served_cost = served = rejected = 0
+    if bucket_cost is not None:
+        missing = [b for b in table if int(b) not in bucket_cost]
+        if missing:
+            raise ValueError(
+                f"bucket_cost does not cover table buckets {missing}")
+    served_cost = 0.0
+    served = rejected = 0
     per_bucket = {int(b): 0 for b in table}
     for edge, count in bins:
         bucket = next((b for b in table if edge <= b), None)
@@ -137,14 +186,16 @@ def score_buckets(buckets: Sequence[int], edges: Sequence[float],
             rejected += count
             continue
         served += count
-        served_cost += count * bucket
+        served_cost += count * (float(bucket) if bucket_cost is None
+                                else float(bucket_cost[int(bucket)]))
         per_bucket[bucket] += count
     rejected += overflow
     total = served + rejected
+    per_key, _, digits = _cost_keys(bucket_cost)
     return {
         "buckets": [int(b) for b in table],
-        "points_per_request": (round(served_cost / served, 2)
-                               if served else None),
+        per_key: (round(served_cost / served, digits)
+                  if served else None),
         "requests": total,
         "served_requests": served,
         "rejected_requests": rejected,
@@ -153,19 +204,60 @@ def score_buckets(buckets: Sequence[int], edges: Sequence[float],
     }
 
 
+def candidate_buckets(edges: Sequence[float], counts: Sequence[int],
+                      min_bucket: int = 0) -> List[int]:
+    """The candidate bucket values :func:`propose_buckets` will choose
+    from (non-empty bins' upper edges, min_bucket-folded) — exposed so
+    the cost-surface coverage check and the DP agree on the exact set."""
+    return sorted({max(edge, min_bucket)
+                   for edge, _ in _bins(edges, counts)})
+
+
 def build_advisor_report(edges: Sequence[float], counts: Sequence[int],
                          current_buckets: Sequence[int],
                          n_buckets: Optional[int] = None,
                          min_bucket: int = 0,
-                         source: str = "<histogram>") -> Dict[str, Any]:
+                         source: str = "<histogram>",
+                         cost_surface=None,
+                         dtype: str = "bfloat16") -> Dict[str, Any]:
     """The full advisory: proposed table (same size as the current one
     unless ``n_buckets`` overrides), current-table score, and the
-    improvement — all from one committed histogram."""
+    improvement — all from one committed histogram.
+
+    ``cost_surface`` (a :class:`~pvraft_tpu.programs.costs.CostSurface`)
+    promotes the objective from expected device points to PREDICTED
+    DEVICE-SECONDS when the surface's certified serve records cover
+    every candidate bucket AND the current table exactly; otherwise the
+    report falls back to points with a loud ``objective.note`` naming
+    the uncovered buckets (pricing uncertified geometry in certified
+    seconds would be fiction — the registry certifies a proposal first,
+    then the seconds objective scores it)."""
     k = n_buckets or len(current_buckets)
-    proposed = propose_buckets(edges, counts, k, min_bucket=min_bucket)
-    current = score_buckets(current_buckets, edges, counts)
+    bucket_cost = None
+    objective: Dict[str, Any] = {"unit": "device_points"}
+    if cost_surface is not None:
+        need = sorted(set(candidate_buckets(edges, counts, min_bucket))
+                      | {int(b) for b in current_buckets})
+        costs = {b: cost_surface.serve_seconds_per_request(b, dtype)
+                 for b in need}
+        uncovered = sorted(b for b, c in costs.items() if c is None)
+        if uncovered:
+            objective["note"] = (
+                f"cost surface has no certified serve record for "
+                f"buckets {uncovered} (dtype {dtype}) — scoring in "
+                "expected device points instead of predicted "
+                "device-seconds")
+        else:
+            bucket_cost = costs
+            objective = {"unit": "device_seconds", "dtype": dtype,
+                         "surface": getattr(cost_surface, "path", None)}
+    per_key, _, _ = _cost_keys(bucket_cost)
+    proposed = propose_buckets(edges, counts, k, min_bucket=min_bucket,
+                               bucket_cost=bucket_cost)
+    current = score_buckets(current_buckets, edges, counts,
+                            bucket_cost=bucket_cost)
     improvement = None
-    if current["points_per_request"] and current["served_requests"]:
+    if current[per_key] and current["served_requests"]:
         # Compare on the SAME population: the proposed table serves all
         # in-range traffic while the current one may reject part of it,
         # and per-request costs over different populations are not
@@ -179,12 +271,13 @@ def build_advisor_report(edges: Sequence[float], counts: Sequence[int],
             c if i < len(edges) and edges[i] <= largest_current else 0
             for i, c in enumerate(counts)]
         proposed_on_served = score_buckets(
-            proposed["buckets"], edges, served_counts)
-        saved = (current["points_per_request"]
-                 - proposed_on_served["points_per_request"])
+            proposed["buckets"], edges, served_counts,
+            bucket_cost=bucket_cost)
+        saved = current[per_key] - proposed_on_served[per_key]
+        _, _, digits = _cost_keys(bucket_cost)
         improvement = {
-            "points_per_request_saved": round(saved, 2),
-            "relative": round(saved / current["points_per_request"], 4),
+            f"{per_key}_saved": round(saved, digits),
+            "relative": round(saved / current[per_key], 4),
             "population": "traffic served by the current table",
         }
     return {
@@ -193,6 +286,7 @@ def build_advisor_report(edges: Sequence[float], counts: Sequence[int],
         "histogram": {"edges": [int(e) for e in edges],
                       "counts": [int(c) for c in counts]},
         "min_bucket": int(min_bucket),
+        "objective": objective,
         "proposed": proposed,
         "current": current,
         "improvement": improvement,

@@ -143,7 +143,7 @@ class MicroBatcher:
 
     def __init__(self, engine: InferenceEngine, cfg: BatcherConfig,
                  telemetry=None, metrics=None, watchdog=None,
-                 supervisor=None):
+                 supervisor=None, costing=None):
         largest = max(engine.cfg.batch_sizes)
         if cfg.max_batch > largest:
             raise ValueError(
@@ -169,6 +169,13 @@ class MicroBatcher:
         # pre-supervision semantics, bit-for-bit (every hook below is a
         # None check).
         self.supervisor = supervisor
+        # Cost-calibration plane (serve/costing.py), wired by
+        # build_service when a cost surface is armed: every successful
+        # dispatch is priced in predicted device-seconds and measured
+        # against the price. None = disarmed, and the dispatch path
+        # carries exactly one attribute check (the faults.py
+        # zero-residue discipline, test-gated).
+        self.costing = costing
         # The executor pool: the engine's replicas, or the engine itself
         # as a single executor (test doubles without a pool).
         self.replicas = list(getattr(engine, "replicas", ()) or ()) \
@@ -603,6 +610,11 @@ class MicroBatcher:
                 if not r.abandoned and r.finalize()]
         bs = self.engine.batch_size_for(len(group))
         device_id = int(getattr(replica, "device_id", index))
+        # Price + measure the dispatch against the cost surface, keyed
+        # on the DISPATCHED batch slot count (the AOT program that ran,
+        # mirroring the fill accounting below).
+        if self.costing is not None:
+            self.costing.observe_dispatch(bucket, bs, index, t0, now)
         for r, _ in live:
             # Re-read trace/abandoned per request: a waiter that 504'd
             # since `live` was computed is assembling its (partial) span

@@ -1,0 +1,96 @@
+"""Dispatch pricing: the serve plane's live view of the cost surface.
+
+ISSUE 14's feedback loop: every dispatched micro-batch is PRICED
+through the committed cost inventory
+(:class:`~pvraft_tpu.programs.costs.CostSurface`) and MEASURED against
+that price — the predicted device-seconds land on the
+``pvraft_serve_predicted_device_seconds_total`` counter, the measured
+dispatch wall on ``pvraft_serve_device_busy_seconds_total{replica}``,
+and their per-(bucket, batch, dtype) ratio is the calibration summary
+that says whether the cost model is honest (``cost_calibration``
+events + ``/healthz`` snapshot + Prometheus).
+
+Platform honesty is first-class (the ``pvraft_bench/v1`` lesson): a
+calibration record is ``comparable`` ONLY when the engine executes on a
+real TPU *and* the prediction came from a TPU-topology record — a CPU
+wall clock next to an XLA optimal-seconds estimate is recorded (the
+machinery must be exercised everywhere) but can never be enforced, and
+the schema makes the distinction unrepresentable to forget
+(``obs/events.py`` rejects ``comparable: true`` off-TPU).
+
+The price table is computed ONCE at construction (the serve program
+table is a small static product), so the per-dispatch hook is a dict
+read plus two counter bumps — and a disarmed service carries no model
+at all (``costing is None`` in the batcher: one attribute check, the
+``faults.py`` zero-residue discipline, test-gated).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from pvraft_tpu.programs.costs import CostEstimate, CostSurface
+
+
+class ServeCostModel:
+    """One serve pool's price table + calibration sink."""
+
+    def __init__(self, surface: CostSurface, buckets: Sequence[int],
+                 batch_sizes: Sequence[int], dtype: str, platform: str,
+                 metrics=None, telemetry=None):
+        self.surface = surface
+        self.dtype = dtype
+        self.platform = platform
+        self.metrics = metrics
+        self.telemetry = telemetry
+        # Immutable after construction: read-only from the executor
+        # threads, so no lock is needed on the dispatch path.
+        self._prices: Dict[Tuple[int, int], Optional[CostEstimate]] = {
+            (int(b), int(bs)): surface.estimate_serve(b, bs, dtype)
+            for b in buckets for bs in batch_sizes}
+
+    def price(self, bucket: int, batch: int) -> Optional[CostEstimate]:
+        """The predicted cost of one (bucket, batch) dispatch (None when
+        the surface has no serve records for the dtype at all)."""
+        return self._prices.get((int(bucket), int(batch)))
+
+    def coverage(self) -> Dict[str, Any]:
+        """What the table knows — the /healthz arming report."""
+        priced = {k: v for k, v in self._prices.items() if v is not None}
+        return {
+            "surface": self.surface.path,
+            "dtype": self.dtype,
+            "platform": self.platform,
+            "programs": len(self.surface),
+            "priced_geometries": len(priced),
+            "extrapolated_geometries": sorted(
+                f"b{b}_bs{bs}" for (b, bs), v in priced.items()
+                if v.extrapolated),
+        }
+
+    def observe_dispatch(self, bucket: int, batch: int, replica: int,
+                         t_start: float, t_end: float) -> None:
+        """Price + measure one successful dispatch. Called by the
+        batcher's executor after the engine call returns; ``t_start``/
+        ``t_end`` bracket exactly the device_execute window the trace
+        plane marks, so the busy-seconds ledger and the span plane tell
+        one story."""
+        est = self.price(bucket, batch)
+        if est is None:
+            return
+        measured_s = max(0.0, t_end - t_start)
+        comparable = self.platform == "tpu" and est.comparable
+        if self.metrics is not None:
+            self.metrics.record_cost(
+                bucket=bucket, batch=batch, dtype=self.dtype,
+                replica=replica, predicted_s=est.device_seconds,
+                measured_s=measured_s, t_start=t_start, t_end=t_end,
+                comparable=comparable, extrapolated=est.extrapolated)
+        if self.telemetry is not None:
+            self.telemetry.emit_cost_calibration(
+                bucket=bucket, batch=batch, dtype=self.dtype,
+                predicted_s=round(est.device_seconds, 9),
+                measured_s=round(measured_s, 6),
+                platform=self.platform, comparable=comparable,
+                replica=replica, basis=est.basis,
+                extrapolated=est.extrapolated, program=est.name)

@@ -384,6 +384,14 @@ class InferenceEngine:
 
     # ---------------------------------------------------------------- API --
 
+    @property
+    def platform(self) -> str:
+        """The backend the replica pool executes on ("tpu"/"cpu"/...):
+        the cost-calibration plane's comparable flag keys off this —
+        predictions come from the TPU-topology inventory, and only a
+        TPU measurement may be enforced against them."""
+        return str(self.replicas[0].device.platform)
+
     def bucket_for(self, n_points: int) -> Optional[int]:
         """Smallest bucket holding ``n_points``, or None if too large."""
         for b in self.cfg.buckets:

@@ -166,6 +166,34 @@ class ServeTelemetry:
         with self._lock:
             self.events.emit("fault_injected", **fields)
 
+    def emit_cost_calibration(self, bucket: int, batch: int, dtype: str,
+                              predicted_s: float, measured_s: float,
+                              platform: str, comparable: bool,
+                              replica: Optional[int] = None,
+                              basis: Optional[str] = None,
+                              extrapolated: Optional[bool] = None,
+                              program: Optional[str] = None) -> None:
+        """One dispatch priced through the cost surface
+        (serve/costing.py) beside its measured wall-seconds — the
+        calibration ledger that proves (or indicts) the cost model.
+        ``comparable`` may be true only on platform "tpu"; the validator
+        rejects anything else (enforcing off-TPU is a schema violation
+        by design)."""
+        fields: Dict[str, Any] = {
+            "bucket": bucket, "batch": batch, "dtype": dtype,
+            "predicted_s": predicted_s, "measured_s": measured_s,
+            "platform": platform, "comparable": comparable}
+        if replica is not None:
+            fields["replica"] = replica
+        if basis is not None:
+            fields["basis"] = basis
+        if extrapolated is not None:
+            fields["extrapolated"] = extrapolated
+        if program is not None:
+            fields["program"] = program
+        with self._lock:
+            self.events.emit("cost_calibration", **fields)
+
     def emit_shutdown(self, served: int, rejected: int,
                       drained: int) -> None:
         with self._lock:

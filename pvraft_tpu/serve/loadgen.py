@@ -197,11 +197,11 @@ def validate_load_artifact(doc: Any,
 
 
 def validate_load_artifact_file(path: str) -> List[str]:
-    try:
-        with open(path, "r", encoding="utf-8") as f:
-            doc = json.load(f)
-    except (OSError, ValueError) as e:
-        return [f"{path}: unreadable: {e}"]
+    from pvraft_tpu.obs.loading import load_json_artifact
+
+    doc, problems = load_json_artifact(path)
+    if problems:
+        return problems
     return validate_load_artifact(doc, path=path)
 
 

@@ -22,6 +22,8 @@ Two exposition formats off the same store:
 
 from __future__ import annotations
 
+import collections
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
@@ -40,6 +42,20 @@ POINT_EDGES = (
     32.0, 64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0, 8192.0,
     16384.0, 32768.0,
 )
+
+# Rolling window for the per-replica utilization gauge (the fraction of
+# the last window each replica spent inside predict): long enough to
+# smooth batch granularity, short enough that a drained replica reads 0
+# within a scrape or two.
+UTILIZATION_WINDOW_S = 60.0
+
+# Hard backstop on the per-replica dispatch-interval history backing
+# the rolling utilization. Intervals are pruned by AGE on every append
+# (only the trailing window is ever kept), so this cap exists purely to
+# bound memory against a pathological dispatch rate — at 65536 entries
+# the window stays fully covered down to ~0.9 ms/dispatch; the counters
+# (busy seconds) are exact regardless.
+_BUSY_INTERVALS_MAX = 65536
 
 
 class LatencyHistogram:
@@ -126,6 +142,19 @@ class ServeMetrics:
         # Batches re-dispatched on a different replica after a dispatch
         # failure (serve/supervisor.py retry-once) — Prometheus-only.
         self.retries_total = 0  # guarded-by: _lock
+        # Cost-calibration plane (ISSUE 14; Prometheus/healthz-only, the
+        # frozen JSON snapshot never sees any of it). Armed explicitly
+        # by build_service when a cost surface is wired — a disarmed
+        # store renders the exposition byte-identically to pre-surface
+        # builds (test-gated).
+        self.cost_armed = False  # guarded-by: _lock
+        self.predicted_device_seconds_total = 0.0  # guarded-by: _lock
+        self.busy_seconds: Dict[int, float] = {}  # guarded-by: _lock
+        # (bucket, batch, dtype) -> running calibration sums.
+        self.cost_calibration: Dict[Tuple[int, int, str], Dict[str, Any]] = {}  # guarded-by: _lock
+        # replica -> recent (t_start, t_end) dispatch intervals, backing
+        # the rolling utilization gauge.
+        self._busy_intervals: Dict[int, Any] = {}  # guarded-by: _lock
 
     def current_in_flight(self) -> int:
         """Locked read of the in-flight gauge for external surfaces
@@ -172,6 +201,88 @@ class ServeMetrics:
         (serve/batcher.py retry-once-on-other-replica)."""
         with self._lock:
             self.retries_total += 1
+
+    def arm_cost(self) -> None:
+        """Turn the cost-calibration series on (build_service, when a
+        cost surface is wired). Disarmed stores render the exposition
+        byte-identically to pre-surface builds."""
+        with self._lock:
+            self.cost_armed = True
+
+    def record_cost(self, bucket: int, batch: int, dtype: str,
+                    replica: int, predicted_s: float, measured_s: float,
+                    t_start: float, t_end: float, comparable: bool,
+                    extrapolated: bool) -> None:
+        """One priced + measured dispatch (serve/costing.py): predicted
+        device-seconds vs the measured dispatch wall, per (bucket,
+        batch, dtype) and per replica."""
+        key = (int(bucket), int(batch), dtype)
+        with self._lock:
+            self.predicted_device_seconds_total += predicted_s
+            self.busy_seconds[int(replica)] = (
+                self.busy_seconds.get(int(replica), 0.0) + measured_s)
+            slot = self.cost_calibration.get(key)
+            if slot is None:
+                slot = {"n": 0, "predicted_s": 0.0, "measured_s": 0.0,
+                        "comparable": comparable, "extrapolated": 0}
+                self.cost_calibration[key] = slot
+            slot["n"] += 1
+            slot["predicted_s"] += predicted_s
+            slot["measured_s"] += measured_s
+            # One record per key: an incomparable dispatch poisons the
+            # whole key (mixed-platform sums are never enforceable).
+            slot["comparable"] = slot["comparable"] and comparable
+            slot["extrapolated"] += 1 if extrapolated else 0
+            window = self._busy_intervals.get(int(replica))
+            if window is None:
+                window = collections.deque(maxlen=_BUSY_INTERVALS_MAX)
+                self._busy_intervals[int(replica)] = window
+            window.append((t_start, t_end))
+            # Prune by age so a busy replica's history always spans the
+            # full utilization window (a fixed-size deque alone would
+            # silently shrink the numerator's coverage below the
+            # window it is divided by — phantom headroom).
+            cutoff = t_end - UTILIZATION_WINDOW_S
+            while window and window[0][1] < cutoff:
+                window.popleft()
+
+    def cost_snapshot(self, now: Optional[float] = None
+                      ) -> Optional[Dict[str, Any]]:
+        """The /healthz calibration + utilization block (None while the
+        cost plane is disarmed — the JSON /metrics snapshot never
+        carries any of this; /healthz is the operator surface)."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            if not self.cost_armed:
+                return None
+            rows = []
+            for (bucket, batch, dtype), slot in sorted(
+                    self.cost_calibration.items()):
+                rows.append({
+                    "bucket": bucket, "batch": batch, "dtype": dtype,
+                    "n": slot["n"],
+                    "predicted_s": round(slot["predicted_s"], 6),
+                    "measured_s": round(slot["measured_s"], 6),
+                    "ratio": (round(slot["measured_s"]
+                                    / slot["predicted_s"], 4)
+                              if slot["predicted_s"] > 0 else None),
+                    "comparable": slot["comparable"],
+                    "extrapolated": slot["extrapolated"],
+                })
+            return {
+                "predicted_device_seconds_total": round(
+                    self.predicted_device_seconds_total, 6),
+                "device_busy_seconds": {
+                    str(r): round(s, 6)
+                    for r, s in sorted(self.busy_seconds.items())},
+                "utilization_window_s": UTILIZATION_WINDOW_S,
+                "utilization": {
+                    str(r): round(u, 4)
+                    for r, u in sorted(replica_utilization(
+                        self._busy_intervals, now).items())},
+                "calibration": rows,
+            }
 
     def record_reject(self, reason: str) -> None:
         with self._lock:
@@ -230,6 +341,22 @@ class ServeMetrics:
             return render_prometheus(self, queue_depths,
                                      replica_stats=replica_stats,
                                      batch_queue_depth=batch_queue_depth)
+
+
+def replica_utilization(busy_intervals: Dict[int, Any], now: float,
+                        window_s: float = UTILIZATION_WINDOW_S
+                        ) -> Dict[int, float]:
+    """replica -> busy fraction of the trailing window, from the
+    per-replica dispatch-interval history. The caller holds the metrics
+    lock (cost_snapshot / the exposition render — the same
+    caller-holds-lock contract as :func:`render_prometheus`)."""
+    out: Dict[int, float] = {}
+    cutoff = now - window_s
+    for replica, intervals in busy_intervals.items():
+        busy = sum(max(0.0, min(t1, now) - max(t0, cutoff))
+                   for t0, t1 in intervals)
+        out[replica] = min(1.0, busy / window_s)
+    return out
 
 
 # ------------------------------------------------ Prometheus exposition --
@@ -394,6 +521,59 @@ def render_prometheus(metrics: "ServeMetrics",
                "Failed micro-batches re-dispatched once on a different "
                "replica (supervisor retry path).")
     doc.sample("pvraft_serve_retries_total", metrics.retries_total)
+    if metrics.cost_armed:
+        # The cost-calibration plane (serve/costing.py) — present only
+        # when a cost surface is armed, so pre-surface expositions stay
+        # byte-identical.
+        doc.family("pvraft_serve_predicted_device_seconds_total", "counter",
+                   "Predicted device-seconds of every priced dispatch "
+                   "(CostSurface over artifacts/programs_costs.json).")
+        doc.sample("pvraft_serve_predicted_device_seconds_total",
+                   round(metrics.predicted_device_seconds_total, 6))
+        doc.family("pvraft_serve_device_busy_seconds_total", "counter",
+                   "Measured dispatch wall-seconds per replica (the "
+                   "device_execute window the trace plane marks).")
+        for replica, busy in sorted(metrics.busy_seconds.items()):
+            doc.sample("pvraft_serve_device_busy_seconds_total",
+                       round(busy, 6), {"replica": replica})
+        doc.family("pvraft_serve_replica_utilization", "gauge",
+                   "Busy fraction of the trailing "
+                   f"{UTILIZATION_WINDOW_S:.0f}s window per replica.")
+        now = time.monotonic()
+        for replica, util in sorted(replica_utilization(
+                metrics._busy_intervals, now).items()):
+            doc.sample("pvraft_serve_replica_utilization",
+                       round(util, 4), {"replica": replica})
+        cal = [((b, bs, dt), slot,
+                {"bucket": b, "batch": bs, "dtype": dt})
+               for (b, bs, dt), slot in sorted(
+                   metrics.cost_calibration.items())]
+        doc.family("pvraft_serve_cost_predicted_seconds_total", "counter",
+                   "Predicted device-seconds by (bucket, batch, dtype).")
+        for _, slot, labels in cal:
+            doc.sample("pvraft_serve_cost_predicted_seconds_total",
+                       round(slot["predicted_s"], 6), labels)
+        doc.family("pvraft_serve_cost_measured_seconds_total", "counter",
+                   "Measured dispatch seconds by (bucket, batch, dtype).")
+        for _, slot, labels in cal:
+            doc.sample("pvraft_serve_cost_measured_seconds_total",
+                       round(slot["measured_s"], 6), labels)
+        doc.family("pvraft_serve_cost_dispatches_total", "counter",
+                   "Priced dispatches by (bucket, batch, dtype).")
+        for _, slot, labels in cal:
+            doc.sample("pvraft_serve_cost_dispatches_total",
+                       slot["n"], labels)
+        doc.family("pvraft_serve_cost_calibration_ratio", "gauge",
+                   "measured/predicted device-seconds by (bucket, "
+                   "batch, dtype) — near 1.0 when the cost model is "
+                   "honest ON TPU; off-TPU the ratio is recorded but "
+                   "never enforceable (comparable=false on the event "
+                   "stream).")
+        for _, slot, labels in cal:
+            if slot["predicted_s"] > 0:
+                doc.sample("pvraft_serve_cost_calibration_ratio",
+                           round(slot["measured_s"] / slot["predicted_s"],
+                                 4), labels)
     doc.family("pvraft_serve_latency_ms", "histogram",
                "End-to-end request latency (enqueue to resolve), ms.")
     doc.histogram("pvraft_serve_latency_ms", metrics.latency)

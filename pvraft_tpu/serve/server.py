@@ -187,6 +187,17 @@ class _Handler(BaseHTTPRequestHandler):
                 "replicas": self.batcher.replica_stats(),
                 "in_flight": (self.metrics.current_in_flight()
                               if self.metrics is not None else None),
+                # Cost-calibration plane (ISSUE 14): predicted vs
+                # measured device-seconds per (bucket, batch, dtype),
+                # cumulative busy seconds and the rolling utilization
+                # per replica — null while no cost surface is armed
+                # (the calibration story lives here and on Prometheus;
+                # the JSON /metrics shape stays frozen).
+                "cost": (self.metrics.cost_snapshot()
+                         if self.metrics is not None else None),
+                "cost_surface": (self.batcher.costing.coverage()
+                                 if self.batcher.costing is not None
+                                 else None),
                 "programs": self.batcher.engine.compile_report(),
                 "telemetry": {
                     "events_path": self.events_path or None,
@@ -524,7 +535,8 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
                   strict_retrace: bool = False,
                   devmem_interval_s: float = 10.0,
                   supervise: bool = True,
-                  supervisor_cfg=None) -> ServeHTTPServer:
+                  supervisor_cfg=None,
+                  cost_surface=None) -> ServeHTTPServer:
     """The one canonical engine -> metrics -> batcher -> HTTP assembly,
     shared by ``python -m pvraft_tpu.serve`` and the load generator so
     the two serving surfaces cannot drift: ``max_batch`` is always the
@@ -552,12 +564,36 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
     overrides the declared thresholds
     (``programs/geometries.SUPERVISOR_DEFAULTS``). ``supervise=False``
     restores the pre-supervision pool bit-for-bit.
+
+    Cost calibration (ISSUE 14): ``cost_surface`` — a
+    :class:`~pvraft_tpu.programs.costs.CostSurface` or a path to a
+    committed ``pvraft_costs/v1`` artifact — arms the pricing plane:
+    every dispatch is priced in predicted device-seconds and measured
+    against the price (``pvraft_serve_predicted_device_seconds_total``,
+    ``pvraft_serve_device_busy_seconds_total{replica}``, the per-
+    (bucket, batch, dtype) calibration summary, ``cost_calibration``
+    events, the /healthz ``cost`` block). None (the default) leaves the
+    dispatch path with exactly one attribute check and the exposition
+    byte-identical to pre-surface builds.
     Returns an unstarted server (``.start()`` / ``.shutdown()``)."""
     from pvraft_tpu.obs.device_memory import DeviceMemoryMonitor
     from pvraft_tpu.obs.retrace import RetraceWatchdog
     from pvraft_tpu.serve.supervisor import ReplicaSupervisor
 
     metrics = ServeMetrics(engine.cfg.buckets)
+    costing = None
+    if cost_surface is not None:
+        from pvraft_tpu.programs.costs import CostSurface
+        from pvraft_tpu.serve.costing import ServeCostModel
+
+        surface = (CostSurface.load(cost_surface)
+                   if isinstance(cost_surface, str) else cost_surface)
+        costing = ServeCostModel(
+            surface, buckets=engine.cfg.buckets,
+            batch_sizes=engine.cfg.batch_sizes, dtype=engine.cfg.dtype,
+            platform=getattr(engine, "platform", "cpu"),
+            metrics=metrics, telemetry=telemetry)
+        metrics.arm_cost()
     supervisor = (ReplicaSupervisor(engine, cfg=supervisor_cfg,
                                     telemetry=telemetry)
                   if supervise else None)
@@ -582,7 +618,7 @@ def build_service(engine, *, max_wait_ms: float = 5.0,
                       max_wait_ms=max_wait_ms, queue_depth=queue_depth,
                       eager_when_idle=eager_when_idle),
         telemetry=telemetry, metrics=metrics, watchdog=watchdog,
-        supervisor=supervisor)
+        supervisor=supervisor, costing=costing)
     tracer = Tracer(
         sample_every=trace_sample_every,
         emit=telemetry.emit_span if telemetry is not None else None)

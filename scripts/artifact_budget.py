@@ -44,6 +44,14 @@ BUDGETS = (
     # (pvraft_kernel_plan/v1, regenerate-and-compare pinned by lint.sh);
     # growth here means the planner started dumping, not planning.
     ("artifacts/kernel_plan.json", 32 * 1024),
+    # The capacity plan (pvraft_capacity/v1) is the same discipline: a
+    # few demand rows + per-bucket pricing, regenerate-and-compare
+    # pinned — growth means the planner started dumping raw inputs.
+    ("artifacts/capacity_report.json", 32 * 1024),
+    # Calibration evidence (pvraft_cost_calibration/v1): per-(bucket,
+    # batch, dtype) summary rows + the identity ledger, never raw
+    # per-dispatch samples (those ride the events stream).
+    ("artifacts/serve_calibration.json", 32 * 1024),
     # Structured reports (costs inventory, SLO, loadgen, convergence).
     ("artifacts/*.json", 128 * 1024),
     ("artifacts/*.log", 64 * 1024),

@@ -14,10 +14,21 @@ on the same traffic:
     python scripts/bucket_advisor.py --load ... --n-buckets 4 \
         --out artifacts/bucket_advisor.json
 
+Objective (ISSUE 14 / ROADMAP items 3+5): proposals are scored in
+PREDICTED DEVICE-SECONDS through the committed cost surface
+(``--cost-surface``, default ``artifacts/programs_costs.json``) when
+its certified serve records cover every candidate bucket exactly — an
+8192-point request and a 2048-point request are not the same unit of
+work, and the inventory says by how much. When coverage is incomplete
+(or the surface is absent) the report falls back LOUDLY to the PR-8
+expected-device-points proxy (the ``objective.note`` names the
+uncovered buckets) — certify a proposal's geometry first, then the
+seconds objective scores it.
+
 The proposal is ADVISORY: promoting it means editing ``geometries.py``
 (the single source the engine, registry, deepcheck and AOT evidence all
 read) — this script never mutates the declared geometry, it argues with
-numbers. jax is never imported (pure host-side arithmetic).
+numbers.
 """
 
 from __future__ import annotations
@@ -31,6 +42,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pvraft_tpu.programs.geometries import (  # noqa: E402 — needs the path hack
     SERVE_DEFAULT_BUCKETS,
+    SERVE_DEFAULT_DTYPE,
 )
 from pvraft_tpu.serve.advisor import build_advisor_report  # noqa: E402
 
@@ -47,9 +59,27 @@ def main() -> int:
     ap.add_argument("--min-bucket", type=int, default=0,
                     help="smallest legal bucket (e.g. the model's "
                          "min_points floor)")
+    ap.add_argument("--cost-surface",
+                    default="artifacts/programs_costs.json",
+                    help="pvraft_costs/v1 inventory for the predicted "
+                         "device-seconds objective ('' disables: "
+                         "expected-device-points proxy)")
+    ap.add_argument("--dtype", default=SERVE_DEFAULT_DTYPE,
+                    help="serving dtype the seconds objective prices")
     ap.add_argument("--out", default="",
                     help="also write the report as JSON")
     args = ap.parse_args()
+
+    surface = None
+    if args.cost_surface:
+        from pvraft_tpu.programs.costs import CostSurface
+
+        try:
+            surface = CostSurface.load(args.cost_surface)
+        except (OSError, ValueError) as e:
+            print(f"[bucket_advisor] NOTE: cost surface unavailable "
+                  f"({e}) — falling back to the expected-device-points "
+                  f"objective", file=sys.stderr)
 
     edges, counts = None, None
     for path in args.load:
@@ -74,20 +104,26 @@ def main() -> int:
         edges, counts, SERVE_DEFAULT_BUCKETS,
         n_buckets=args.n_buckets or None,
         min_bucket=args.min_bucket,
-        source=",".join(args.load))
+        source=",".join(args.load),
+        cost_surface=surface, dtype=args.dtype)
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2)
         print(f"[bucket_advisor] wrote {args.out}")
     print(json.dumps(report, indent=2))
+    if report["objective"].get("note"):
+        print(f"[bucket_advisor] NOTE: {report['objective']['note']}",
+              file=sys.stderr)
+    unit = report["objective"]["unit"]
+    key = ("device_seconds_per_request" if unit == "device_seconds"
+           else "points_per_request")
     cur = report["current"]
     prop = report["proposed"]
-    print(f"[bucket_advisor] current {cur['buckets']} -> "
-          f"{cur['points_per_request']} device points/request "
-          f"(rejects {cur['rejected_fraction']}); proposed "
-          f"{prop['buckets']} -> {prop['points_per_request']} "
-          f"points/request")
+    print(f"[bucket_advisor] objective {unit}: current {cur['buckets']} "
+          f"-> {cur[key]} per request (rejects "
+          f"{cur['rejected_fraction']}); proposed {prop['buckets']} -> "
+          f"{prop[key]} per request")
     return 0
 
 

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Build (or regenerate-and-compare) the committed capacity plan.
+
+    # regenerate the committed artifact from the committed inputs
+    python scripts/capacity_report.py --out artifacts/capacity_report.json
+
+    # the lint.sh gate: regenerate from the artifact's OWN recorded
+    # inputs and byte-compare (the kernel_plan.json discipline)
+    python scripts/capacity_report.py --check artifacts/capacity_report.json
+
+The plan (``pvraft_capacity/v1``, ``pvraft_tpu/obs/capacity.py``) joins
+the cost surface, the committed ``pvraft_serve_request_points``
+histogram and the SLO report into per-bucket device-seconds/sec demand
+and chips-needed-at-SLO — a pure function of committed inputs (no
+timestamps, no toolchain, no compiles, no devices — pure host-side
+arithmetic; the obs package import is the only reason jax enters the
+process at all), so drift between the artifact and the code that
+claims to produce it fails the standing gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pvraft_tpu.obs.capacity import (  # noqa: E402 — needs the path hack
+    DEFAULT_QPS_LADDER,
+    DEFAULT_UTILIZATION_CEILING,
+    build_capacity_report,
+    validate_capacity,
+)
+from pvraft_tpu.programs.costs import CostSurface  # noqa: E402
+from pvraft_tpu.programs.geometries import (  # noqa: E402
+    SERVE_DEFAULT_BATCH_SIZES,
+    SERVE_DEFAULT_BUCKETS,
+    SERVE_DEFAULT_DTYPE,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build(costs_path, load_path, slo_path, dtype, qps, ceiling):
+    surface = CostSurface.load(os.path.join(REPO, costs_path))
+    with open(os.path.join(REPO, load_path), encoding="utf-8") as f:
+        load_doc = json.load(f)
+    with open(os.path.join(REPO, slo_path), encoding="utf-8") as f:
+        slo_doc = json.load(f)
+    return build_capacity_report(
+        surface, load_doc, slo_doc,
+        buckets=SERVE_DEFAULT_BUCKETS,
+        batch_sizes=SERVE_DEFAULT_BATCH_SIZES,
+        dtype=dtype, qps_ladder=qps, utilization_ceiling=ceiling,
+        inputs={"costs": costs_path, "load": load_path, "slo": slo_path})
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--costs", default="artifacts/programs_costs.json")
+    ap.add_argument("--load", default="artifacts/serve_cpu_synthetic.json",
+                    help="pvraft_serve_load/v1 artifact carrying the "
+                         "request_points traffic histogram")
+    ap.add_argument("--slo", default="artifacts/serve_cpu_synthetic.slo.json")
+    ap.add_argument("--dtype", default=SERVE_DEFAULT_DTYPE)
+    ap.add_argument("--qps", default=",".join(
+        str(q) for q in DEFAULT_QPS_LADDER),
+        help="comma-separated target-QPS ladder")
+    ap.add_argument("--ceiling", type=float,
+                    default=DEFAULT_UTILIZATION_CEILING,
+                    help="per-chip utilization ceiling the plan "
+                         "provisions against (SLO headroom)")
+    ap.add_argument("--out", default="",
+                    help="write the pvraft_capacity/v1 artifact here")
+    ap.add_argument("--check", default="", metavar="ARTIFACT",
+                    help="regenerate from the artifact's recorded "
+                         "inputs and byte-compare (lint.sh gate)")
+    args = ap.parse_args()
+    qps = tuple(float(q) for q in args.qps.split(",") if q)
+
+    if args.check:
+        with open(args.check, encoding="utf-8") as f:
+            committed = json.load(f)
+        problems = validate_capacity(committed, path=args.check)
+        inputs = committed.get("inputs") or {}
+        for key in ("costs", "load", "slo"):
+            if not isinstance(inputs.get(key), str):
+                problems.append(
+                    f"{args.check}: inputs.{key} must record the "
+                    "committed source path")
+        if problems:
+            for p in problems:
+                print(p, file=sys.stderr)
+            return 1
+        regenerated = _build(
+            inputs["costs"], inputs["load"], inputs["slo"],
+            dtype=committed.get("dtype", SERVE_DEFAULT_DTYPE),
+            qps=tuple(r["qps"] for r in committed.get("demand", ()))
+            or qps,
+            ceiling=committed.get("utilization_ceiling", args.ceiling))
+        if regenerated != committed:
+            print(f"{args.check}: committed plan differs from the one "
+                  "regenerated from its recorded inputs — regenerate "
+                  "with `python scripts/capacity_report.py --out "
+                  f"{args.check}`", file=sys.stderr)
+            want = json.dumps(regenerated, indent=2, sort_keys=True)
+            got = json.dumps(committed, indent=2, sort_keys=True)
+            for a, b in zip(want.splitlines(), got.splitlines()):
+                if a != b:
+                    print(f"  regenerated: {a}\n  committed:   {b}",
+                          file=sys.stderr)
+                    break
+            return 1
+        print(f"{args.check}: OK (schema + regenerate-and-compare)")
+        return 0
+
+    report = _build(args.costs, args.load, args.slo, dtype=args.dtype,
+                    qps=qps, ceiling=args.ceiling)
+    problems = validate_capacity(report, path=args.out or "<report>")
+    if problems:
+        for p in problems:
+            print(f"[capacity] SCHEMA PROBLEM: {p}", file=sys.stderr)
+        return 1
+    text = json.dumps(report, indent=2, sort_keys=True) + "\n"
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"[capacity] wrote {args.out}")
+    print(text)
+    for row in report["demand"]:
+        print(f"[capacity] {row['qps']:g} qps -> "
+              f"{row['device_seconds_per_sec']} device-s/s -> "
+              f"{row['chips_needed']} chip(s) at "
+              f"{report['utilization_ceiling']:.0%} ceiling")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

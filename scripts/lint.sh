@@ -125,6 +125,27 @@ else
   echo "(no committed bench artifacts)"
 fi
 
+echo "== pvraft_capacity/v1: committed capacity plan validates + regenerates"
+# The capacity planner (ISSUE 14): artifacts/capacity_report.json is a
+# pure function of committed inputs (cost surface + traffic histogram +
+# SLO report) — schema-validate it, then regenerate from the artifact's
+# OWN recorded inputs and compare (the kernel_plan.json discipline; a
+# hand-edited chips-needed number, or drift between the planner code
+# and the committed plan, fails here).
+JAX_PLATFORMS=cpu python -m pvraft_tpu.obs validate-capacity \
+  artifacts/capacity_report.json
+JAX_PLATFORMS=cpu \
+  python scripts/capacity_report.py --check artifacts/capacity_report.json
+
+echo "== pvraft_cost_calibration/v1: committed calibration evidence validates"
+# The predicted-vs-measured ledger from a real loadgen run with the
+# cost surface armed (scripts/serve_calibration.py): the identity must
+# have held at every polled snapshot, ratios must recompute, and
+# comparable=true off-TPU is a schema violation (the pvraft_bench/v1
+# platform-honesty rule, enforced structurally).
+JAX_PLATFORMS=cpu python -m pvraft_tpu.obs validate-calibration \
+  artifacts/serve_calibration.json
+
 echo "== artifact size budget (per-glob byte caps over committed evidence)"
 python scripts/artifact_budget.py
 
@@ -143,10 +164,12 @@ fi
 echo "== pvraft_serve_load/v1: committed load-gen artifacts validate"
 # The serve latency/throughput evidence (scripts/serve_loadgen.py) must
 # parse against its schema, same discipline as the event logs. The
-# trace/SLO siblings (*.trace.json / *.slo.json) have their own
-# validators in the next stage — exclude them here.
+# trace/SLO siblings (*.trace.json / *.slo.json) and the calibration
+# evidence (pvraft_cost_calibration/v1) have their own validators in
+# other stages — exclude them here.
 serve_artifacts=$(ls artifacts/serve_*.json 2>/dev/null \
-  | grep -v -e '\.trace\.json$' -e '\.slo\.json$' || true)
+  | grep -v -e '\.trace\.json$' -e '\.slo\.json$' \
+            -e 'serve_calibration\.json$' || true)
 if [ -n "$serve_artifacts" ]; then
   # shellcheck disable=SC2086 -- word splitting over the file list is intended
   python -m pvraft_tpu.serve validate-load $serve_artifacts

@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Calibration evidence run: price + measure a REAL loadgen pass.
+
+Stands up the full service (AOT engine -> replica pool -> continuous
+micro-batcher -> HTTP) with the COST SURFACE ARMED, drives it with
+concurrent clients over real HTTP while a poller reads atomic
+Prometheus renders and checks the ``requests == responses + Σrejected
++ in_flight`` identity at every snapshot, then commits the evidence:
+
+    artifacts/serve_calibration.json          pvraft_cost_calibration/v1
+    artifacts/serve_calibration.events.jsonl  pvraft_events/v1 (serve,
+                                              incl. cost_calibration)
+
+The generator REFUSES to write unless the run actually proved what the
+artifact claims: at least one calibration record per exercised
+(bucket, batch, dtype), zero identity violations, and — off TPU —
+every record ``comparable: false`` (the platform-honesty rule;
+CPU-synthetic tier measures the MACHINERY, not the model's accuracy).
+Both files are validated by ``scripts/lint.sh``.
+
+    python scripts/serve_calibration.py --out artifacts/serve_calibration.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pvraft_tpu import parse_int_list as _parse_ints  # noqa: E402 — needs the path hack
+
+_IDENTITY_COUNTERS = ("pvraft_serve_requests_total",
+                      "pvraft_serve_responses_total",
+                      "pvraft_serve_in_flight")
+
+
+def _prom_counters(text: str) -> dict:
+    out = {}
+    for name in _IDENTITY_COUNTERS:
+        m = re.search(rf"^{name} (\S+)$", text, re.M)
+        out[name] = float(m.group(1)) if m else 0.0
+    out["rejected"] = sum(
+        float(v) for v in re.findall(
+            r'^pvraft_serve_rejected_total\{[^}]*\} (\S+)$', text, re.M))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", default="artifacts/serve_calibration.json")
+    ap.add_argument("--events", default="",
+                    help="events path (default: <out stem>.events.jsonl)")
+    ap.add_argument("--surface", default="artifacts/programs_costs.json")
+    ap.add_argument("--buckets", default="128,256")
+    ap.add_argument("--batch_sizes", default="1,4")
+    ap.add_argument("--truncate_k", type=int, default=32)
+    ap.add_argument("--graph_k", type=int, default=8)
+    ap.add_argument("--corr_knn", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--dtype", default="float32",
+                    choices=("float32", "bfloat16"))
+    ap.add_argument("--device_count", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from pvraft_tpu.serve.loadgen import force_host_device_count
+
+    force_host_device_count(args.device_count)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models.raft import PVRaft
+    from pvraft_tpu.obs.calibration import (
+        CALIBRATION_SCHEMA,
+        validate_calibration,
+    )
+    from pvraft_tpu.serve import (
+        InferenceEngine,
+        ServeConfig,
+        ServeTelemetry,
+        build_service,
+    )
+    from pvraft_tpu.serve.loadgen import run_load
+
+    model = ModelConfig(truncate_k=args.truncate_k, graph_k=args.graph_k,
+                        corr_knn=args.corr_knn)
+    cfg = ServeConfig(model=model, buckets=_parse_ints(args.buckets),
+                      batch_sizes=_parse_ints(args.batch_sizes),
+                      num_iters=args.iters, dtype=args.dtype)
+    events_path = args.events or (
+        os.path.splitext(args.out)[0] + ".events.jsonl")
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    # The run streams its events to a temp sibling and promotes it
+    # ONLY on the success path below — a refused run must leave the
+    # committed {json, events} pair untouched and consistent, never a
+    # stale json beside a failed run's fresh events.
+    events_tmp = events_path + ".tmp"
+    if os.path.exists(events_tmp):
+        os.unlink(events_tmp)
+    telemetry = ServeTelemetry(events_tmp, cfg=cfg)
+
+    m = PVRaft(model)
+    rng = np.random.default_rng(args.seed)
+    pc = jax.numpy.asarray(
+        rng.uniform(-1, 1, (1, cfg.buckets[0], 3)).astype(np.float32))
+    params = m.init(jax.random.key(args.seed), pc, pc, 2)
+    engine = InferenceEngine(params, cfg, telemetry=telemetry)
+
+    server = build_service(engine, max_wait_ms=5.0, queue_depth=64,
+                           telemetry=telemetry, trace_sample_every=0,
+                           cost_surface=args.surface)
+    server.start()
+    print(f"[calibration] serving on port {server.port} "
+          f"({len(engine.replicas)} replicas, dtype {cfg.dtype}, "
+          f"surface {args.surface} ARMED)", flush=True)
+
+    # Identity poller: every snapshot is ONE atomic Prometheus render
+    # (the handler holds the metrics lock for the whole exposition).
+    # Transient HTTP hiccups (a connection reset under a loaded box)
+    # are retried, never fatal — the poller must survive the WHOLE run
+    # or the artifact's "identity held throughout" claim would quietly
+    # cover only its first seconds (poll_errors is recorded so a noisy
+    # run is visible in the evidence).
+    snapshots = []
+    violations = []
+    poll_errors = [0]
+    stop = threading.Event()
+
+    def poll():
+        import http.client
+
+        while not stop.is_set():
+            try:
+                conn = http.client.HTTPConnection(
+                    server.host, server.port, timeout=10)
+                try:
+                    conn.request("GET", "/metrics?format=prometheus")
+                    c = _prom_counters(conn.getresponse().read().decode())
+                finally:
+                    conn.close()
+            except Exception:  # noqa: BLE001 — poller must outlive hiccups
+                poll_errors[0] += 1
+                time.sleep(0.01)
+                continue
+            snapshots.append(c)
+            if c["pvraft_serve_requests_total"] != (
+                    c["pvraft_serve_responses_total"] + c["rejected"]
+                    + c["pvraft_serve_in_flight"]):
+                violations.append(c)
+            time.sleep(0.01)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+
+    counts = []
+    lo = engine.cfg.min_points
+    prev = 0
+    for b in cfg.buckets:
+        span = b - prev
+        counts.append(max(lo, prev + int(0.75 * span)))
+        counts.append(max(lo, prev + int(0.95 * span)))
+        prev = b
+    measurement = run_load(server, n_requests=args.requests,
+                           concurrency=args.concurrency,
+                           point_counts=counts, seed=args.seed)
+    # A final post-drain snapshot so the ledger provably closes at 0
+    # in-flight.
+    time.sleep(0.05)
+    stop.set()
+    poller.join(5)
+    poller_died_early = poller.is_alive()
+
+    import http.client
+
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=10)
+    conn.request("GET", "/healthz")
+    health = json.loads(conn.getresponse().read())
+    conn.close()
+    platform = engine.platform
+    server.shutdown(drain=True)
+    telemetry.close()
+
+    cost = health.get("cost") or {}
+    artifact = {
+        "schema": CALIBRATION_SCHEMA,
+        "surface": args.surface,
+        "surface_coverage": health.get("cost_surface"),
+        "platform": platform,
+        "dtype": cfg.dtype,
+        "config": {
+            "buckets": list(cfg.buckets),
+            "batch_sizes": list(cfg.batch_sizes),
+            "num_iters": cfg.num_iters,
+            "truncate_k": model.truncate_k,
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "replicas": len(engine.replicas),
+            "weights": "random_init",
+        },
+        "identity": {
+            "snapshots": len(snapshots),
+            "violations": len(violations),
+            "poll_errors": poll_errors[0],
+        },
+        "requests": measurement["requests"],
+        "throughput_rps": measurement["throughput_rps"],
+        "records": cost.get("calibration", []),
+        "device_busy_seconds": cost.get("device_busy_seconds"),
+        "predicted_device_seconds_total": cost.get(
+            "predicted_device_seconds_total"),
+    }
+
+    # The generator refuses to commit evidence that proves nothing.
+    fatal = []
+    if not artifact["records"]:
+        fatal.append("no calibration records — the surface never priced "
+                     "a dispatch")
+    if violations:
+        fatal.append(f"identity violated at {len(violations)} of "
+                     f"{len(snapshots)} snapshots: {violations[:3]}")
+    if measurement["requests"]["ok"] != args.requests:
+        fatal.append(f"only {measurement['requests']['ok']}/"
+                     f"{args.requests} requests succeeded")
+    if poller_died_early:
+        fatal.append("identity poller wedged mid-run — the snapshot "
+                     "ledger does not cover the whole run")
+    if len(snapshots) < 10:
+        fatal.append(f"only {len(snapshots)} identity snapshots — the "
+                     "poller did not cover the run")
+    fatal.extend(validate_calibration(artifact, path=args.out))
+    if fatal:
+        for p in fatal:
+            print(f"[calibration] REFUSING TO WRITE: {p}",
+                  file=sys.stderr)
+        print(f"[calibration] failed run's events left at {events_tmp} "
+              "for inspection; committed artifacts untouched",
+              file=sys.stderr)
+        return 1
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(events_tmp, events_path)
+    print(f"[calibration] wrote {args.out} and {events_path}")
+    print(json.dumps({
+        "platform": platform,
+        "snapshots": len(snapshots),
+        "violations": len(violations),
+        "records": [
+            {k: r[k] for k in ("bucket", "batch", "n", "ratio",
+                               "comparable")}
+            for r in artifact["records"]],
+    }, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,440 @@
+"""Cost-calibration plane (ISSUE 14): CostSurface lookups, the serve
+pricing model's zero-residue contract, the cost_calibration event
+schema, the capacity planner (pvraft_capacity/v1) and the calibration
+evidence validator (pvraft_cost_calibration/v1) — red/green for every
+validator, determinism for the committed plan, and the platform-honesty
+rule (comparable=true off-TPU is unrepresentable) at every layer."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from pvraft_tpu.obs.calibration import (
+    CALIBRATION_SCHEMA,
+    validate_calibration,
+    validate_calibration_file,
+)
+from pvraft_tpu.obs.capacity import (
+    CAPACITY_SCHEMA,
+    build_capacity_report,
+    chips_needed,
+    validate_capacity,
+    validate_capacity_file,
+)
+from pvraft_tpu.obs.events import validate_event
+from pvraft_tpu.obs.loading import load_json_artifact
+from pvraft_tpu.programs.costs import (
+    CostSurface,
+    hardware_utilization,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rec(name, flops=1e9, bytes_=2e9, opt=None, target="v5e:2x2x1"):
+    rec = {"name": name, "target": target, "tags": [], "ok": True,
+           "flops": flops, "bytes_accessed": bytes_,
+           "memory": {"live_bytes_estimate": 1024,
+                      "fits_16GiB_hbm": True}}
+    if opt is not None:
+        rec["optimal_seconds"] = opt
+    return rec
+
+
+def _surface(records):
+    return CostSurface({"schema": "pvraft_costs/v1",
+                        "programs": records})
+
+
+SERVE_RECORDS = [
+    _rec("serve_predict_bf16_pallas_b2048_bs1", flops=4e9, opt=0.01),
+    _rec("serve_predict_bf16_pallas_b8192_bs4", flops=6.4e10, opt=0.08),
+    _rec("serve_predict_fp32_b2048_bs1", flops=4e9, opt=0.02),
+    _rec("flagship_train_step_fp32_remat", flops=2.7e11, bytes_=2.9e11,
+         opt=-100.0),  # XLA's nonsense negative optimal (real artifact)
+    _rec("engine.train_step", flops=1e9, target="host"),
+]
+
+
+# ------------------------------------------------------------ CostSurface --
+
+
+def test_surface_lookup_and_basis():
+    s = _surface(SERVE_RECORDS)
+    est = s.lookup("serve_predict_bf16_pallas_b2048_bs1")
+    assert est.device_seconds == 0.01 and est.basis == "xla_optimal"
+    assert est.comparable is True
+    # Negative optimal_seconds never propagates: roofline fallback.
+    train = s.lookup("flagship_train_step_fp32_remat")
+    assert train.basis == "roofline" and train.device_seconds > 0
+    # Host-target records predict but are never comparable.
+    host = s.lookup("engine.train_step")
+    assert host.comparable is False
+    assert s.lookup("nonexistent") is None
+
+
+def test_surface_serve_lookup_exact_and_extrapolated():
+    s = _surface(SERVE_RECORDS)
+    exact = s.lookup_serve(2048, 1, "bfloat16")
+    assert exact.name == "serve_predict_bf16_pallas_b2048_bs1"
+    assert exact.extrapolated is False and exact.scale == 1.0
+    assert s.lookup_serve(4096, 4, "bfloat16") is None
+    est = s.estimate_serve(4096, 4, "bfloat16")
+    assert est.extrapolated is True
+    assert est.reference == "serve_predict_bf16_pallas_b8192_bs4"
+    assert est.scale == pytest.approx(0.5)
+    assert est.device_seconds == pytest.approx(0.04)
+    # dtype routing: fp32 variant resolves separately.
+    assert s.lookup_serve(2048, 1, "float32").name == \
+        "serve_predict_fp32_b2048_bs1"
+    assert s.estimate_serve(2048, 1, "bfloat16").device_seconds == 0.01
+
+
+def test_surface_seconds_per_request_exact_coverage_only():
+    s = _surface(SERVE_RECORDS)
+    assert s.serve_seconds_per_request(8192, "bfloat16") == \
+        pytest.approx(0.02)   # 0.08 / bs 4
+    assert s.serve_seconds_per_request(4096, "bfloat16") is None
+
+
+def test_surface_train_step_and_utilization():
+    s = _surface(SERVE_RECORDS)
+    assert s.lookup_train_step("float32").name == \
+        "flagship_train_step_fp32_remat"
+    assert s.lookup_train_step("bfloat16") is None
+    util = hardware_utilization(1e12, 0.1, "bfloat16")
+    assert util == pytest.approx(1e12 / (0.1 * 197e12))
+    assert hardware_utilization(0.0, 0.1, "bfloat16") is None
+
+
+def test_surface_rejects_wrong_schema_and_loads_committed():
+    with pytest.raises(ValueError):
+        CostSurface({"schema": "nope"})
+    s = CostSurface.load()          # the committed inventory
+    assert len(s) > 40
+    assert s.serve_coverage("bfloat16") == [(2048, 1), (8192, 4)]
+    assert s.lookup_train_step("bfloat16") is not None
+
+
+# ----------------------------------------------------- cost_calibration --
+
+
+def _cal_event(**over):
+    rec = {"schema": "pvraft_events/v1", "type": "cost_calibration",
+           "time": 1.0, "seq": 0, "bucket": 2048, "batch": 1,
+           "dtype": "bfloat16", "predicted_s": 0.01, "measured_s": 0.02,
+           "platform": "cpu", "comparable": False}
+    rec.update(over)
+    return rec
+
+
+def test_cost_calibration_event_green_and_red():
+    assert validate_event(_cal_event()) == []
+    assert validate_event(_cal_event(platform="tpu", comparable=True,
+                                     basis="roofline",
+                                     extrapolated=True, replica=1)) == []
+    # The platform-honesty rule: comparable=true off-TPU is invalid.
+    assert validate_event(_cal_event(comparable=True))
+    assert validate_event(_cal_event(comparable="yes"))
+    assert validate_event(_cal_event(predicted_s=-1.0))
+    assert validate_event(_cal_event(basis="guess"))
+    assert validate_event(_cal_event(dtype=""))
+    bad = _cal_event()
+    del bad["platform"]
+    assert validate_event(bad)
+
+
+# ------------------------------------------------------- capacity plan --
+
+
+def _load_doc():
+    return {"schema": "pvraft_serve_load/v1",
+            "config": {"platform": "cpu"},
+            "request_points": {
+                "edges": [1024, 2048, 8192],
+                "counts": [50, 100, 50, 0]}}
+
+
+def _slo_doc():
+    return {"schema": "pvraft_slo/v1", "slo": {"p99_ms": 2000.0},
+            "max_qps_under_slo": 30.0}
+
+
+def test_capacity_build_validates_and_is_deterministic():
+    s = _surface(SERVE_RECORDS)
+    kwargs = dict(buckets=(2048, 8192), batch_sizes=(1, 4),
+                  dtype="bfloat16", qps_ladder=(10.0, 100.0),
+                  inputs={"costs": "c", "load": "l", "slo": "s"})
+    a = build_capacity_report(s, _load_doc(), _slo_doc(), **kwargs)
+    b = build_capacity_report(s, _load_doc(), _slo_doc(), **kwargs)
+    assert a == b                      # pure function of inputs
+    assert validate_capacity(a) == []
+    assert a["measured_evidence"]["comparable"] is False
+    # Mix: 150 requests land in bucket 2048 (0.01 s/request), 50 in
+    # 8192 (0.08 at bs 4 -> 0.02 s/request).
+    by_bucket = {r["bucket"]: r for r in a["per_bucket"]}
+    assert by_bucket[2048]["requests"] == 150
+    assert by_bucket[8192]["requests"] == 50
+    assert by_bucket[8192]["seconds_per_request"] == pytest.approx(0.02)
+    demand = {r["qps"]: r for r in a["demand"]}
+    mean = a["traffic"]["mean_device_seconds_per_request"]
+    assert demand[100.0]["device_seconds_per_sec"] == \
+        pytest.approx(100.0 * mean, rel=1e-5)
+    assert demand[100.0]["chips_needed"] == chips_needed(
+        demand[100.0]["device_seconds_per_sec"], 0.7)
+
+
+def test_capacity_validator_red():
+    s = _surface(SERVE_RECORDS)
+    good = build_capacity_report(
+        s, _load_doc(), _slo_doc(), buckets=(2048, 8192),
+        batch_sizes=(1, 4), dtype="bfloat16")
+    assert validate_capacity(good) == []
+    # Hand-edited chips-needed contradicting its own demand row.
+    bad = copy.deepcopy(good)
+    bad["demand"][0]["chips_needed"] += 5
+    assert any("chips_needed" in p for p in validate_capacity(bad))
+    # comparable=true on non-TPU evidence.
+    bad = copy.deepcopy(good)
+    bad["measured_evidence"]["comparable"] = True
+    assert any("comparable" in p for p in validate_capacity(bad))
+    # Traffic fractions exceeding 1.
+    bad = copy.deepcopy(good)
+    bad["per_bucket"][0]["traffic_fraction"] = 0.9
+    bad["per_bucket"][-1]["traffic_fraction"] = 0.9
+    assert any("fractions" in p for p in validate_capacity(bad))
+    assert validate_capacity([]) and validate_capacity({"schema": "x"})
+
+
+def test_committed_capacity_artifact_checks():
+    """The committed plan validates AND regenerates byte-identically
+    from its recorded inputs (the lint.sh stage, in test form)."""
+    path = os.path.join(REPO, "artifacts", "capacity_report.json")
+    assert validate_capacity_file(path) == []
+    committed, problems = load_json_artifact(path)
+    assert problems == []
+    surface = CostSurface.load()
+    inputs = committed["inputs"]
+    load_doc, _ = load_json_artifact(os.path.join(REPO, inputs["load"]))
+    slo_doc, _ = load_json_artifact(os.path.join(REPO, inputs["slo"]))
+    from pvraft_tpu.programs import geometries as g
+
+    regenerated = build_capacity_report(
+        surface, load_doc, slo_doc,
+        buckets=g.SERVE_DEFAULT_BUCKETS,
+        batch_sizes=g.SERVE_DEFAULT_BATCH_SIZES,
+        dtype=committed["dtype"],
+        qps_ladder=tuple(r["qps"] for r in committed["demand"]),
+        utilization_ceiling=committed["utilization_ceiling"],
+        inputs=inputs)
+    assert regenerated == committed
+
+
+# -------------------------------------------------- calibration evidence --
+
+
+def _cal_doc(**over):
+    doc = {
+        "schema": CALIBRATION_SCHEMA,
+        "surface": "artifacts/programs_costs.json",
+        "platform": "cpu",
+        "dtype": "float32",
+        "config": {},
+        "identity": {"snapshots": 40, "violations": 0},
+        "records": [{"bucket": 128, "batch": 1, "dtype": "float32",
+                     "n": 30, "predicted_s": 0.01, "measured_s": 0.02,
+                     "ratio": 2.0, "comparable": False}],
+    }
+    doc.update(over)
+    return doc
+
+
+def test_calibration_validator_green_and_red():
+    assert validate_calibration(_cal_doc()) == []
+    # The identity must have held at every polled snapshot.
+    assert any("violations" in p for p in validate_calibration(
+        _cal_doc(identity={"snapshots": 40, "violations": 1})))
+    assert any("snapshots" in p for p in validate_calibration(
+        _cal_doc(identity={"snapshots": 0, "violations": 0})))
+    # A forged ratio is recomputed, not trusted.
+    forged = _cal_doc()
+    forged["records"][0]["ratio"] = 0.5
+    assert any("ratio" in p for p in validate_calibration(forged))
+    # comparable=true off-TPU is unrepresentable.
+    dishonest = _cal_doc()
+    dishonest["records"][0]["comparable"] = True
+    assert any("comparable" in p for p in validate_calibration(dishonest))
+    assert validate_calibration(_cal_doc(records=[]))
+    assert validate_calibration({"schema": "x"})
+
+
+def test_committed_calibration_artifact():
+    """The committed evidence run validates, held the identity at every
+    snapshot, and (being CPU-tier) claims nothing enforceable."""
+    path = os.path.join(REPO, "artifacts", "serve_calibration.json")
+    assert validate_calibration_file(path) == []
+    doc, _ = load_json_artifact(path)
+    assert doc["identity"]["violations"] == 0
+    assert doc["identity"]["snapshots"] > 0
+    assert doc["records"]
+    assert all(r["comparable"] is False for r in doc["records"])
+    # The sibling event stream carries the per-dispatch ledger.
+    from pvraft_tpu.obs.events import validate_events_file
+
+    events = os.path.join(REPO, "artifacts",
+                          "serve_calibration.events.jsonl")
+    assert validate_events_file(events) == []
+    recs = [json.loads(line) for line in open(events, encoding="utf-8")]
+    cal = [r for r in recs if r["type"] == "cost_calibration"]
+    assert cal and all(r["comparable"] is False for r in cal)
+    assert sum(1 for r in cal) == sum(r["n"] for r in doc["records"])
+
+
+# ----------------------------------------------- serve residue + advisor --
+
+
+def test_surface_disabled_service_has_zero_residue(tmp_path):
+    """build_service without a cost surface: costing is None on the
+    batcher (one attribute check per dispatch), the metrics store stays
+    disarmed, /healthz reports cost: null, and the exposition carries
+    no cost family."""
+    from types import SimpleNamespace
+
+    import numpy as np
+
+    from pvraft_tpu.serve import build_service
+    from pvraft_tpu.serve.engine import RequestError
+
+    class _Replica:
+        def __init__(self, i):
+            self.index = i
+            self.device_id = i
+
+        def predict_batch(self, requests, bucket):
+            return [np.zeros((p1.shape[0], 3), np.float32)
+                    for p1, _ in requests]
+
+    class _Engine:
+        def __init__(self):
+            self.cfg = SimpleNamespace(
+                buckets=(32,), batch_sizes=(1, 2), min_points=4,
+                coord_limit=100.0, dtype="float32")
+            self.replicas = [_Replica(0)]
+
+        def validate_request(self, pc1, pc2):
+            if max(pc1.shape[0], pc2.shape[0]) > 32:
+                raise RequestError("too_large", "too large")
+            return 32
+
+        def batch_size_for(self, n):
+            return 1 if n <= 1 else 2
+
+        def compile_report(self):
+            return []
+
+    server = build_service(_Engine(), trace_sample_every=0)
+    server.start()
+    try:
+        assert server.batcher.costing is None
+        metrics = server.batcher.metrics
+        assert metrics.cost_armed is False
+        assert metrics.cost_snapshot() is None
+        assert "pvraft_serve_predicted_device_seconds_total" \
+            not in metrics.prometheus()
+    finally:
+        server.shutdown(drain=True)
+
+
+def test_replica_utilization_covers_full_window():
+    """The rolling utilization divides by the full window, so the
+    interval history must always SPAN the window: a replica busy for
+    the whole trailing window reads ~1.0 no matter how many small
+    dispatches filled it (age-pruned history, not a fixed-size deque
+    that could silently cover less than the window)."""
+    from pvraft_tpu.serve.metrics import (
+        UTILIZATION_WINDOW_S,
+        ServeMetrics,
+    )
+
+    m = ServeMetrics(buckets=(32,))
+    m.arm_cost()
+    now = 1000.0
+    n = 400
+    step = UTILIZATION_WINDOW_S / n
+    for i in range(n):   # n back-to-back dispatches tile the window
+        t0 = now - UTILIZATION_WINDOW_S + i * step
+        m.record_cost(bucket=32, batch=1, dtype="float32", replica=0,
+                      predicted_s=0.01, measured_s=step, t_start=t0,
+                      t_end=t0 + step, comparable=False,
+                      extrapolated=False)
+    snap = m.cost_snapshot(now=now)
+    assert snap["utilization"]["0"] == pytest.approx(1.0, abs=0.02)
+    # A full window later the same history reads idle.
+    assert m.cost_snapshot(
+        now=now + 2 * UTILIZATION_WINDOW_S)["utilization"]["0"] == 0.0
+
+
+def test_advisor_device_seconds_objective_and_fallback():
+    from pvraft_tpu.serve.advisor import build_advisor_report
+
+    s = _surface(SERVE_RECORDS)
+    edges = [2048.0, 8192.0]
+    counts = [100, 50, 0]
+    # Full exact coverage -> seconds objective.
+    rep = build_advisor_report(edges, counts, (2048, 8192),
+                               cost_surface=s, dtype="bfloat16")
+    assert rep["objective"]["unit"] == "device_seconds"
+    assert "device_seconds_per_request" in rep["proposed"]
+    assert "device_seconds_per_request" in rep["current"]
+    assert rep["improvement"]["population"] == \
+        "traffic served by the current table"
+    # Seconds objective actually changes the verdict points can't see:
+    # per-request seconds at 8192 (0.02) is 2x 2048's (0.01), while
+    # points says 4x — the DP trades them differently under tight k.
+    assert rep["current"]["device_seconds_per_request"] == \
+        pytest.approx((100 * 0.01 + 50 * 0.02) / 150, abs=1e-6)
+    # Any uncovered candidate -> loud fallback to points.
+    rep2 = build_advisor_report([1024.0, 8192.0], counts, (2048, 8192),
+                                cost_surface=s, dtype="bfloat16")
+    assert rep2["objective"]["unit"] == "device_points"
+    assert "1024" in rep2["objective"]["note"]
+    assert "points_per_request" in rep2["proposed"]
+    # No surface at all -> points, no note.
+    rep3 = build_advisor_report(edges, counts, (2048, 8192))
+    assert rep3["objective"] == {"unit": "device_points"}
+
+
+def test_shared_artifact_loader_contracts(tmp_path):
+    good = tmp_path / "good.json"
+    good.write_text('{"a": 1}\n')
+    assert load_json_artifact(str(good)) == ({"a": 1}, [])
+    doc, problems = load_json_artifact(str(tmp_path / "missing.json"))
+    assert doc is None and "unreadable" in problems[0]
+    pretty = tmp_path / "pretty.json"
+    pretty.write_text('{\n  "a": 1\n}\n')
+    assert load_json_artifact(str(pretty)) == ({"a": 1}, [])  # whole-file
+    doc, problems = load_json_artifact(str(pretty), one_line=True)
+    assert doc is None and "exactly one JSON line" in problems[0]
+    two = tmp_path / "two.json"
+    two.write_text('{"a": 1}\n{"b": 2}\n')
+    doc, problems = load_json_artifact(str(two), one_line=True)
+    assert doc is None and "got 2" in problems[0]
+    # bench.load_bench_file rides THIS loader (the dedupe satellite).
+    from pvraft_tpu.obs.bench import load_bench_file
+
+    assert load_bench_file(str(two))[0] is None
+
+
+def test_obs_cli_validates_capacity_and_calibration(tmp_path, capsys):
+    from pvraft_tpu.obs.__main__ import main
+
+    cap = os.path.join(REPO, "artifacts", "capacity_report.json")
+    cal = os.path.join(REPO, "artifacts", "serve_calibration.json")
+    assert main(["validate-capacity", cap]) == 0
+    assert main(["validate-calibration", cal]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert main(["validate-capacity", str(bad)]) == 1
+    assert main(["validate-calibration", str(bad)]) == 1

@@ -376,17 +376,31 @@ def test_queue_full_503_carries_retry_after(tmp_path):
                 FaultRule("replica_latency_ms", nth=1, every=1,
                           value=400.0)])):
             # Saturate: 2 slow executors + batch queue + 1-deep bucket
-            # queue; later submits shed.
+            # queue; later submits shed. Barrier-start the clients so
+            # the burst arrives together even on a loaded CPU, and
+            # drive MORE requests than the pipeline can absorb even at
+            # max grouping (2 exec groups + 2 batch-queue groups + 1
+            # collector-held group, 2 requests each, + the 1-deep
+            # queue = 11): with 16 in one burst at least 5 must shed
+            # regardless of scheduler interleaving (the 8-client
+            # version flaked under a loaded full-suite run).
             results = []
+            burst = 16
+            barrier = threading.Barrier(burst)
+            lock = threading.Lock()
 
             def client(seed):
                 pc = _pc(20, seed)
-                results.append(_http(
-                    "POST", server.host, server.port, "/predict",
-                    json.dumps({"pc1": pc.tolist(), "pc2": pc.tolist()})))
+                payload = json.dumps({"pc1": pc.tolist(),
+                                      "pc2": pc.tolist()})
+                barrier.wait(10)
+                r = _http("POST", server.host, server.port, "/predict",
+                          payload)
+                with lock:
+                    results.append(r)
 
             threads = [threading.Thread(target=client, args=(s,))
-                       for s in range(8)]
+                       for s in range(burst)]
             for t in threads:
                 t.start()
             for t in threads:

@@ -290,16 +290,50 @@ def test_eager_dispatch_vs_baseline_straggler_wait():
         assert bound(ms), (eager, ms)
 
 
+def _identity_holds(text):
+    """requests == responses + Σrejected + in_flight, all parsed from
+    ONE atomic exposition render."""
+    import re
+
+    def one(name):
+        m = re.search(rf"^{name} (\S+)$", text, re.M)
+        return float(m.group(1)) if m else 0.0
+
+    rejected = sum(float(v) for v in re.findall(
+        r'^pvraft_serve_rejected_total\{[^}]*\} (\S+)$', text, re.M))
+    return one("pvraft_serve_requests_total") == (
+        one("pvraft_serve_responses_total") + rejected
+        + one("pvraft_serve_in_flight"))
+
+
 def test_live_in_flight_reconciliation_and_prometheus():
     """While a request is mid-execute the /metrics identity holds with
     the live gauge: requests_total == responses_total + rejected +
-    in_flight — and Prometheus exposes the per-replica decomposition."""
+    in_flight — and Prometheus exposes the per-replica decomposition.
+    The cost plane is ARMED (ISSUE 14): the identity must hold on a
+    render that ALSO carries the predicted/busy/utilization series."""
+    from pvraft_tpu.programs.costs import CostSurface
+    from pvraft_tpu.serve.costing import ServeCostModel
+
     engine = _PoolFakeEngine(n_replicas=2)
     engine.gates[32].clear()
     metrics = ServeMetrics(engine.cfg.buckets)
+    surface = CostSurface({
+        "schema": "pvraft_costs/v1",
+        "programs": [
+            {"name": f"serve_predict_fp32_b{b}_bs{bs}",
+             "target": "v5e:2x2x1", "ok": True, "flops": 1e9 * b * bs,
+             "bytes_accessed": 1e9, "optimal_seconds": 1e-4 * b * bs,
+             "memory": {"live_bytes_estimate": 1}}
+            for b in (32, 64) for bs in (1, 2)]})
+    costing = ServeCostModel(surface, buckets=engine.cfg.buckets,
+                             batch_sizes=engine.cfg.batch_sizes,
+                             dtype="float32", platform="cpu",
+                             metrics=metrics)
+    metrics.arm_cost()
     batcher = MicroBatcher(
         engine, BatcherConfig(max_batch=1, max_wait_ms=0, queue_depth=8),
-        metrics=metrics)
+        metrics=metrics, costing=costing)
     h = batcher.submit(_pc(20), _pc(20))
     assert _poll(lambda: any(r.started[32].is_set()
                              for r in engine.replicas))
@@ -315,6 +349,8 @@ def test_live_in_flight_reconciliation_and_prometheus():
     assert "pvraft_serve_replica_in_flight" in text
     assert "pvraft_serve_replica_batches_total" in text
     assert "pvraft_serve_batch_queue_depth" in text
+    assert "pvraft_serve_predicted_device_seconds_total" in text
+    assert _identity_holds(text)
     stats = batcher.replica_stats()
     assert sum(s["in_flight"] for s in stats) == 1
     engine.gates[32].set()
@@ -323,6 +359,18 @@ def test_live_in_flight_reconciliation_and_prometheus():
     assert metrics.in_flight == 0
     text = metrics.prometheus(replica_stats=batcher.replica_stats())
     assert "pvraft_serve_in_flight 0" in text
+    # Quiescent render: the priced dispatch landed on every cost
+    # series, and the identity still holds on the same render.
+    assert "pvraft_serve_device_busy_seconds_total{replica=" in text
+    assert "pvraft_serve_replica_utilization{replica=" in text
+    assert ('pvraft_serve_cost_calibration_ratio{batch="1",bucket="32",'
+            'dtype="float32"}') in text
+    assert _identity_holds(text)
+    # The /healthz cost block tells the same story.
+    cost = metrics.cost_snapshot()
+    assert cost["calibration"][0]["n"] == 1
+    assert cost["calibration"][0]["comparable"] is False  # CPU platform
+    assert cost["predicted_device_seconds_total"] > 0
 
 
 def test_outcome_recorded_exactly_once_under_timeout_race():

@@ -380,3 +380,24 @@ def test_json_metrics_snapshot_byte_compatible():
         '{"32": 1, "64": 1}, "queue_depth": {"32": 0, "64": 1}, '
         '"rejected": {"queue_full": 1}, "requests_total": 3, '
         '"responses_total": 2}')
+
+
+def test_json_metrics_byte_frozen_with_cost_surface_armed():
+    """ISSUE-14 pin: ARMING the cost surface (and recording priced
+    dispatches) must not move a single byte of the frozen JSON
+    /metrics — every cost series is Prometheus/healthz-only."""
+    baseline = _metrics_with_data()
+    armed = _metrics_with_data()
+    armed.arm_cost()
+    armed.record_cost(bucket=32, batch=1, dtype="float32", replica=0,
+                      predicted_s=0.01, measured_s=0.02, t_start=0.0,
+                      t_end=0.02, comparable=False, extrapolated=False)
+    assert json.dumps(armed.snapshot({32: 0, 64: 1}), sort_keys=True) \
+        == json.dumps(baseline.snapshot({32: 0, 64: 1}), sort_keys=True)
+    # And the DISARMED exposition is byte-identical to a pre-surface
+    # store: the cost families appear only once armed.
+    assert baseline.prometheus() == _metrics_with_data().prometheus()
+    assert "pvraft_serve_predicted_device_seconds_total" \
+        not in baseline.prometheus()
+    assert "pvraft_serve_predicted_device_seconds_total" \
+        in armed.prometheus()
