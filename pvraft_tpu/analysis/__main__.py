@@ -1,5 +1,5 @@
 """CLI: ``python -m pvraft_tpu.analysis
-{lint,trace,deepcheck,concurrency,kernels}``.
+{lint,trace,deepcheck,concurrency,kernels,sharding}``.
 
 ``lint`` is pure stdlib-AST and never initializes a jax backend
 (``--stats`` prints the suppression-debt report instead of findings).
@@ -19,6 +19,13 @@ interpreter escape hatch — over the Pallas plane (``ops/pallas/``);
 ``--plan`` joins the static models with the committed cost inventory
 into the ``pvraft_kernel_plan/v1`` artifact (fused-GRU VMEM residency,
 roofline verdicts, static-vs-Mosaic cross-validation).
+``sharding`` (shardcheck) runs the GS001+ rules — partition-rule
+coverage, mesh-axis discipline, host-materialized sharded batches,
+unguarded process-0 I/O, batch-contract confusion — over the
+multi-process planes (engine/obs/parallel/programs/models/ops/data);
+``--plan`` joins the partition rules, the committed param-tree
+inventory and the cost inventory into ``pvraft_pod_plan/v1``
+(per-device memory + ring comms verdicts per candidate (dp, sp) mesh).
 """
 
 from __future__ import annotations
@@ -213,6 +220,64 @@ def _kernels_plan(args) -> int:
     return 0
 
 
+def _cmd_sharding(args) -> int:
+    from pvraft_tpu.analysis.sharding.check import check_paths, default_scope
+    from pvraft_tpu.analysis.sharding.rules import all_sharding_rules
+
+    if args.list_rules:
+        for rule in all_sharding_rules():
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id}  {rule.title:<28} {doc}")
+        return 0
+    if args.plan or args.check:
+        return _sharding_plan(args)
+    paths = args.paths or list(default_scope())
+    select = tuple(args.select.split(",")) if args.select else ()
+    diags, nfiles = check_paths(paths, rule_ids=select)
+    for d in diags:
+        print(d.format())
+    print(f"shardcheck: {len(diags)} finding(s) in {nfiles} file(s)",
+          file=sys.stderr)
+    return 1 if diags else 0
+
+
+def _sharding_plan(args) -> int:
+    """Build (or --check) the pvraft_pod_plan/v1 artifact: partition
+    rules x committed inventories x candidate meshes. Exit 1 on any
+    plan problem — shardcheck findings, a failed sharded-step
+    cross-check, or (with --check) committed-plan drift."""
+    import json
+
+    from pvraft_tpu.analysis.sharding.planner import (
+        build_plan,
+        check_plan_file,
+        write_plan,
+    )
+
+    if args.check:
+        problems = check_plan_file(args.check, args.costs, args.params)
+        for p in problems:
+            print(p, file=sys.stderr)
+        if not problems:
+            print(f"{args.check}: OK (matches the plan regenerated from "
+                  f"{args.costs} + {args.params})")
+        return 1 if problems else 0
+    try:
+        plan = build_plan(args.costs, args.params)
+    except (OSError, ValueError) as e:
+        print(f"sharding --plan: {e}", file=sys.stderr)
+        return 1
+    if args.out:
+        write_plan(plan, args.out)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(json.dumps(plan, indent=1, sort_keys=True))
+    for n_points, verdict in sorted(plan["scene_verdicts"].items(),
+                                    key=lambda kv: int(kv[0])):
+        print(f"[pod] {n_points} points: {verdict}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pvraft_tpu.analysis",
@@ -294,6 +359,38 @@ def main(argv=None) -> int:
                         help="the committed pvraft_costs/v1 inventory to "
                              "join against")
     p_kern.set_defaults(fn=_cmd_kernels)
+
+    p_shard = sub.add_parser(
+        "sharding",
+        help="shardcheck: SPMD/multi-host static analysis (GS rules) over "
+             "the multi-process planes, plus the --plan pod "
+             "memory/comms planner",
+    )
+    p_shard.add_argument("paths", nargs="*",
+                         help="files/directories to check (default: the "
+                              "engine/obs/parallel/programs/models/ops/"
+                              "data scope)")
+    p_shard.add_argument("--list-rules", action="store_true",
+                         help="print the GS rule table and exit")
+    p_shard.add_argument("--select", default="",
+                         help="comma-separated GS rule ids (default all)")
+    p_shard.add_argument("--plan", action="store_true",
+                         help="emit the pvraft_pod_plan/v1 artifact "
+                              "(partition rules x --costs x --params x "
+                              "candidate meshes)")
+    p_shard.add_argument("--out", default="",
+                         help="with --plan: write the artifact here "
+                              "instead of stdout")
+    p_shard.add_argument("--check", default="", metavar="ARTIFACT",
+                         help="regenerate the plan and compare against a "
+                              "committed artifact (exit 1 on drift)")
+    p_shard.add_argument("--costs", default="artifacts/programs_costs.json",
+                         help="the committed pvraft_costs/v1 inventory to "
+                              "join against")
+    p_shard.add_argument("--params", default="artifacts/params_tree.json",
+                         help="the committed pvraft_params_tree/v1 leaf "
+                              "inventory to join against")
+    p_shard.set_defaults(fn=_cmd_sharding)
 
     args = parser.parse_args(argv)
     return args.fn(args)

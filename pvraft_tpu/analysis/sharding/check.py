@@ -1,0 +1,144 @@
+"""shardcheck driver: files -> shard models -> GS rules -> diagnostics.
+
+Mirrors ``concurrency/check.py``/``kernels/check.py`` deliberately: the
+same ``Diagnostic`` type, the same ``# graftlint: disable=GSxxx --
+reason`` suppression grammar (one parser — what ``lint --stats`` counts
+is exactly what is honored here), the same stable ordering. Scope
+defaults to the multi-process planes of the package (engine, obs,
+parallel, programs, models, ops, data + the compat/config top-levels;
+``serve/`` is the single-host plane, threadcheck's turf).
+
+The declared context comes from the data planes, never hardcoded: the
+mesh-axis vocabulary is parsed from ``parallel/mesh.py``'s
+``*_AXIS = "..."`` declarations, the GS001 leaf inventory from the
+committed ``artifacts/params_tree.json`` (whose own drift is pinned by
+``programs params --check``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence, Set, Tuple
+
+from pvraft_tpu.analysis.engine import (
+    Diagnostic,
+    _expand_decorated_regions,
+    _suppressed,
+    _suppressions,
+    iter_py_files,
+)
+from pvraft_tpu.analysis.sharding.model import build_module_shard_model
+from pvraft_tpu.analysis.sharding.rules import (
+    ShardContext,
+    all_sharding_rules,
+)
+
+# Spelled as constants for docs/tests; resolved lazily by the CLI.
+DEFAULT_SCOPE = (
+    "pvraft_tpu/engine", "pvraft_tpu/obs", "pvraft_tpu/parallel",
+    "pvraft_tpu/programs", "pvraft_tpu/models", "pvraft_tpu/ops",
+    "pvraft_tpu/data", "pvraft_tpu/compat.py", "pvraft_tpu/config.py",
+)
+
+
+def _pkg_root() -> str:
+    import pvraft_tpu
+
+    return os.path.dirname(os.path.abspath(pvraft_tpu.__file__))
+
+
+def default_scope() -> Tuple[str, ...]:
+    """The gate's scan scope, as absolute paths of this checkout."""
+    pkg = _pkg_root()
+    return tuple(
+        os.path.join(pkg, rel.split("/", 1)[1]) for rel in DEFAULT_SCOPE)
+
+
+def declared_axes() -> Set[str]:
+    """The mesh-axis vocabulary: every ``<NAME>_AXIS = "..."`` string
+    constant declared at module level of ``parallel/mesh.py`` — the
+    ``(data, seq)`` builder IS the declaration site (GS002)."""
+    path = os.path.join(_pkg_root(), "parallel", "mesh.py")
+    axes: Set[str] = set()
+    try:
+        with open(path, "r", encoding="utf-8-sig") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return axes
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id.endswith("_AXIS") and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            axes.add(node.value.value)
+    return axes
+
+
+def default_param_leaves() -> Optional[List[str]]:
+    """Leaf paths of the committed ``artifacts/params_tree.json``
+    (repo-root sibling of the package), or None when unreadable —
+    GS001 reports that as a finding rather than skipping."""
+    path = os.path.join(os.path.dirname(_pkg_root()),
+                        "artifacts", "params_tree.json")
+    try:
+        from pvraft_tpu.programs.partitioning import load_params_tree
+
+        doc = load_params_tree(path)
+    except (OSError, ValueError):
+        return None
+    return [leaf["path"] for leaf in doc["leaves"]]
+
+
+def check_source(source: str, path: str = "<string>",
+                 rule_ids: Sequence[str] = (),
+                 declared: Optional[Set[str]] = None,
+                 param_leaves: Optional[Sequence[str]] = None,
+                 ) -> List[Diagnostic]:
+    """Run the GS rules over one source string (suppressions applied)."""
+    source = source.lstrip("\ufeff")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic(path, e.lineno or 1, e.offset or 0, "GS000",
+                           f"syntax error: {e.msg}")]
+    model = build_module_shard_model(tree)
+    ctx = ShardContext(path, source, tree, model,
+                       declared_axes=declared, param_leaves=param_leaves)
+    per_line, file_ids = _suppressions(source)
+    _expand_decorated_regions(tree, per_line)
+    out: List[Diagnostic] = []
+    for rule_cls in all_sharding_rules():
+        if rule_ids and rule_cls.id not in rule_ids:
+            continue
+        for d in rule_cls().check(ctx):
+            if not _suppressed(d, per_line, file_ids):
+                out.append(d)
+    out.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return out
+
+
+def check_paths(paths: Sequence[str], rule_ids: Sequence[str] = (),
+                declared: Optional[Set[str]] = None,
+                param_leaves: Optional[Sequence[str]] = None,
+                ) -> Tuple[List[Diagnostic], int]:
+    """Check files/directories. Returns (findings, files_checked).
+
+    ``declared``/``param_leaves`` default to the live declarations
+    (mesh.py axes, the committed leaf inventory) so the clean-tree gate
+    always arms GS001/GS002 with real data."""
+    if declared is None:
+        declared = declared_axes()
+    if param_leaves is None:
+        param_leaves = default_param_leaves()
+    findings: List[Diagnostic] = []
+    n = 0
+    for f in iter_py_files(paths):
+        n += 1
+        with open(f, "r", encoding="utf-8-sig") as fh:
+            findings.extend(check_source(
+                fh.read(), path=f, rule_ids=rule_ids, declared=declared,
+                param_leaves=param_leaves))
+    findings.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return findings, n

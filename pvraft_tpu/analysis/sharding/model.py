@@ -1,0 +1,663 @@
+"""Static SPMD model of one module: the facts the GS rules consume.
+
+Pure stdlib ``ast``. One walk extracts, per module:
+
+* **axis sites** — string-literal mesh-axis names at every
+  ``PartitionSpec``/``P``/``Mesh``/collective (``ppermute``, ``psum``,
+  ``pvary``, ``axis_index``, ``axis_size``, ...) call and every
+  ``mesh.shape["..."]`` subscript (GS002);
+* **fragile spellings** — direct ``lax.axis_size`` use outside
+  ``compat.py`` (the in-jit spelling that moved between jax versions;
+  GL004 precedent, GS002);
+* **eager stack sites** — the ``tree_map(lambda *xs: jnp.stack(xs),
+  *pending)`` host-materialization idiom, with the class/module
+  process-count guards that must accompany it (GS003);
+* **write sites** — filesystem mutations with a guard analysis:
+  lexical ``jax.process_index() == 0`` dominators, terminating guard
+  clauses, process-0 flag fields (the ``EventLog.enabled`` pattern) and
+  module-local writer helpers dominated by guarded call sites (GS004);
+* **batch-contract sites** — arithmetic crossing a ``process_count``
+  boundary with a batch dimension, and device-placement calls that
+  bypass ``parallel/mesh.py`` (GS005).
+
+The guard analysis is deliberately syntactic and local: it recognizes
+the repo's actual conventions (guard clause + early return, rank-0
+``if`` bodies, tainted boolean fields, guarded helper call sites) and
+nothing cleverer — a write the model cannot prove guarded is a finding,
+the same fail-closed posture as kernelcheck's GK000.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# Collective / sharding APIs whose string-literal args name mesh axes.
+AXIS_APIS = frozenset({
+    "PartitionSpec", "ppermute", "psum", "psum_scatter", "pmean", "pmax",
+    "pmin", "pvary", "pbroadcast", "all_gather", "all_to_all",
+    "axis_index", "axis_size", "pswapaxes",
+})
+
+# Filesystem mutations GS004 watches. ``os.makedirs``/``os.mkdir`` with
+# ``exist_ok=True`` are exempt (idempotent ensure — concurrent-safe by
+# construction); everything else here mutates shared state.
+WRITE_APIS = frozenset({
+    "os.makedirs", "os.mkdir", "os.replace", "os.rename", "os.unlink",
+    "os.remove", "os.rmdir", "np.save", "np.savez", "np.savez_compressed",
+    "numpy.save", "numpy.savez", "numpy.savez_compressed",
+    "shutil.rmtree", "shutil.copytree", "shutil.copy", "shutil.copy2",
+    "shutil.move",
+})
+
+PLACEMENT_APIS = frozenset({
+    "device_put", "make_array_from_process_local_data",
+})
+
+
+def _dotted(node: ast.AST) -> str:
+    """'os.path.join'-style spelling of a Name/Attribute chain ('' when
+    the chain bottoms out in a call/subscript — those roots are dynamic)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _tail(node: ast.AST) -> str:
+    d = _dotted(node)
+    return d.rsplit(".", 1)[-1] if d else ""
+
+
+def _contains_text(node: ast.AST, text: str) -> bool:
+    """Does any Name/Attribute in the subtree spell ``text``?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == text:
+            return True
+        if isinstance(n, ast.Name) and n.id == text:
+            return True
+    return False
+
+
+def _str_constants(node: ast.AST) -> List[Tuple[int, int, str]]:
+    return [(n.lineno, n.col_offset, n.value) for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisSite:
+    line: int
+    col: int
+    axis: str
+    api: str
+
+
+@dataclasses.dataclass(frozen=True)
+class FragileSpelling:
+    line: int
+    col: int
+    spelling: str
+
+
+@dataclasses.dataclass(frozen=True)
+class StackSite:
+    line: int
+    col: int
+    owner: str          # enclosing class name, "" for module level
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessGuard:
+    line: int
+    owner: str
+
+
+@dataclasses.dataclass(frozen=True)
+class WriteSite:
+    line: int
+    col: int
+    call: str
+    func: str           # enclosing function name ("" = module body)
+    owner: str          # enclosing class name
+    guarded: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchArithSite:
+    line: int
+    col: int
+    detail: str
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementSite:
+    line: int
+    col: int
+    api: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleEntry:
+    line: int
+    col: int
+    pattern: Optional[str]                       # None: unparseable
+    spec: Optional[Tuple[Optional[str], ...]]
+
+
+@dataclasses.dataclass
+class PartitionRulesDecl:
+    line: int
+    entries: List[RuleEntry]
+
+
+@dataclasses.dataclass
+class ModuleShardModel:
+    axis_sites: List[AxisSite]
+    fragile: List[FragileSpelling]
+    stack_sites: List[StackSite]
+    process_guards: List[ProcessGuard]
+    write_sites: List[WriteSite]
+    batch_arith: List[BatchArithSite]
+    placements: List[PlacementSite]
+    partition_rules: Optional[PartitionRulesDecl]
+
+
+# --- guard grammar ---------------------------------------------------------
+
+def _is_rank_compare(node: ast.AST, api: str, values: Sequence[int],
+                     ops) -> bool:
+    """``<...api...> OP <int in values>`` (either side)."""
+    if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+        return False
+    left, op, right = node.left, node.ops[0], node.comparators[0]
+    if not isinstance(op, ops):
+        return False
+    for a, b in ((left, right), (right, left)):
+        if (_contains_text(a, api) and isinstance(b, ast.Constant)
+                and b.value in values):
+            return True
+    return False
+
+
+class _GuardLattice:
+    """Per-class taint of process-0 flags + the guard-test classifier."""
+
+    def __init__(self):
+        self.rank0_fields: Set[str] = set()   # self.<field> is a p0 flag
+        self.rank0_locals: Set[str] = set()   # per-function, reset often
+
+    def is_rank0_true(self, test: ast.AST) -> bool:
+        """Inside ``if test:`` the process is provably 0 (or provably the
+        only process)."""
+        if _is_rank_compare(test, "process_index", (0,), (ast.Eq,)):
+            return True
+        if _is_rank_compare(test, "process_count", (1,), (ast.Eq,)) or \
+                _is_rank_compare(test, "process_count", (1, 2),
+                                 (ast.Lt, ast.LtE)):
+            # count == 1 / count <= 1 / count < 2: single-process.
+            return True
+        if isinstance(test, ast.Name) and test.id in self.rank0_locals:
+            return True
+        if isinstance(test, ast.Attribute) and \
+                isinstance(test.value, ast.Name) and \
+                test.value.id == "self" and test.attr in self.rank0_fields:
+            return True
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self.is_rank0_exit(test.operand)
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            return any(self.is_rank0_true(v) for v in test.values)
+        return False
+
+    def is_rank0_exit(self, test: ast.AST) -> bool:
+        """``if test: return/raise`` leaves only process 0 (or a single
+        process) on the fall-through path."""
+        if _is_rank_compare(test, "process_index", (0,), (ast.NotEq,)) or \
+                _is_rank_compare(test, "process_index", (0,), (ast.Gt,)):
+            return True
+        if _is_rank_compare(test, "process_count", (1,), (ast.NotEq,)) or \
+                _is_rank_compare(test, "process_count", (1, 2),
+                                 (ast.Gt, ast.GtE)):
+            return True
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self.is_rank0_true(test.operand)
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            # `if flag and count > 1: raise` — the fall-through is only
+            # single-process WHEN flag holds; accepting it mirrors the
+            # evaluator's dump_dir guard (the write is gated on the same
+            # flag). Deliberately permissive in the flag direction.
+            return any(self.is_rank0_exit(v) for v in test.values)
+        return False
+
+    def taint_function(self, fn: ast.AST) -> None:
+        """Collect rank-0 locals: names assigned (anywhere in ``fn``)
+        from an expression containing a process_index-vs-0 compare."""
+        self.rank0_locals = set()
+        if not _contains_text(fn, "process_index"):
+            return  # cheap prefilter: nothing to taint from
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and n.targets:
+                if self._rank0_expr(n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            self.rank0_locals.add(t.id)
+
+    def _rank0_expr(self, expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            if _is_rank_compare(n, "process_index", (0,), (ast.Eq,)):
+                return True
+            if isinstance(n, ast.Name) and n.id in self.rank0_locals:
+                return True
+        return False
+
+    def taint_class(self, cls: ast.ClassDef) -> None:
+        """Two passes: function-local flags, then ``self.X = <flag>``."""
+        self.rank0_fields = set()
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self.taint_function(item)
+            for n in ast.walk(item):
+                if isinstance(n, ast.Assign) and self._rank0_expr(n.value):
+                    for t in n.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            self.rank0_fields.add(t.attr)
+
+
+def _body_terminates(body: Sequence[ast.stmt]) -> bool:
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+               for s in body)
+
+
+# --- write-site extraction -------------------------------------------------
+
+def _write_call(node: ast.Call) -> Optional[str]:
+    """The WRITE_APIS spelling of a call, or 'open' for a write-mode
+    open, or None."""
+    dotted = _dotted(node.func)
+    if dotted in WRITE_APIS:
+        if dotted in ("os.makedirs", "os.mkdir"):
+            for kw in node.keywords:
+                if kw.arg == "exist_ok" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is True:
+                    return None  # idempotent ensure: concurrent-safe
+        return dotted
+    if isinstance(node.func, ast.Name) and node.func.id == "open":
+        mode = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if isinstance(mode, str) and any(c in mode for c in "wax+"):
+            return "open"
+    return None
+
+
+class _FunctionWrites:
+    """Write sites (and writer-helper call sites) of one function with
+    lexical guard state."""
+
+    def __init__(self, lattice: _GuardLattice):
+        self.lattice = lattice
+        self.writes: List[Tuple[int, int, str, bool]] = []
+        self.calls: List[Tuple[str, bool]] = []   # (callee name, guarded)
+
+    def scan(self, fn) -> None:
+        self.lattice.taint_function(fn)
+        self._block(fn.body, False)
+
+    def _expr(self, node: ast.AST, guarded: bool) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue  # nested scopes handled by the module pass
+            if isinstance(n, ast.Call):
+                w = _write_call(n)
+                if w:
+                    self.writes.append(
+                        (n.lineno, n.col_offset, w, guarded))
+                callee = _tail(n.func)
+                if callee:
+                    self.calls.append((callee, guarded))
+
+    def _block(self, body: Sequence[ast.stmt], guarded: bool) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # the module pass scans nested scopes itself
+            if isinstance(stmt, ast.If):
+                self._expr(stmt.test, guarded)
+                if self.lattice.is_rank0_true(stmt.test):
+                    self._block(stmt.body, True)
+                    self._block(stmt.orelse, guarded)
+                elif self.lattice.is_rank0_exit(stmt.test) and \
+                        _body_terminates(stmt.body):
+                    self._block(stmt.body, guarded)
+                    self._block(stmt.orelse, guarded)
+                    guarded = True  # fall-through is process-0/single
+                else:
+                    self._block(stmt.body, guarded)
+                    self._block(stmt.orelse, guarded)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr(stmt.iter, guarded)
+                self._block(stmt.body, guarded)
+                self._block(stmt.orelse, guarded)
+                continue
+            if isinstance(stmt, ast.While):
+                self._expr(stmt.test, guarded)
+                self._block(stmt.body, guarded)
+                self._block(stmt.orelse, guarded)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._expr(item.context_expr, guarded)
+                self._block(stmt.body, guarded)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._block(stmt.body, guarded)
+                for h in stmt.handlers:
+                    self._block(h.body, guarded)
+                self._block(stmt.orelse, guarded)
+                self._block(stmt.finalbody, guarded)
+                continue
+            self._expr(stmt, guarded)
+
+
+def _collect_write_sites(tree: ast.Module) -> List[WriteSite]:
+    """Module-wide GS004 model: per-function lexical analysis (the
+    module body itself is analyzed as the ``<module>`` scope — an
+    import-time write is as multi-process-hot as any), then the
+    writer-helper dominance fixpoint (a helper whose every in-module
+    call site is guarded inherits the guard — the ``checkpoint.py
+    _write``/``_swap_in`` shape)."""
+    functions: List[Tuple[str, str, ast.AST]] = [("", "<module>", tree)]
+
+    def discover(body, owner: str) -> None:
+        """Every def/class, wherever nested (incl. under if/try/with)."""
+        for item in body:
+            if isinstance(item, ast.ClassDef):
+                discover(item.body, item.name)
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                functions.append((owner, item.name, item))
+                discover(item.body, owner)
+            else:
+                for sub in (getattr(item, "body", ()),
+                            getattr(item, "orelse", ()),
+                            getattr(item, "finalbody", ())):
+                    discover(sub, owner)
+                for h in getattr(item, "handlers", ()):
+                    discover(h.body, owner)
+
+    discover(tree.body, "")
+
+    lattice = _GuardLattice()
+    class_nodes = {n.name: n for n in ast.walk(tree)
+                   if isinstance(n, ast.ClassDef)}
+    field_cache: Dict[str, Set[str]] = {}
+    per_fn: Dict[Tuple[str, str], _FunctionWrites] = {}
+    order: List[Tuple[str, str]] = []
+    for owner, name, fn in functions:
+        if owner and owner in class_nodes:
+            if owner not in field_cache:
+                lattice.taint_class(class_nodes[owner])
+                field_cache[owner] = set(lattice.rank0_fields)
+            lattice.rank0_fields = field_cache[owner]
+        else:
+            lattice.rank0_fields = set()
+        fw = _FunctionWrites(lattice)
+        fw.scan(fn)
+        key = (owner, name)
+        if key not in per_fn:       # first def wins on duplicate names
+            per_fn[key] = fw
+            order.append(key)
+
+    # Least-fixpoint dominance, grown from lexically-guarded call
+    # sites: a function is guard-dominated iff it HAS in-module call
+    # sites and every one is lexically guarded or inside a dominated
+    # function. (A greatest fixpoint would prove a mutually-recursive
+    # writer pair with no outside callers "guarded" — fail closed.)
+    name_to_keys: Dict[str, List[Tuple[str, str]]] = {}
+    for k in per_fn:
+        name_to_keys.setdefault(k[1], []).append(k)
+    call_sites: Dict[Tuple[str, str], List[Tuple[Tuple[str, str], bool]]] = \
+        {k: [] for k in per_fn}
+    for caller, fw in per_fn.items():
+        for callee, guarded in fw.calls:
+            for key in name_to_keys.get(callee, ()):
+                if key != caller:
+                    call_sites[key].append((caller, guarded))
+    dominated: Set[Tuple[str, str]] = set()
+    changed = True
+    while changed:
+        changed = False
+        for key, sites in call_sites.items():
+            if key in dominated or not sites:
+                continue
+            if all(guarded or caller in dominated
+                   for caller, guarded in sites):
+                dominated.add(key)
+                changed = True
+
+    out: List[WriteSite] = []
+    for key in order:
+        owner, name = key
+        fw = per_fn[key]
+        for line, col, call, guarded in fw.writes:
+            out.append(WriteSite(
+                line=line, col=col, call=call, func=name, owner=owner,
+                guarded=guarded or key in dominated))
+    out.sort(key=lambda w: (w.line, w.col))
+    return out
+
+
+# --- the module walk -------------------------------------------------------
+
+def _partition_spec_names(tree: ast.Module) -> Set[str]:
+    """Local spellings of PartitionSpec ('P' via the import alias)."""
+    names = {"PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "PartitionSpec" and alias.asname:
+                    names.add(alias.asname)
+    return names
+
+
+def _extract_partition_rules(tree: ast.Module) -> Optional[PartitionRulesDecl]:
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target, value = node.target, node.value
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == "PARTITION_RULES"):
+            continue
+        entries: List[RuleEntry] = []
+        if isinstance(value, (ast.Tuple, ast.List)):
+            for elt in value.elts:
+                pattern = spec = None
+                if isinstance(elt, (ast.Tuple, ast.List)) and \
+                        len(elt.elts) == 2:
+                    pat_node, spec_node = elt.elts
+                    if isinstance(pat_node, ast.Constant) and \
+                            isinstance(pat_node.value, str):
+                        pattern = pat_node.value
+                    if isinstance(spec_node, (ast.Tuple, ast.List)):
+                        axes = []
+                        ok = True
+                        for a in spec_node.elts:
+                            if isinstance(a, ast.Constant) and (
+                                    a.value is None
+                                    or isinstance(a.value, str)):
+                                axes.append(a.value)
+                            else:
+                                ok = False
+                        if ok:
+                            spec = tuple(axes)
+                entries.append(RuleEntry(elt.lineno, elt.col_offset,
+                                         pattern, spec))
+        return PartitionRulesDecl(line=node.lineno, entries=entries)
+    return None
+
+
+def build_module_shard_model(tree: ast.Module) -> ModuleShardModel:
+    ps_names = _partition_spec_names(tree)
+    axis_sites: List[AxisSite] = []
+    fragile: List[FragileSpelling] = []
+    stack_sites: List[StackSite] = []
+    guards: List[ProcessGuard] = []
+    batch_arith: List[BatchArithSite] = []
+    placements: List[PlacementSite] = []
+
+    # process_count-tainted local names, per function (for GS005).
+    def count_tainted(fn) -> Set[str]:
+        tainted: Set[str] = set()
+        if not _contains_text(fn, "process_count"):
+            return tainted  # cheap prefilter
+        for _ in range(2):  # one propagation round is enough here
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign):
+                    if _contains_text(n.value, "process_count") or any(
+                            isinstance(x, ast.Name) and x.id in tainted
+                            for x in ast.walk(n.value)):
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                tainted.add(t.id)
+        return tainted
+
+    def owner_of(path: List[ast.AST]) -> str:
+        for node in reversed(path):
+            if isinstance(node, ast.ClassDef):
+                return node.name
+        return ""
+
+    # Walk with a parent path so stack sites / guards know their class.
+    def walk(node, path):
+        for child in ast.iter_child_nodes(node):
+            visit(child, path + [node])
+
+    def visit(node, path):
+        if isinstance(node, ast.Call):
+            callee = _tail(node.func)
+            dotted = _dotted(node.func)
+            if callee in ps_names or callee in AXIS_APIS or \
+                    callee == "Mesh":
+                api = ("PartitionSpec" if callee in ps_names else callee)
+                # Keywords carry axis names too (`psum(x,
+                # axis_name="data")` is the common jax spelling).
+                args: List[ast.AST] = list(node.args) + [
+                    kw.value for kw in node.keywords]
+                if callee == "Mesh":
+                    # Only the axis-names operand (2nd positional or the
+                    # axis_names kwarg) carries axis strings.
+                    args = list(node.args[1:2]) + [
+                        kw.value for kw in node.keywords
+                        if kw.arg == "axis_names"]
+                for arg in args:
+                    for line, col, s in _str_constants(arg):
+                        axis_sites.append(AxisSite(line, col, s, api))
+            if dotted.endswith("lax.axis_size"):
+                fragile.append(FragileSpelling(
+                    node.lineno, node.col_offset, dotted))
+            if callee in PLACEMENT_APIS:
+                placements.append(PlacementSite(
+                    node.lineno, node.col_offset, callee))
+            if callee in ("tree_map", "tree_multimap"):
+                has_star = any(isinstance(a, ast.Starred)
+                               for a in node.args)
+                lam = next((a for a in node.args
+                            if isinstance(a, ast.Lambda)), None)
+                if has_star and lam is not None and any(
+                        isinstance(n, ast.Call)
+                        and _tail(n.func) in ("stack", "concatenate")
+                        for n in ast.walk(lam.body)):
+                    stack_sites.append(StackSite(
+                        node.lineno, node.col_offset, owner_of(path)))
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Attribute) and \
+                node.value.attr == "shape":
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                axis_sites.append(AxisSite(
+                    node.lineno, node.col_offset, sl.value, "mesh.shape"))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and \
+                isinstance(node.func.value, ast.Attribute) and \
+                node.func.value.attr == "shape":
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                axis_sites.append(AxisSite(
+                    node.lineno, node.col_offset, node.args[0].value,
+                    "mesh.shape"))
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+            for alias in node.names:
+                if alias.name == "axis_size":
+                    fragile.append(FragileSpelling(
+                        node.lineno, node.col_offset, "jax.lax.axis_size"))
+        if isinstance(node, ast.If) and \
+                _contains_text(node.test, "process_count") and \
+                any(isinstance(n, ast.Compare)
+                    for n in ast.walk(node.test)) and \
+                any(isinstance(s, (ast.Raise, ast.Return, ast.Assign))
+                    for s in ast.walk(node)):
+            guards.append(ProcessGuard(node.lineno, owner_of(path)))
+        walk(node, path)
+
+    walk(tree, [])
+
+    # GS005 batch arithmetic, per function scope.
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tainted = count_tainted(fn)
+
+        def is_count_side(n) -> bool:
+            if _contains_text(n, "process_count"):
+                return True
+            return any(isinstance(x, ast.Name) and x.id in tainted
+                       for x in ast.walk(n))
+
+        def is_batch_side(n) -> bool:
+            for x in ast.walk(n):
+                if isinstance(x, ast.Name) and "batch" in x.id.lower():
+                    return True
+                if isinstance(x, ast.Attribute) and \
+                        "batch" in x.attr.lower():
+                    return True
+            return False
+
+        for n in ast.walk(fn):
+            if isinstance(n, ast.BinOp) and isinstance(
+                    n.op, (ast.Mult, ast.Div, ast.FloorDiv, ast.Mod)):
+                pairs = ((n.left, n.right), (n.right, n.left))
+                for a, b in pairs:
+                    if is_count_side(a) and is_batch_side(b) and \
+                            not is_count_side(b):
+                        batch_arith.append(BatchArithSite(
+                            n.lineno, n.col_offset,
+                            "batch dim combined with process_count"))
+                        break
+
+    return ModuleShardModel(
+        axis_sites=sorted(axis_sites, key=lambda a: (a.line, a.col)),
+        fragile=sorted(fragile, key=lambda a: (a.line, a.col)),
+        stack_sites=sorted(stack_sites, key=lambda a: (a.line, a.col)),
+        process_guards=guards,
+        write_sites=_collect_write_sites(tree),
+        batch_arith=sorted(set(batch_arith), key=lambda a: (a.line, a.col)),
+        placements=sorted(placements, key=lambda a: (a.line, a.col)),
+        partition_rules=_extract_partition_rules(tree),
+    )

@@ -1,0 +1,401 @@
+"""Pod-scale memory/comms planner: the ``pvraft_pod_plan/v1`` artifact.
+
+Joins the declared ``PARTITION_RULES`` ladder, the committed param-tree
+leaf inventory (``artifacts/params_tree.json``) and the committed cost
+inventory (``artifacts/programs_costs.json``) with the candidate
+``(dp, sp)`` meshes into a machine-checked plan — the committed answer
+to "which mesh does a 100k-point scene train on", which ROADMAP item 2
+cites the way item 1 cites ``kernel_plan.json``:
+
+* per mesh: per-device param/optimizer bytes honoring the partition
+  rules (replicated leaves pay full freight on every chip — the plan
+  shows exactly how little that costs at this model's size, and starts
+  shrinking the day a rule shards);
+* per (mesh, scene): per-device activation bytes (linear B x N scaling
+  from the ``flagship_train_step_fp32_remat`` record — the supported
+  fp32 path), the ring-fold transient under the declared chunking, the
+  batch arrays, and the fits-16GiB verdict;
+* ring comms: per-hop bytes x (p-1) hops from the ``ring.py`` geometry
+  (the last fold's chunk is never forwarded — the deepcheck GJ002 fix)
+  against per-step compute at the v5e roofline;
+* an honesty cross-check against the committed ``dp_sp_2x2_train_step``
+  compile record: the model's per-device estimate for that exact
+  geometry must sit inside a pinned band of the real (un-remat'd)
+  ``live_bytes_estimate`` — an axis mixup or a lost per-device division
+  refuses the plan instead of committing fiction.
+
+Everything is a pure function of committed inputs — no timestamps, no
+toolchain — so ``artifacts/pod_plan.json`` is byte-deterministic and
+``sharding --check`` regenerates and compares it exactly (the
+``kernel_plan.json`` discipline, pinned in ``scripts/lint.sh``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from pvraft_tpu.analysis.kernels.planner import (
+    HBM_BYTES_PER_S,
+    PEAK_FLOPS_F32,
+    _round,
+)
+from pvraft_tpu.analysis.sharding.check import (
+    check_paths,
+    declared_axes,
+    default_scope,
+)
+from pvraft_tpu.programs.geometries import (
+    FLAGSHIP_BATCH,
+    FLAGSHIP_POINTS,
+    HBM_BYTES,
+)
+from pvraft_tpu.programs.partitioning import (
+    PARTITION_RULES,
+    leaf_bytes,
+    load_params_tree,
+    match_partition_rules,
+    shard_factor,
+)
+
+PLAN_SCHEMA = "pvraft_pod_plan/v1"
+
+# Candidate (dp, sp) meshes — data-parallel x sequence-parallel. 2x2 is
+# the certified registry spec; the ladder extends it toward a v5e pod
+# slice (32 chips at 8x4).
+CANDIDATE_MESHES: Tuple[Tuple[int, int], ...] = ((2, 2), (4, 2), (4, 4),
+                                                 (8, 4))
+
+# Scene sizes the pod campaign must answer for: the serve buckets, the
+# flagship, the 16k long-context target and the 100k stretch scene.
+SCENE_POINTS: Tuple[int, ...] = (2048, 8192, 16384, 100000)
+
+# The activation basis: the supported fp32 training path (remat'd GRU
+# iterations — plain fp32 does not fit one chip, see the catalog's
+# expect_failure record). Its temp bytes at (B=2, N=8192) scale
+# linearly in B x N; the dense-pairwise transient baked into the basis
+# makes the linear extrapolation mildly conservative for ring runs.
+ACTIVATION_BASIS_PROGRAM = "flagship_train_step_fp32_remat"
+
+# The cross-check target: the real compiled sharded step (un-remat'd).
+SHARDED_STEP_PROGRAM = "dp_sp_2x2_train_step"
+
+# The model's remat-basis estimate for the dp_sp geometry must sit in
+# this band of the compiled un-remat'd live bytes: above 1.0 the
+# "cheaper" remat model exceeds the real un-remat program (broken
+# model); below 1/8 something lost a dimension or a per-device divide.
+CROSS_CHECK_BAND = (1.0 / 8.0, 1.0)
+
+# Pod scenario knobs (declared, recorded in the artifact):
+PER_DEVICE_BATCH = 1          # one scene per data-row — the memory floor
+ADAM_STATE_FACTOR = 2         # mu + nu mirror the param tree
+RING_CHUNK = 4096             # corr_chunk for the ring fold (the config
+#                               lever that bounds the (N/sp)^2 transient)
+RING_FOLD_FACTOR = 3          # fold matrix + top-k concat + xyz planes
+FEATURE_DIM_FALLBACK = 128
+
+# v5e inter-chip interconnect: 1,600 Gbps aggregate per chip over 4
+# links (public spec) — a ring hop rides one link, ~50 GB/s.
+ICI_BYTES_PER_S = 50e9
+
+_F32 = 4
+# Batch arrays per scene row: pc1 + pc2 + gt (3 floats each) + mask.
+_BATCH_FLOATS_PER_POINT = 10
+
+
+def _feature_dim() -> int:
+    try:
+        from pvraft_tpu.config import ModelConfig
+
+        return int(ModelConfig().feature_dim)
+    except Exception:  # pragma: no cover - partial checkouts only
+        return FEATURE_DIM_FALLBACK
+
+
+def _cost_record(costs: Dict[str, Any], name: str) -> Optional[Dict[str, Any]]:
+    for rec in costs.get("programs", ()):
+        if isinstance(rec, dict) and rec.get("name") == name:
+            return rec
+    return None
+
+
+# --- per-device byte accounting --------------------------------------------
+
+def param_bytes_per_device(leaves: Sequence[Dict[str, Any]],
+                           mesh_shape: Dict[str, int]) -> int:
+    """Sum of leaf bytes / shard factor under the declared rules."""
+    spec_of = match_partition_rules(
+        PARTITION_RULES, [leaf["path"] for leaf in leaves])
+    total = 0
+    for leaf in leaves:
+        factor = shard_factor(spec_of[leaf["path"]], mesh_shape)
+        total += -(-leaf_bytes(leaf) // factor)  # ceil-divide
+    return total
+
+
+def activation_bytes_per_point(costs: Dict[str, Any]) -> float:
+    """temp bytes of the remat'd flagship step per (batch x point)."""
+    rec = _cost_record(costs, ACTIVATION_BASIS_PROGRAM)
+    if rec is None or not rec.get("ok"):
+        raise ValueError(
+            f"costs artifact has no ok record for "
+            f"{ACTIVATION_BASIS_PROGRAM!r} — regenerate "
+            f"programs_costs.json")
+    temp = int((rec.get("memory") or {}).get("temp_size_in_bytes", 0))
+    if temp <= 0:
+        raise ValueError(
+            f"{ACTIVATION_BASIS_PROGRAM}: temp_size_in_bytes missing "
+            f"from the costs record")
+    return temp / float(FLAGSHIP_BATCH * FLAGSHIP_POINTS)
+
+
+def ring_transient_bytes(points_per_device: int, chunk: int,
+                         per_device_batch: int = PER_DEVICE_BATCH) -> int:
+    """Fold-transient bytes of one ring step at the declared chunking:
+    the (Nq_local x chunk) fold matrix plus the top-k concat and
+    gathered xyz planes (RING_FOLD_FACTOR, declared)."""
+    c = min(points_per_device, chunk)
+    return (per_device_batch * points_per_device * c
+            * _F32 * RING_FOLD_FACTOR)
+
+
+def ring_comms(points_per_device: int, sp: int, feature_dim: int,
+               per_device_batch: int = PER_DEVICE_BATCH) -> Dict[str, Any]:
+    """Per-step ring traffic from the ``ring.py`` geometry: each hop
+    forwards this device's circulating chunk; ``sp - 1`` hops per ring
+    (the final fold's chunk is never sent — the GJ002 fix). Rings per
+    step: two kNN graph rings (pc1, pc2 — xyz chunks, int indices stay
+    local, no backward traffic) and one correlation ring (fmap2 + xyz2
+    chunks, counted twice for the ppermute transpose in the backward)."""
+    hops = max(0, sp - 1)
+    knn_hop = per_device_batch * points_per_device * 3 * _F32
+    corr_hop = per_device_batch * points_per_device * \
+        (feature_dim + 3) * _F32
+    total = hops * (2 * knn_hop + 2 * corr_hop)
+    return {
+        "hops": hops,
+        "knn_per_hop_bytes": knn_hop,
+        "knn_rings": 2,
+        "corr_per_hop_bytes": corr_hop,
+        "corr_rings_fwd_bwd": 2,
+        "total_bytes_per_step": total,
+    }
+
+
+# --- plan assembly ----------------------------------------------------------
+
+def build_plan(costs_path: str,
+               params_path: str) -> Dict[str, Any]:
+    """The full ``pvraft_pod_plan/v1`` document. Raises ValueError on
+    any problem — shardcheck findings in the gate scope, a failed
+    cross-check, missing basis records — so the plan is only
+    committable when the checker and the pins agree."""
+    with open(costs_path, "r", encoding="utf-8") as f:
+        costs = json.load(f)
+    tree = load_params_tree(params_path)
+    leaves = tree["leaves"]
+    leaf_paths = [leaf["path"] for leaf in leaves]
+
+    problems: List[str] = []
+    findings, _n = check_paths(list(default_scope()),
+                               param_leaves=leaf_paths)
+    problems.extend(f"shardcheck finding: {d.format()}" for d in findings)
+
+    try:
+        act_per_bn = activation_bytes_per_point(costs)
+    except ValueError as e:
+        problems.append(str(e))
+        act_per_bn = 0.0
+    feature_dim = _feature_dim()
+
+    def scene_row(sp: int, n_points: int) -> Tuple[int, int, int, int]:
+        pts = n_points // sp
+        act = int(act_per_bn * PER_DEVICE_BATCH * pts)
+        transient = ring_transient_bytes(pts, RING_CHUNK)
+        batch = (PER_DEVICE_BATCH * pts
+                 * _BATCH_FLOATS_PER_POINT * _F32)
+        return pts, act, transient, batch
+
+    meshes: List[Dict[str, Any]] = []
+    for dp, sp in CANDIDATE_MESHES:
+        mesh_shape = {"data": dp, "seq": sp}
+        pbytes = param_bytes_per_device(leaves, mesh_shape)
+        obytes = ADAM_STATE_FACTOR * pbytes
+        rec: Dict[str, Any] = {
+            "dp": dp,
+            "sp": sp,
+            "devices": dp * sp,
+            "global_batch": PER_DEVICE_BATCH * dp,
+            "params_bytes_per_device": pbytes,
+            "optimizer_bytes_per_device": obytes,
+            "scenes": [],
+        }
+        for n_points in SCENE_POINTS:
+            if n_points % sp:
+                rec["scenes"].append({
+                    "n_points": n_points,
+                    "fits_16GiB_hbm": False,
+                    "verdict": f"seq axis {sp} does not divide "
+                               f"{n_points} points",
+                })
+                continue
+            pts, act, transient, batch = scene_row(sp, n_points)
+            total = pbytes + obytes + act + transient + batch
+            fits = total <= HBM_BYTES
+            comms = ring_comms(pts, sp, feature_dim)
+            flops_per_device = 0.0
+            basis = _cost_record(costs, ACTIVATION_BASIS_PROGRAM) or {}
+            flops_flagship = float(basis.get("flops", 0.0) or 0.0)
+            if flops_flagship:
+                scale = (PER_DEVICE_BATCH * dp * n_points) / float(
+                    FLAGSHIP_BATCH * FLAGSHIP_POINTS)
+                flops_per_device = flops_flagship * scale / (dp * sp)
+            compute_s = (flops_per_device / PEAK_FLOPS_F32
+                         if flops_per_device else 0.0)
+            comm_s = comms["total_bytes_per_step"] / ICI_BYTES_PER_S
+            scene: Dict[str, Any] = {
+                "n_points": n_points,
+                "points_per_device": pts,
+                "activation_bytes": act,
+                "ring_transient_bytes": transient,
+                "batch_bytes": batch,
+                "total_bytes_per_device": total,
+                "fits_16GiB_hbm": fits,
+                "ring": dict(comms, **{
+                    "comm_seconds_per_step": _round(comm_s),
+                    "compute_seconds_per_step": _round(compute_s),
+                    "comm_compute_ratio": _round(
+                        comm_s / compute_s if compute_s else 0.0),
+                }),
+                "verdict": (
+                    f"{total / 2**30:.2f} GiB of "
+                    f"{HBM_BYTES / 2**30:.0f} GiB per device — "
+                    + ("fits" if fits else "does NOT fit")),
+            }
+            rec["scenes"].append(scene)
+        meshes.append(rec)
+
+    # Honesty cross-check vs the committed sharded-step compile record.
+    cross: Dict[str, Any] = {"program": SHARDED_STEP_PROGRAM}
+    ds = _cost_record(costs, SHARDED_STEP_PROGRAM)
+    if ds is None or not ds.get("ok"):
+        problems.append(
+            f"costs artifact has no ok record for "
+            f"{SHARDED_STEP_PROGRAM!r} — cross-check impossible")
+    elif act_per_bn:
+        live = int((ds.get("memory") or {}).get("live_bytes_estimate", 0))
+        # The dp_sp program's OWN geometry, not the scenario knobs:
+        # global B=FLAGSHIP_BATCH over dp=2, N=FLAGSHIP_POINTS over
+        # sp=2 — so every byte term below uses the same b_loc even if
+        # PER_DEVICE_BATCH is ever re-declared.
+        b_loc = max(1, FLAGSHIP_BATCH // 2)
+        pts = FLAGSHIP_POINTS // 2
+        pbytes = param_bytes_per_device(leaves, {"data": 2, "seq": 2})
+        model_total = (pbytes + ADAM_STATE_FACTOR * pbytes
+                       + int(act_per_bn * b_loc * pts)
+                       + ring_transient_bytes(pts, RING_CHUNK,
+                                              per_device_batch=b_loc)
+                       + b_loc * pts * _BATCH_FLOATS_PER_POINT * _F32)
+        ratio = model_total / live if live else float("inf")
+        lo, hi = CROSS_CHECK_BAND
+        cross.update({
+            "compiled_live_bytes_per_device": live,
+            "model_bytes_per_device": model_total,
+            "model_vs_compiled_ratio": _round(ratio),
+            "band": [lo, hi],
+            "note": ("the compiled record is the un-remat'd step; the "
+                     "remat-basis model must come in below it but not "
+                     "vanish — outside the band the byte model has "
+                     "diverged from the real program"),
+        })
+        if not (lo <= ratio <= hi):
+            problems.append(
+                f"{SHARDED_STEP_PROGRAM}: model estimate {model_total} B "
+                f"vs compiled live {live} B — ratio {ratio:.3f} outside "
+                f"the pinned [{lo:g}, {hi:g}] band; the pod byte model "
+                f"has diverged from the real sharded program")
+
+    if problems:
+        raise ValueError("pod plan cannot be built:\n  "
+                         + "\n  ".join(problems))
+
+    # Headline verdicts ROADMAP item 2 cites.
+    scene_verdicts: Dict[str, str] = {}
+    for n_points in SCENE_POINTS:
+        fitting = [f"{m['dp']}x{m['sp']}" for m in meshes
+                   if any(s["n_points"] == n_points
+                          and s.get("fits_16GiB_hbm") for s in m["scenes"])]
+        scene_verdicts[str(n_points)] = (
+            f"fits per-device on: {', '.join(fitting)}" if fitting
+            else "fits NO candidate mesh — a bigger seq axis or a "
+                 "smaller ring chunk is required")
+
+    return {
+        "schema": PLAN_SCHEMA,
+        "topology": costs.get("topology"),
+        "costs_artifact": os.path.basename(costs_path),
+        "params_artifact": os.path.basename(params_path),
+        "declared_axes": sorted(declared_axes() or ("data", "seq")),
+        "partition_rules": [[pat, list(spec)]
+                            for pat, spec in PARTITION_RULES],
+        "params": {
+            "leaves": len(leaves),
+            "total_parameters": tree["total_parameters"],
+            "total_bytes": tree["total_bytes"],
+        },
+        "scenario": {
+            "per_device_batch": PER_DEVICE_BATCH,
+            "remat_policy": "dots",
+            "activation_basis": ACTIVATION_BASIS_PROGRAM,
+            "activation_bytes_per_batch_point": _round(act_per_bn),
+            "ring_chunk": RING_CHUNK,
+            "ring_fold_factor": RING_FOLD_FACTOR,
+            "adam_state_factor": ADAM_STATE_FACTOR,
+            "feature_dim": feature_dim,
+        },
+        "interconnect": {
+            "ici_bytes_per_s": ICI_BYTES_PER_S,
+            "peak_flops_f32": PEAK_FLOPS_F32,
+            "hbm_bytes_per_s": HBM_BYTES_PER_S,
+            "basis": "public TPU v5e specs (one ICI link per ring hop)",
+        },
+        "hbm_limit_bytes": HBM_BYTES,
+        "meshes": meshes,
+        "sharded_step_cross_check": cross,
+        "scene_verdicts": scene_verdicts,
+    }
+
+
+def write_plan(plan: Dict[str, Any], out_path: str) -> None:
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(plan, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def check_plan_file(path: str, costs_path: str,
+                    params_path: str) -> List[str]:
+    """Regenerate the plan from the committed inputs and compare — a
+    stale or hand-edited artifact fails here (the kernel_plan.json
+    discipline). Returns problems ([] = up to date)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            committed = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable: {e}"]
+    if not isinstance(committed, dict):
+        return [f"{path}: artifact is {type(committed).__name__}, not a "
+                f"{PLAN_SCHEMA} object — regenerate"]
+    try:
+        fresh = build_plan(costs_path, params_path)
+    except (OSError, ValueError) as e:
+        return [f"{path}: cannot rebuild plan: {e}"]
+    if committed != fresh:
+        drift = [k for k in sorted(set(committed) | set(fresh))
+                 if committed.get(k) != fresh.get(k)]
+        return [
+            f"{path}: committed plan drifted from the regenerated one "
+            f"(differing keys: {', '.join(drift)}) — regenerate: "
+            f"python -m pvraft_tpu.analysis sharding --plan --out {path}"]
+    return []

@@ -1,0 +1,322 @@
+"""shardcheck rules GS001-GS005 — the SPMD/multi-host failure classes.
+
+ROADMAP item 2 turns the multi-process guards into implementations:
+named-mesh sharding rules, per-host data loading, multihost
+checkpointing, the ring kNN path promoted to how big scenes train.
+These rules make the conventions that campaign depends on — partition
+coverage, axis-name discipline, the no-eager-stack invariant, the
+process-0 I/O contract, the batch-size contract — machine-checked
+BEFORE the guards come down, the way kernelcheck de-risked the fused
+kernel campaign. Suppress with ``# graftlint: disable=GSxxx -- reason``
+(shared pragma grammar; reason-less suppressions fail ``lint --stats``).
+
+Path scoping: inside the installed package each rule applies only where
+its convention lives (GS004 to ``engine/``+``obs/``, GS005 to
+``engine/``+``data/``+``obs/`` with ``parallel/mesh.py`` exempt as the
+contract owner); outside the package (fixtures, inline test sources)
+every rule applies unconditionally so red/green corpora stay honest.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Sequence, Set, Tuple, Type
+
+from pvraft_tpu.analysis.engine import Diagnostic, LintContext, Rule
+from pvraft_tpu.analysis.sharding.model import (
+    ModuleShardModel,
+    build_module_shard_model,
+)
+
+
+class ShardContext(LintContext):
+    """LintContext + the extracted shard model + the declared-data
+    context (mesh axes from ``parallel/mesh.py``, the committed param
+    leaf inventory for GS001). ``param_leaves=None`` means the caller
+    supplied no inventory: GS001 then reports the gap as a finding on
+    any ``PARTITION_RULES`` file rather than silently skipping."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 model: Optional[ModuleShardModel] = None,
+                 declared_axes: Optional[Set[str]] = None,
+                 param_leaves: Optional[Sequence[str]] = None):
+        super().__init__(path, source, tree)
+        self.model = model if model is not None \
+            else build_module_shard_model(tree)
+        self.declared_axes = declared_axes
+        self.param_leaves = param_leaves
+
+    def package_suffix(self) -> Optional[str]:
+        """'pvraft_tpu/...' relative suffix, or None for out-of-package
+        sources (fixtures, inline strings) — those see every rule."""
+        if "pvraft_tpu/" in self.norm_path:
+            return "pvraft_tpu/" + self.norm_path.rsplit(
+                "/pvraft_tpu/", 1)[-1]
+        return None
+
+    def diag_at(self, line: int, col: int, rule_id: str,
+                message: str) -> Diagnostic:
+        return Diagnostic(self.path, line, col, rule_id, message)
+
+
+class ShardRule(Rule):
+    def check(self, ctx: ShardContext) -> Iterable[Diagnostic]:
+        raise NotImplementedError
+
+
+_GS_REGISTRY: List[Type[ShardRule]] = []
+
+
+def gs_register(cls: Type[ShardRule]) -> Type[ShardRule]:
+    if not cls.id or not cls.title:
+        raise ValueError(f"rule {cls.__name__} must set id and title")
+    if any(r.id == cls.id for r in _GS_REGISTRY):
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _GS_REGISTRY.append(cls)
+    return cls
+
+
+def all_sharding_rules() -> Tuple[Type[ShardRule], ...]:
+    return tuple(sorted(_GS_REGISTRY, key=lambda r: r.id))
+
+
+def _in_scope(ctx: ShardContext, prefixes: Tuple[str, ...],
+              exempt: Tuple[str, ...] = ()) -> bool:
+    suffix = ctx.package_suffix()
+    if suffix is None:
+        return True
+    if any(suffix == e for e in exempt):
+        return False
+    return any(suffix.startswith(p) for p in prefixes)
+
+
+# --- GS001 ----------------------------------------------------------------
+
+@gs_register
+class PartitionRuleCoverage(ShardRule):
+    """Partition-rule ladder fails exactly-once leaf coverage.
+
+    ``PARTITION_RULES`` must match every committed param-tree leaf
+    (``artifacts/params_tree.json``) exactly once: an unmatched leaf
+    would shard nothing silently, a multiply-matched leaf makes the
+    ladder order-sensitive, a dead rule is a stale regex nobody notices.
+    Runs on any file declaring ``PARTITION_RULES`` (the real
+    ``programs/partitioning.py`` and the fixture corpus alike).
+    """
+
+    id = "GS001"
+    title = "partition-rule-coverage"
+
+    def check(self, ctx: ShardContext) -> Iterable[Diagnostic]:
+        decl = ctx.model.partition_rules
+        if decl is None:
+            return
+        rules = []
+        for entry in decl.entries:
+            if entry.pattern is None or entry.spec is None:
+                yield ctx.diag_at(
+                    entry.line, entry.col, self.id,
+                    "PARTITION_RULES entry is not a literal "
+                    "(regex, spec-tuple) pair — the ladder must stay "
+                    "statically readable data")
+                continue
+            try:
+                re.compile(entry.pattern)
+            except re.error as e:
+                yield ctx.diag_at(
+                    entry.line, entry.col, self.id,
+                    f"invalid partition-rule regex {entry.pattern!r}: {e}")
+                continue
+            if ctx.declared_axes is not None:
+                bad = [a for a in entry.spec
+                       if a is not None and a not in ctx.declared_axes]
+                if bad:
+                    yield ctx.diag_at(
+                        entry.line, entry.col, self.id,
+                        f"partition spec names undeclared mesh axes "
+                        f"{bad} (declared: "
+                        f"{sorted(ctx.declared_axes)})")
+                    continue
+            rules.append((entry, entry.pattern, entry.spec))
+        if ctx.param_leaves is None:
+            yield ctx.diag_at(
+                decl.line, 0, self.id,
+                "param-tree leaf inventory unavailable (regenerate "
+                "artifacts/params_tree.json: python -m pvraft_tpu."
+                "programs params --out artifacts/params_tree.json) — "
+                "coverage cannot be checked")
+            return
+        from pvraft_tpu.programs.partitioning import match_report
+
+        _mapping, unmatched, multi, unused = match_report(
+            [(pat, spec) for _, pat, spec in rules], ctx.param_leaves)
+        for path in unmatched:
+            yield ctx.diag_at(
+                decl.line, 0, self.id,
+                f"param leaf {path!r} matches no partition rule "
+                f"(exactly-once coverage)")
+        for path, pats in multi:
+            yield ctx.diag_at(
+                decl.line, 0, self.id,
+                f"param leaf {path!r} matches {len(pats)} rules "
+                f"({pats}); rules must be disjoint")
+        by_pattern = {pat: entry for entry, pat, _ in rules}
+        for pat in unused:
+            entry = by_pattern[pat]
+            yield ctx.diag_at(
+                entry.line, entry.col, self.id,
+                f"dead partition rule {pat!r}: no param leaf matches it")
+
+
+# --- GS002 ----------------------------------------------------------------
+
+@gs_register
+class MeshAxisDiscipline(ShardRule):
+    """Undeclared mesh-axis name, or a version-fragile in-jit spelling.
+
+    Every literal axis string at a ``PartitionSpec``/``Mesh``/
+    collective call site (and ``mesh.shape["..."]`` lookups) must be an
+    axis ``parallel/mesh.py`` declares — a typo'd axis name surfaces as
+    an unbound-axis trace error only at the first multi-device run.
+    Direct ``lax.axis_size`` use is flagged outside ``compat.py``: the
+    spelling moved between jax versions (the GL004 precedent), and
+    ``pvraft_tpu.compat.axis_size`` is the stable one.
+    """
+
+    id = "GS002"
+    title = "mesh-axis-discipline"
+
+    def check(self, ctx: ShardContext) -> Iterable[Diagnostic]:
+        declared = ctx.declared_axes
+        if declared is not None:
+            for site in ctx.model.axis_sites:
+                if site.axis not in declared:
+                    yield ctx.diag_at(
+                        site.line, site.col, self.id,
+                        f"axis name {site.axis!r} at a {site.api} site "
+                        f"is not declared by parallel/mesh.py (declared: "
+                        f"{sorted(declared)})")
+        if ctx.package_suffix() == "pvraft_tpu/compat.py":
+            return
+        for f in ctx.model.fragile:
+            yield ctx.diag_at(
+                f.line, f.col, self.id,
+                f"direct {f.spelling} (moved between jax versions); "
+                f"use pvraft_tpu.compat.axis_size")
+
+
+# --- GS003 ----------------------------------------------------------------
+
+@gs_register
+class HostMaterializedShardedBatch(ShardRule):
+    """Eager stack of device batches with no multi-process guard.
+
+    ``tree_map(lambda *xs: jnp.stack(xs), *pending)`` materializes a
+    stacked batch EAGERLY: on a multi-host mesh the pending batches are
+    non-fully-addressable global arrays and the stack raises mid-epoch
+    (or worse, silently gathers). Every such site must live in a class
+    (or module) that also carries a ``process_count`` guard — the
+    ``trainer.py`` constructor-raise / ``evaluator.py`` fallback shape —
+    so the ROADMAP item-2 PR that deletes the guards cannot keep the
+    eager stack by accident.
+    """
+
+    id = "GS003"
+    title = "host-materialized-sharded-batch"
+
+    def check(self, ctx: ShardContext) -> Iterable[Diagnostic]:
+        guard_owners = {g.owner for g in ctx.model.process_guards}
+        for site in ctx.model.stack_sites:
+            if site.owner in guard_owners:
+                continue
+            where = (f"class {site.owner}" if site.owner
+                     else "this module")
+            yield ctx.diag_at(
+                site.line, site.col, self.id,
+                f"eager tree_map/jnp.stack of accumulated device "
+                f"batches, but {where} has no process_count guard — "
+                f"on a multi-host mesh the stacked batches are "
+                f"non-addressable global arrays; guard the mode (raise "
+                f"or fall back) or shard the stack through the mesh")
+
+
+# --- GS004 ----------------------------------------------------------------
+
+@gs_register
+class UnguardedProcessZeroIO(ShardRule):
+    """Filesystem write reachable without a process-0 dominator.
+
+    ``engine/`` and ``obs/`` run on every host of a multi-process mesh;
+    a write no ``jax.process_index() == 0`` test dominates runs once
+    per host — concurrent truncations, interleaved JSONL, corrupt
+    checkpoints. Recognized guard shapes: lexical rank-0 ``if`` bodies,
+    terminating guard clauses (``if process_index() != 0: return``),
+    process-0 flag fields (the ``EventLog.enabled`` pattern),
+    single-process proofs (``if process_count() > 1: raise``), and
+    module-local helpers whose every call site is guarded (the
+    ``checkpoint.py`` ``_write``/``_swap_in`` shape).
+    ``os.makedirs(..., exist_ok=True)`` is exempt (idempotent ensure).
+    """
+
+    id = "GS004"
+    title = "unguarded-process0-io"
+
+    _SCOPE = ("pvraft_tpu/engine/", "pvraft_tpu/obs/")
+
+    def check(self, ctx: ShardContext) -> Iterable[Diagnostic]:
+        if not _in_scope(ctx, self._SCOPE):
+            return
+        for site in ctx.model.write_sites:
+            if site.guarded:
+                continue
+            where = (f"{site.owner}.{site.func}" if site.owner
+                     else site.func or "<module>")
+            yield ctx.diag_at(
+                site.line, site.col, self.id,
+                f"{site.call}(...) in {where} is reachable without a "
+                f"dominating jax.process_index() == 0 test — on a "
+                f"multi-process mesh every host runs it; guard the "
+                f"write (early return, rank-0 if, or a process-0 flag "
+                f"field)")
+
+
+# --- GS005 ----------------------------------------------------------------
+
+@gs_register
+class BatchContractConfusion(ShardRule):
+    """Per-host vs global batch arithmetic outside the mesh contract.
+
+    The global/local batch relationship (``global = per_device x
+    mesh_data``, ``local = global / process_count``) lives in
+    ``parallel/mesh.py`` (``batch_contract``/``shard_batch``/
+    ``device_batch``); a literal batch dim scaled by ``process_count``
+    anywhere else re-derives the contract and drifts from it (the
+    historical trainer shape). Direct ``jax.device_put`` /
+    ``make_array_from_process_local_data`` calls in the engine/data/obs
+    planes bypass the one placement path that is multi-host-correct.
+    """
+
+    id = "GS005"
+    title = "batch-contract-confusion"
+
+    _SCOPE = ("pvraft_tpu/engine/", "pvraft_tpu/data/", "pvraft_tpu/obs/")
+    _OWNER = ("pvraft_tpu/parallel/mesh.py",)
+
+    def check(self, ctx: ShardContext) -> Iterable[Diagnostic]:
+        if not _in_scope(ctx, self._SCOPE, exempt=self._OWNER):
+            return
+        for site in ctx.model.batch_arith:
+            yield ctx.diag_at(
+                site.line, site.col, self.id,
+                f"{site.detail} — the per-host/global batch contract "
+                f"lives in parallel/mesh.py (batch_contract); derive "
+                f"the size there instead of re-scaling by "
+                f"process_count here")
+        for site in ctx.model.placements:
+            yield ctx.diag_at(
+                site.line, site.col, self.id,
+                f"direct jax.{site.api}(...) outside parallel/mesh.py "
+                f"— batch placement must route through mesh."
+                f"shard_batch/device_batch (the multi-host-correct "
+                f"path)")

@@ -56,6 +56,7 @@ from pvraft_tpu.obs.device_memory import sample_device_memory
 from pvraft_tpu.obs.divergence import DivergenceHalt
 from pvraft_tpu.obs.retrace import RetraceWatchdog, args_signature
 from pvraft_tpu.parallel.mesh import (
+    batch_contract,
     device_batch,
     eval_scene_shard,
     make_mesh,
@@ -166,21 +167,18 @@ class Trainer:
         # batch_size is PER-DEVICE (the reference's DataParallel splits its
         # global bs=2 across 2 GPUs, tools/engine.py:63-64; here each chip
         # of the mesh data axis gets cfg.train.batch_size samples).
-        n_data = self.mesh.shape["data"]
-        self.global_batch = cfg.train.batch_size * n_data
         # Multi-host: each process loads only the slice of the global batch
         # its local devices consume (PrefetchLoader shard + the
         # make_array_from_process_local_data path in parallel/mesh.py);
         # val/test loaders are scene-sharded per process too when the
         # counts divide evenly (see _eval_shard below), else they feed
         # identical data on every process and replication stays exact.
+        # The global/local split itself is mesh.batch_contract — the one
+        # declaration of the per-host batch relationship (GS005).
+        n_data = self.mesh.shape["data"]
         n_proc = jax.process_count()
-        if self.global_batch % max(1, n_proc) != 0:
-            raise ValueError(
-                f"global batch {self.global_batch} must be a multiple of "
-                f"the process count ({n_proc})"
-            )
-        self.local_batch = self.global_batch // max(1, n_proc)
+        self.global_batch, self.local_batch = batch_contract(
+            cfg.train.batch_size, self.mesh)
         self.log.info(
             f"mesh {dict(self.mesh.shape)}: per-device batch "
             f"{cfg.train.batch_size} -> global batch {self.global_batch}"

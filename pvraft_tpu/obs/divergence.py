@@ -101,16 +101,24 @@ def dump_snapshot(
     loss: float,
     cfg=None,
     extra_meta: Optional[Dict[str, Any]] = None,
-) -> str:
-    """Write one ``pvraft_snapshot/v1`` directory; returns its path.
+) -> Optional[str]:
+    """Write one ``pvraft_snapshot/v1`` directory; returns its path
+    (``None`` on non-zero ranks — snapshot dirs are process-0-only
+    filesystem state, shardcheck GS004; the Trainer additionally never
+    calls this on multi-process meshes, where the global batch is not
+    host-addressable).
 
     ``params``/``opt_state`` must be host numpy trees captured BEFORE the
     offending update (the state the replay needs); ``batch`` the host
     batch that triggered it."""
+    import jax
+
     from flax import serialization
 
     from pvraft_tpu.obs.events import sanitize
 
+    if jax.process_index() != 0:
+        return None
     out = os.path.join(snap_dir, f"step_{step:07d}")
     os.makedirs(out, exist_ok=True)
     np.savez(os.path.join(out, "batch.npz"),

@@ -54,6 +54,26 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def batch_contract(per_device_batch: int, mesh: Mesh) -> tuple:
+    """``(global_batch, local_batch)`` — THE per-host vs global batch
+    relationship, in one place (shardcheck GS005 bans re-deriving it
+    elsewhere): the global batch is ``per_device_batch`` per chip of the
+    mesh data axis; each process loads the slice its local devices
+    consume. Raises when the process count cannot split the global
+    batch evenly — a ragged per-host share would assemble a global
+    array whose rows disagree across hosts."""
+    n_data = mesh.shape[DATA_AXIS]
+    global_batch = per_device_batch * n_data
+    n_proc = max(1, jax.process_count())
+    if global_batch % n_proc != 0:
+        raise ValueError(
+            f"global batch {global_batch} (= {per_device_batch}/device x "
+            f"{n_data} devices) must be a multiple of the process count "
+            f"({n_proc})"
+        )
+    return global_batch, global_batch // n_proc
+
+
 def eval_scene_shard(n_scenes: int, eval_batch: int, mesh: Mesh) -> tuple:
     """``(rank, world)`` for scene-sharding an eval loader across processes.
 

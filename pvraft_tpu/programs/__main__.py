@@ -1,4 +1,5 @@
-"""CLI: ``python -m pvraft_tpu.programs {list,describe,verify,compile,costs}``.
+"""CLI: ``python -m pvraft_tpu.programs
+{list,describe,verify,compile,costs,params}``.
 
 ``list`` renders the program inventory (no tracing — safe anywhere,
 golden-pinned by ``tests/test_programs.py`` against the committed
@@ -11,6 +12,9 @@ specs; ``--tag kernel`` lowers every Pallas entry point through the
 real Mosaic pipeline so toolchain drift fails the gate loudly.
 ``costs`` builds (or, with ``--check``, validates) the registry-wide
 ``pvraft_costs/v1`` cost/HBM inventory (``programs/costs.py``).
+``params`` caches the registry's eval_shape param tree as the jax-free
+``pvraft_params_tree/v1`` leaf inventory the shardcheck engine (GS001)
+and the pod planner join against (``programs/partitioning.py``).
 """
 
 from __future__ import annotations
@@ -231,6 +235,50 @@ def _cmd_costs(args) -> int:
     return 0 if rec["ok"] else 1
 
 
+def _cmd_params(args) -> int:
+    """The ``pvraft_params_tree/v1`` leaf inventory: the registry's
+    eval_shape param tree cached jax-free for the shardcheck engine and
+    the pod planner. ``--check`` regenerates and compares (the
+    programs_list.txt drift discipline)."""
+    from pvraft_tpu.programs.partitioning import (
+        build_params_tree,
+        load_params_tree,
+    )
+
+    if args.check:
+        try:
+            committed = load_params_tree(args.check)
+        except (OSError, ValueError) as e:
+            print(f"{args.check}: {e}", file=sys.stderr)
+            return 1
+        fresh = build_params_tree()
+        if committed != fresh:
+            drift = [k for k in sorted(set(committed) | set(fresh))
+                     if committed.get(k) != fresh.get(k)]
+            print(f"{args.check}: committed param-tree inventory drifted "
+                  f"from the registry's eval_shape tree (differing keys: "
+                  f"{', '.join(drift)}) — regenerate: python -m "
+                  f"pvraft_tpu.programs params --out {args.check}",
+                  file=sys.stderr)
+            return 1
+        print(f"{args.check}: OK (matches the registry's eval_shape "
+              f"param tree, {committed['total_parameters']} parameters)")
+        return 0
+    doc = build_params_tree()
+    if args.out:
+        import os
+
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.out} ({len(doc['leaves'])} leaves, "
+              f"{doc['total_parameters']} parameters)", file=sys.stderr)
+    else:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pvraft_tpu.programs",
@@ -300,6 +348,17 @@ def main(argv=None) -> int:
                          help="exit 0 (loudly) when libtpu cannot provide "
                               "the compile topology")
     p_costs.set_defaults(fn=_cmd_costs)
+
+    p_par = sub.add_parser(
+        "params",
+        help="pvraft_params_tree/v1 leaf inventory from the registry's "
+             "eval_shape param tree (or --check a committed artifact)")
+    p_par.add_argument("--out", default="",
+                       help="write the inventory artifact (JSON)")
+    p_par.add_argument("--check", default="", metavar="ARTIFACT",
+                       help="regenerate the inventory and compare against "
+                            "a committed artifact (exit 1 on drift)")
+    p_par.set_defaults(fn=_cmd_params)
 
     args = parser.parse_args(argv)
     return args.fn(args)

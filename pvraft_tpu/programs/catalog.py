@@ -188,18 +188,26 @@ for _tag, _kwargs, _kinds, _expect in _FLAGSHIP_VARIANTS:
           description="2x2 dp x sp sharded train step (ring correlation)")
 def _dp_sp(devices=None):
     """Batch over ``data``, points over ``seq`` (ring correlation),
-    params replicated — collectives must lower for the v5e slice. With
+    params placed by the declared ``PARTITION_RULES`` ladder — the
+    registry spec and ``programs/partitioning.py`` cannot drift, and a
+    param leaf no rule covers fails the build (exactly-once coverage,
+    shardcheck GS001). Collectives must lower for the v5e slice. With
     no devices (the verify/trace path) the mesh degrades to whatever the
     host offers, the same discipline as the ring audit entries."""
     import jax
     import numpy as np
     import optax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from pvraft_tpu.config import ModelConfig
     from pvraft_tpu.engine.loss import sequence_loss
     from pvraft_tpu.models import PVRaft
     from pvraft_tpu.parallel.mesh import make_mesh
+    from pvraft_tpu.programs.partitioning import (
+        BATCH_PARTITION,
+        PARTITION_RULES,
+        match_partition_rules,
+    )
 
     if devices is not None:
         mesh = make_mesh(n_data=2, n_seq=2, devices=list(devices)[:4])
@@ -209,7 +217,7 @@ def _dp_sp(devices=None):
         n_data = 2 if len(local) >= 2 * n_seq else 1
         mesh = make_mesh(n_data=n_data, n_seq=n_seq)
     rep = NamedSharding(mesh, P())
-    batch_s = NamedSharding(mesh, P("data", "seq"))
+    batch_s = NamedSharding(mesh, P(*BATCH_PARTITION))
     b, n = g.FLAGSHIP_BATCH, g.FLAGSHIP_POINTS
     iters, k = g.FLAGSHIP_ITERS, g.FLAGSHIP_TRUNCATE_K
 
@@ -221,10 +229,24 @@ def _dp_sp(devices=None):
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             tree)
 
-    params = shard(_abstract_params(model, b, max(256, k)), rep)
+    def leaf_key(path) -> str:
+        return "/".join(str(getattr(kk, "key", kk)) for kk in path)
+
+    params_abs = _abstract_params(model, b, max(256, k))
+    flat_paths = [leaf_key(p) for p, _ in
+                  jax.tree_util.tree_flatten_with_path(params_abs)[0]]
+    spec_of = match_partition_rules(PARTITION_RULES, flat_paths)
+    params = jax.tree_util.tree_map_with_path(
+        lambda p, x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype,
+            sharding=NamedSharding(mesh, P(*spec_of[leaf_key(p)]))),
+        params_abs)
     pc = jax.ShapeDtypeStruct((b, n, 3), np.float32, sharding=batch_s)
     mask = jax.ShapeDtypeStruct((b, n), np.float32, sharding=batch_s)
     tx = optax.adam(1e-3)
+    # Optimizer state replicates while every PARTITION_RULES spec does;
+    # the first rule that shards a leaf must mirror the ladder over the
+    # adam mu/nu trees here (their inner paths repeat the param paths).
     opt_state = shard(jax.eval_shape(tx.init, params), rep)
 
     def train_step(p, o, a, c, m, gt):

@@ -1,0 +1,246 @@
+"""Declared parameter-partitioning data: the pod-scale sharding rules.
+
+The ``match_partition_rules`` regex-ladder idiom (SNIPPETS.md [2], the
+pjit exemplar ROADMAP item 2 names) with one discipline tightened: every
+param-tree leaf must match **exactly one** rule. A first-match-wins
+ladder silently changes meaning when someone reorders it; disjoint
+rules + the shardcheck GS001 gate make coverage drift (a new module
+whose leaves no rule names, or two rules fighting over one leaf) a
+static finding instead of a mesh-shaped runtime surprise.
+
+Pure data + pure-string matching — imports nothing heavy (no jax), so
+the shardcheck engine and the pod planner read it jax-free, the same
+contract as :mod:`pvraft_tpu.programs.geometries`. The jax consumers:
+
+* ``programs/catalog.py`` ``dp_sp_2x2_train_step`` builds its param
+  NamedShardings from THESE rules (the registry spec and this module
+  cannot drift — AST-guarded by ``tests/test_shardcheck.py``);
+* ``python -m pvraft_tpu.analysis sharding --plan`` joins the rules
+  with the committed param-tree inventory into per-device byte
+  accounting (``artifacts/pod_plan.json``).
+
+A rule is ``(regex, spec)``: ``re.search`` over the ``/``-joined leaf
+path, spec a tuple of mesh axis names (or ``None``) per array dim —
+``()`` replicates. Today every leaf replicates (the model is ~1 MB;
+batch/activation sharding is where the pod memory goes — see the pod
+plan); the ladder still splits the tree by module so the first leaf
+that SHOULD shard (a future wide encoder) has a rule slot to land in.
+
+The leaf inventory the rules are checked against is committed jax-free
+as ``artifacts/params_tree.json`` (``pvraft_params_tree/v1``),
+regenerated from the registry's eval_shape param tree by
+``python -m pvraft_tpu.programs params`` and drift-pinned both by a
+``scripts/lint.sh`` stage and by ``tests/test_programs.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+PARAMS_TREE_SCHEMA = "pvraft_params_tree/v1"
+
+# Mesh axes a spec may name — mirrors parallel/mesh.py's (data, seq)
+# builder; shardcheck GS002 checks the literal spellings at every
+# collective/PartitionSpec call site against the same declaration.
+MESH_AXES = ("data", "seq")
+
+# Batch arrays (B, N, ...): batch over data, points over seq — the spec
+# every sharded step puts on pc1/pc2/mask/gt (catalog dp_sp_2x2).
+BATCH_PARTITION = ("data", "seq")
+
+# The exactly-once ladder over the flagship PVRaft param tree (95
+# leaves, see artifacts/params_tree.json). Disjoint by construction:
+# the three anchored prefixes partition the module tree.
+PARTITION_RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
+    # Twin SetConv encoder stacks (feature + context): small dense
+    # kernels and GroupNorm scales — replicate.
+    (r"^params/(feature|context)_extractor/", ()),
+    # Correlation-lookup head (voxel + knn branches) — replicate.
+    (r"^params/update_iter/corr_lookup/", ()),
+    # Motion encoder + ConvGRU + flow head — replicate.
+    (r"^params/update_iter/update_block/", ()),
+)
+
+
+def match_report(
+    rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]],
+    leaf_paths: Sequence[str],
+) -> Tuple[Dict[str, Tuple[Optional[str], ...]], List[str],
+           List[Tuple[str, List[str]]], List[str]]:
+    """THE matching semantics, shared by the catalog wiring, GS001 and
+    the planner: ``(mapping, unmatched, multi, unused)`` where
+    ``mapping`` is leaf path -> spec for exactly-once leaves,
+    ``unmatched``/``multi`` list the leaves that break the discipline
+    (``multi`` with the offending regexes) and ``unused`` the dead
+    rules no leaf matches."""
+    compiled = [(pat, re.compile(pat), spec) for pat, spec in rules]
+    mapping: Dict[str, Tuple[Optional[str], ...]] = {}
+    unmatched: List[str] = []
+    multi: List[Tuple[str, List[str]]] = []
+    used = set()
+    for path in leaf_paths:
+        hits = [(pat, spec) for pat, rx, spec in compiled if rx.search(path)]
+        used.update(pat for pat, _ in hits)
+        if not hits:
+            unmatched.append(path)
+        elif len(hits) > 1:
+            multi.append((path, [pat for pat, _ in hits]))
+        else:
+            mapping[path] = hits[0][1]
+    # Dead = matches NOTHING (a rule whose only hits are multi-matched
+    # leaves is already reported through `multi`, not here).
+    unused = [pat for pat, _, _ in compiled if pat not in used]
+    return mapping, unmatched, multi, unused
+
+
+def match_partition_rules(
+    rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]],
+    leaf_paths: Sequence[str],
+) -> Dict[str, Tuple[Optional[str], ...]]:
+    """Leaf path -> partition spec, or raise on any coverage violation
+    (the strict entry the catalog uses — a spec built from a broken
+    ladder must fail at build, not shard half a tree)."""
+    mapping, unmatched, multi, _unused = match_report(rules, leaf_paths)
+    problems = []
+    for path in unmatched:
+        problems.append(f"no partition rule matches leaf {path!r}")
+    for path, pats in multi:
+        problems.append(
+            f"leaf {path!r} matched {len(pats)} rules ({pats}); rules "
+            f"must be disjoint (exactly-once discipline)")
+    if problems:
+        raise ValueError("partition-rule coverage: " + "; ".join(problems))
+    return mapping
+
+
+# --- the committed leaf inventory (jax-free read side) ---------------------
+
+def load_params_tree(path: str) -> Dict[str, Any]:
+    """Read + validate a committed ``pvraft_params_tree/v1`` artifact."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    problems = validate_params_tree(doc)
+    if problems:
+        raise ValueError(f"{path}: " + "; ".join(problems))
+    return doc
+
+
+def validate_params_tree(doc: Any) -> List[str]:
+    """Schema problems of a params-tree document ([] = valid)."""
+    if not isinstance(doc, dict):
+        return [f"artifact is {type(doc).__name__}, not an object"]
+    problems = []
+    if doc.get("schema") != PARAMS_TREE_SCHEMA:
+        problems.append(
+            f"schema {doc.get('schema')!r} != {PARAMS_TREE_SCHEMA!r}")
+    leaves = doc.get("leaves")
+    if not isinstance(leaves, list) or not leaves:
+        return problems + ["leaves: missing or empty"]
+    seen = set()
+    n_params = 0
+    n_bytes = 0
+    for i, leaf in enumerate(leaves):
+        if not isinstance(leaf, dict):
+            problems.append(f"leaves[{i}]: not an object")
+            continue
+        path = leaf.get("path")
+        shape = leaf.get("shape")
+        if not isinstance(path, str) or not path:
+            problems.append(f"leaves[{i}]: missing path")
+            continue
+        if path in seen:
+            problems.append(f"leaves[{i}]: duplicate path {path!r}")
+        seen.add(path)
+        if (not isinstance(shape, list)
+                or any(not isinstance(d, int) or d < 0 for d in shape)):
+            problems.append(f"{path}: shape must be a list of ints >= 0")
+            continue
+        count = 1
+        for d in shape:
+            count *= d
+        n_params += count
+        n_bytes += count * _dtype_bytes(leaf.get("dtype", "float32"))
+    if list(sorted(l.get("path", "") for l in leaves
+                   if isinstance(l, dict))) != \
+            [l.get("path", "") for l in leaves if isinstance(l, dict)]:
+        problems.append("leaves must be sorted by path (deterministic "
+                        "artifact; regenerate)")
+    if doc.get("total_parameters") != n_params:
+        problems.append(
+            f"total_parameters {doc.get('total_parameters')} != recomputed "
+            f"{n_params}")
+    if doc.get("total_bytes") != n_bytes:
+        problems.append(
+            f"total_bytes {doc.get('total_bytes')} != recomputed {n_bytes}")
+    return problems
+
+
+def _dtype_bytes(dtype: str) -> int:
+    return {"float32": 4, "bfloat16": 2, "float16": 2, "int32": 4,
+            "int8": 1, "uint8": 1, "bool": 1, "float64": 8}.get(dtype, 4)
+
+
+def leaf_bytes(leaf: Dict[str, Any]) -> int:
+    count = 1
+    for d in leaf["shape"]:
+        count *= d
+    return count * _dtype_bytes(leaf.get("dtype", "float32"))
+
+
+def shard_factor(spec: Sequence[Optional[str]],
+                 mesh_shape: Dict[str, int]) -> int:
+    """How many ways a leaf with ``spec`` splits on a mesh: the product
+    of the named axes' sizes (``()`` / all-None = 1 = replicated)."""
+    factor = 1
+    for axis in spec:
+        if axis is not None:
+            factor *= int(mesh_shape.get(axis, 1))
+    return factor
+
+
+# --- inventory generation (the one jax-touching corner) --------------------
+
+def build_params_tree() -> Dict[str, Any]:
+    """The ``pvraft_params_tree/v1`` document from the registry's OWN
+    eval_shape param tree (``catalog._abstract_params`` at the flagship
+    geometry — the exact tree ``dp_sp_2x2_train_step`` shards). Needs
+    jax; the committed artifact is the jax-free cache every other
+    consumer reads."""
+    import jax
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.models import PVRaft
+    from pvraft_tpu.programs import geometries as g
+    from pvraft_tpu.programs.catalog import _abstract_params
+
+    cfg = ModelConfig(truncate_k=g.FLAGSHIP_TRUNCATE_K)
+    params = _abstract_params(
+        PVRaft(cfg), g.FLAGSHIP_BATCH, max(256, g.FLAGSHIP_TRUNCATE_K))
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    leaves = sorted(
+        ({
+            "path": "/".join(str(getattr(k, "key", k)) for k in path),
+            "shape": [int(d) for d in leaf.shape],
+            "dtype": str(leaf.dtype),
+        } for path, leaf in flat),
+        key=lambda l: l["path"],
+    )
+    doc = {
+        "schema": PARAMS_TREE_SCHEMA,
+        "model": "PVRaft",
+        "truncate_k": g.FLAGSHIP_TRUNCATE_K,
+        "leaves": leaves,
+        "total_parameters": sum(
+            _count(l["shape"]) for l in leaves),
+        "total_bytes": sum(leaf_bytes(l) for l in leaves),
+    }
+    return doc
+
+
+def _count(shape: Sequence[int]) -> int:
+    count = 1
+    for d in shape:
+        count *= d
+    return count

@@ -48,6 +48,10 @@ BUDGETS = (
     # few demand rows + per-bucket pricing, regenerate-and-compare
     # pinned — growth means the planner started dumping raw inputs.
     ("artifacts/capacity_report.json", 32 * 1024),
+    # The pod memory/comms plan (pvraft_pod_plan/v1): 4 meshes x 4
+    # scenes of per-device byte rows + the cross-check, regenerate-and-
+    # compare pinned by lint.sh — same growth rule as kernel_plan.
+    ("artifacts/pod_plan.json", 32 * 1024),
     # Calibration evidence (pvraft_cost_calibration/v1): per-(bucket,
     # batch, dtype) summary rows + the identity ledger, never raw
     # per-dispatch samples (those ride the events stream).

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Static-analysis gate: graftlint AST rules, threadcheck, kernelcheck,
-# the registry verify/deepcheck/Mosaic-compile legs and the committed-
-# artifact validators. Runs before training jobs (run.sh) and as the
+# shardcheck, the registry verify/deepcheck/Mosaic-compile legs and the
+# committed-artifact validators. Runs before training jobs (run.sh) and as the
 # standing gate for kernel/sharding PRs (ROADMAP.md). Exits non-zero on
 # any finding.
 set -e
@@ -52,6 +52,28 @@ echo "== kernelcheck: committed VMEM/roofline plan matches the static model"
 # honest before the kernel is written (ROADMAP item 1).
 python -m pvraft_tpu.analysis kernels --check artifacts/kernel_plan.json
 
+echo "== shardcheck: SPMD/multi-host static analysis (GS rules) over the multi-process planes"
+# The fifth analysis engine (ISSUE 15): partition-rule exactly-once
+# coverage vs the committed param-tree inventory (GS001), mesh-axis
+# discipline at PartitionSpec/collective sites incl. the compat.py
+# routing of fragile in-jit spellings (GS002), the eager-stack-of-
+# sharded-batches idiom behind the multi-process guards (GS003),
+# unguarded process-0 I/O in engine/+obs/ (GS004), and batch-contract
+# arithmetic outside parallel/mesh.py (GS005). Zero findings on the
+# clean tree — real violations get fixed (the deepcheck precedent),
+# not pragma'd. Pure stdlib AST + the jax-free data planes; no jax.
+python -m pvraft_tpu.analysis sharding
+
+echo "== shardcheck: committed pod memory/comms plan matches the declared inputs"
+# artifacts/pod_plan.json (pvraft_pod_plan/v1) is a pure function of
+# PARTITION_RULES x artifacts/params_tree.json x programs_costs.json x
+# the candidate (dp, sp) meshes: this regenerates and compares,
+# enforcing on the way that the byte model's estimate for the REAL
+# compiled dp_sp_2x2_train_step stays inside the pinned band of its
+# live_bytes_estimate — the committed answer to "which mesh does a
+# 100k-point scene train on" that ROADMAP item 2 cites.
+python -m pvraft_tpu.analysis sharding --check artifacts/pod_plan.json
+
 echo "== programs: committed kernel-compile evidence covers the kernel tag"
 # artifacts/programs_kernels.json must name exactly the kernel-tagged
 # registry specs, each with a successful Mosaic compile record — both
@@ -74,6 +96,16 @@ echo "== programs: registry-wide eval_shape verify (zero-FLOP abstract traces)"
 # CPU pin: shape propagation needs no accelerator and must not grab one.
 JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
   python -m pvraft_tpu.programs verify
+
+echo "== programs: committed param-tree inventory matches the registry's eval_shape tree"
+# artifacts/params_tree.json (pvraft_params_tree/v1) is the jax-free
+# cache of the flagship param tree the GS001 gate and the pod planner
+# join against; one eval_shape regenerates it here and compares (the
+# programs_list.txt discipline — a model change that moves a leaf
+# regenerates a different inventory, and the stale committed plan
+# fails the shardcheck compare stage above instead of rotting green).
+JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
+  python -m pvraft_tpu.programs params --check artifacts/params_tree.json
 
 echo "== deepcheck: jaxpr-level semantic analysis (GJ rules) over the audit corpus"
 # Traces every registered audit entry to a ClosedJaxpr and checks

@@ -1,0 +1,7 @@
+"""GS001 green: a disjoint ladder covering every injected leaf once
+(inventory: ``params/enc/kernel``, ``params/head/kernel``)."""
+
+PARTITION_RULES = (
+    (r"^params/enc/", ()),
+    (r"^params/head/", ("data", None)),
+)

@@ -1,0 +1,11 @@
+"""GS001 red: an overlapping ladder plus a dead rule.
+
+The test injects the leaf inventory ``params/enc/kernel``,
+``params/head/kernel``: the catch-all second rule overlaps the first
+(multiply-matched leaf), and the third rule matches nothing (dead)."""
+
+PARTITION_RULES = (
+    (r"^params/enc/", ()),
+    (r"^params/", ("data", None)),
+    (r"^params/never/", ()),
+)

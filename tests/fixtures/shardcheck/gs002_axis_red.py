@@ -1,0 +1,13 @@
+"""GS002 red: an undeclared mesh-axis name and a fragile in-jit
+spelling (declared axes in the test: {"data", "seq"})."""
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def bad_specs(x, mesh):
+    spec = P("model", None)              # "model" is not a declared axis
+    n = mesh.shape["model"]              # neither is this lookup
+    folded = lax.psum(x, "tensor")       # nor this collective's axis
+    size = lax.axis_size("seq")          # fragile: use compat.axis_size
+    return spec, n, folded, size

@@ -1,0 +1,28 @@
+"""GS003 green: the current guarded shape — the eager stack stays, but
+the class refuses to construct the fused mode on multi-process meshes
+(the `trainer.py:100` constructor raise)."""
+
+import jax
+import jax.numpy as jnp
+
+
+class FusedTrainer:
+    def __init__(self, steps_per_dispatch):
+        if steps_per_dispatch > 1 and jax.process_count() > 1:
+            raise ValueError(
+                "steps_per_dispatch > 1 is single-process only (the "
+                "fused mode stacks sharded device batches eagerly)"
+            )
+        self.steps_per_dispatch = steps_per_dispatch
+
+    def training(self, stream, multi_step, flat):
+        pending = []
+        for b in stream:
+            pending.append(b)
+            if len(pending) == self.steps_per_dispatch:
+                batches = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *pending
+                )
+                pending = []
+                flat, _ = multi_step(flat, batches)
+        return flat

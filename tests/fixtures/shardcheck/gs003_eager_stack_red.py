@@ -1,0 +1,26 @@
+"""GS003 red: the PR 2 fused-dispatch trainer shape BEFORE the
+multi-process guard (the exact bug `trainer.py:100` now guards): K
+device batches stacked EAGERLY — on a multi-host mesh those are
+non-fully-addressable global arrays and the stack raises mid-epoch."""
+
+import jax
+import jax.numpy as jnp
+
+
+class FusedTrainer:
+    def __init__(self, steps_per_dispatch):
+        # No process_count guard anywhere in the class: deleting the
+        # real trainer's constructor raise reintroduces this shape.
+        self.steps_per_dispatch = steps_per_dispatch
+
+    def training(self, stream, multi_step, flat):
+        pending = []
+        for b in stream:
+            pending.append(b)
+            if len(pending) == self.steps_per_dispatch:
+                batches = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *pending
+                )
+                pending = []
+                flat, _ = multi_step(flat, batches)
+        return flat

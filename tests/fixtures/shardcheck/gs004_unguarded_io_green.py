@@ -1,0 +1,55 @@
+"""GS004 green: every recognized guard shape at once — guard clause,
+rank-0 ``if`` body, process-0 flag field (the ``EventLog.enabled``
+pattern), single-process proof, and a module-local helper whose every
+call site is guarded (the ``checkpoint.py`` ``_write`` shape)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+
+
+def _write(path, payload):
+    # Helper with no guard of its own: dominated because its only call
+    # sites sit under rank-0 tests.
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def guard_clause(snap_dir, batch):
+    if jax.process_index() != 0:
+        return None
+    np.savez(os.path.join(snap_dir, "batch.npz"), **batch)
+    return snap_dir
+
+
+def rank0_body(path, payload):
+    if jax.process_index() == 0:
+        _write(path, payload)
+
+
+def single_process_proof(dump_dir, rows):
+    if dump_dir is not None and jax.process_count() > 1:
+        raise ValueError("dumping is single-host only")
+    for i, row in enumerate(rows):
+        np.save(os.path.join(dump_dir, f"{i}.npy"), row)
+
+
+class EventSink:
+    def __init__(self, path, enabled=None):
+        if enabled is None:
+            enabled = jax.process_index() == 0
+        self.enabled = bool(enabled)
+        self.path = path
+        if self.enabled:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write("")
+
+    def emit(self, record):
+        if not self.enabled:
+            return
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(record) + "\n")

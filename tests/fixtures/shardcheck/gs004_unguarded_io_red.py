@@ -1,0 +1,21 @@
+"""GS004 red: the pre-fix ``dump_snapshot`` shape — snapshot-dir
+writes a module-level function performs with no process-0 dominator
+(every host of a multi-process mesh would write the same paths)."""
+
+import json
+import os
+
+import numpy as np
+
+
+def dump_snapshot(snap_dir, batch, meta):
+    out = os.path.join(snap_dir, "step_0000001")
+    os.makedirs(out, exist_ok=True)          # exempt: idempotent ensure
+    np.savez(os.path.join(out, "batch.npz"), **batch)        # unguarded
+    tmp = os.path.join(out, "state.tmp")
+    with open(tmp, "wb") as f:                               # unguarded
+        f.write(b"state")
+    os.replace(tmp, os.path.join(out, "state.msgpack"))      # unguarded
+    with open(os.path.join(out, "meta.json"), "w") as f:     # unguarded
+        json.dump(meta, f)
+    return out

@@ -1,0 +1,16 @@
+"""GS005 red: the historical trainer shape — the per-host/global batch
+relationship re-derived with ad-hoc process_count arithmetic, plus a
+direct device placement that bypasses mesh.shard_batch/device_batch."""
+
+import jax
+
+
+class BadTrainer:
+    def __init__(self, per_device_batch, mesh, sharding):
+        n_proc = jax.process_count()
+        self.global_batch = per_device_batch * mesh.ndev
+        self.local_batch = self.global_batch // max(1, n_proc)  # GS005
+        self.sharding = sharding
+
+    def place(self, batch):
+        return jax.device_put(batch, self.sharding)             # GS005

@@ -299,3 +299,50 @@ def test_certified_serve_geometries_are_registered():
     for s in specs.values():
         assert s.donate_argnums == g.SERVE_PREDICT_DONATE
         assert s.topology == g.TOPOLOGY
+
+
+def test_params_tree_artifact_pinned_both_directions():
+    """The committed pvraft_params_tree/v1 inventory IS the registry's
+    eval_shape param tree (regenerate via `python -m pvraft_tpu.programs
+    params --out artifacts/params_tree.json`) — the jax-free cache the
+    shardcheck GS001 gate and the pod planner read; drift in either
+    direction (a model change, a hand-edit) fails here."""
+    from pvraft_tpu.programs.partitioning import (
+        build_params_tree,
+        load_params_tree,
+    )
+
+    committed = load_params_tree(
+        os.path.join(REPO, "artifacts", "params_tree.json"))
+    fresh = build_params_tree()
+    assert committed == fresh, (
+        "artifacts/params_tree.json drifted from the registry's "
+        "eval_shape param tree — regenerate it (and then the pod plan: "
+        "python -m pvraft_tpu.analysis sharding --plan --out "
+        "artifacts/pod_plan.json)")
+
+
+def test_dp_sp_spec_consumes_partition_rules():
+    """Single-source discipline (satellite of ISSUE 15): the sharded
+    registry spec builds its param shardings from the declared
+    PARTITION_RULES — every leaf of its param tree carries a sharding
+    whose spec matches the ladder's answer for that path."""
+    import jax
+
+    from pvraft_tpu.programs import get
+    from pvraft_tpu.programs.partitioning import (
+        PARTITION_RULES,
+        match_partition_rules,
+    )
+
+    _fn, args = get("dp_sp_2x2_train_step").build()
+    params = args[0]
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    paths = ["/".join(str(getattr(k, "key", k)) for k in p)
+             for p, _ in flat]
+    spec_of = match_partition_rules(PARTITION_RULES, paths)
+    for path, leaf in zip(paths, (l for _, l in flat)):
+        want = tuple(spec_of[path])
+        got = tuple(leaf.sharding.spec)
+        assert got == want or (want == () and got in ((), (None,))), \
+            f"{path}: sharding spec {got} != rules answer {want}"

@@ -1,0 +1,443 @@
+"""shardcheck: model extraction vs the real call sites, GS rules
+red/green over the fixture corpus (incl. the PR-2 pre-guard eager-stack
+shape pinned DETECTED), the pragma grammar + `lint --stats` GS debt,
+the clean-tree zero-findings gate, the CLI, and the pod planner
+(schema, drift detection, fits-verdict pins, the sharded-step
+cross-check). Pure host-side — no jax import (tier-1 on CPU)."""
+
+import ast
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+from pvraft_tpu.analysis.__main__ import main as analysis_main
+from pvraft_tpu.analysis.engine import known_rule_ids
+from pvraft_tpu.analysis.sharding.check import (
+    check_paths,
+    check_source,
+    declared_axes,
+    default_param_leaves,
+    default_scope,
+)
+from pvraft_tpu.analysis.sharding.model import build_module_shard_model
+from pvraft_tpu.analysis.sharding.planner import (
+    CANDIDATE_MESHES,
+    CROSS_CHECK_BAND,
+    PLAN_SCHEMA,
+    SCENE_POINTS,
+    build_plan,
+    check_plan_file,
+    param_bytes_per_device,
+    ring_comms,
+)
+from pvraft_tpu.analysis.sharding.rules import all_sharding_rules
+from pvraft_tpu.programs.partitioning import (
+    PARTITION_RULES,
+    load_params_tree,
+    match_partition_rules,
+    match_report,
+    validate_params_tree,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "shardcheck")
+COSTS = os.path.join(REPO, "artifacts", "programs_costs.json")
+PARAMS = os.path.join(REPO, "artifacts", "params_tree.json")
+PLAN = os.path.join(REPO, "artifacts", "pod_plan.json")
+
+AXES = {"data", "seq"}
+LEAVES = ["params/enc/kernel", "params/head/kernel"]
+
+
+def fixture_ids(name, leaves=LEAVES):
+    path = os.path.join(FIXTURES, name)
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return [d.rule_id for d in check_source(
+        src, path=path, declared=AXES, param_leaves=leaves)]
+
+
+# --- model extraction -------------------------------------------------------
+
+def test_declared_axes_are_the_mesh_builders():
+    assert declared_axes() == {"data", "seq"}
+
+
+def test_real_ring_module_axis_sites():
+    """ring.py's shard_map specs and mesh.shape lookups all spell the
+    declared `seq`/`data` axes — the sites GS002 would anchor at."""
+    path = os.path.join(REPO, "pvraft_tpu", "parallel", "ring.py")
+    with open(path, "r", encoding="utf-8") as f:
+        model = build_module_shard_model(ast.parse(f.read()))
+    axes = {s.axis for s in model.axis_sites}
+    assert axes and axes <= {"data", "seq"}
+    apis = {s.api for s in model.axis_sites}
+    assert "PartitionSpec" in apis
+    assert "mesh.shape" in apis
+    assert model.fragile == []  # axis_size routes through compat
+
+
+def test_real_trainer_model_guard_and_stack():
+    """The trainer's eager-stack site and its constructor guard are
+    both extracted and associated with the same class — the pairing
+    GS003 enforces."""
+    path = os.path.join(REPO, "pvraft_tpu", "engine", "trainer.py")
+    with open(path, "r", encoding="utf-8") as f:
+        model = build_module_shard_model(ast.parse(f.read()))
+    assert any(s.owner == "Trainer" for s in model.stack_sites)
+    assert any(g.owner == "Trainer" for g in model.process_guards)
+    assert model.batch_arith == []  # the batch contract moved to mesh.py
+
+
+def test_real_checkpoint_writes_all_guarded():
+    """checkpoint.py's helper chain (_write/_swap_in/_promote_ckpt/
+    _copy_extras) is guard-dominated through its call sites — the
+    interprocedural half of the GS004 model."""
+    path = os.path.join(REPO, "pvraft_tpu", "engine", "checkpoint.py")
+    with open(path, "r", encoding="utf-8") as f:
+        model = build_module_shard_model(ast.parse(f.read()))
+    unguarded = [w for w in model.write_sites if not w.guarded]
+    assert unguarded == []
+    assert len(model.write_sites) >= 10  # the chain is actually modeled
+
+
+# --- partition-rule matching ------------------------------------------------
+
+def test_match_report_semantics():
+    rules = ((r"^a/", ()), (r"^b/", ("data",)), (r"^dead/", ()))
+    mapping, unmatched, multi, unused = match_report(
+        rules, ["a/x", "b/y", "c/z"])
+    assert mapping == {"a/x": (), "b/y": ("data",)}
+    assert unmatched == ["c/z"]
+    assert multi == []
+    assert unused == [r"^dead/"]
+
+
+def test_match_partition_rules_raises_on_violations():
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules(((r"^a/", ()),), ["b/x"])
+    with pytest.raises(ValueError, match="matched 2 rules"):
+        match_partition_rules(((r"^a/", ()), (r"a/x", ())), ["a/x"])
+
+
+def test_committed_rules_cover_committed_inventory_exactly_once():
+    """THE GS001 invariant, asserted directly against both committed
+    data planes."""
+    doc = load_params_tree(PARAMS)
+    paths = [leaf["path"] for leaf in doc["leaves"]]
+    mapping = match_partition_rules(PARTITION_RULES, paths)
+    assert len(mapping) == len(paths) == 95
+
+
+def test_params_tree_validator_red():
+    doc = json.loads(open(PARAMS, encoding="utf-8").read())
+    assert validate_params_tree(doc) == []
+    bad = dict(doc, total_parameters=doc["total_parameters"] + 1)
+    assert any("total_parameters" in p for p in validate_params_tree(bad))
+    assert validate_params_tree({"schema": "nope"})
+
+
+def test_catalog_declares_no_axis_literals():
+    """Satellite single-source guard (the serve bucket-literal ban
+    precedent): catalog.py builds every PartitionSpec from
+    partitioning.py data — no inline axis-name strings in P() calls."""
+    path = os.path.join(REPO, "pvraft_tpu", "programs", "catalog.py")
+    with open(path, "r", encoding="utf-8") as f:
+        model = build_module_shard_model(ast.parse(f.read()))
+    literal_axes = [s for s in model.axis_sites
+                    if s.api == "PartitionSpec"]
+    assert literal_axes == [], (
+        "programs/catalog.py grew inline PartitionSpec axis literals; "
+        "route them through programs/partitioning.py "
+        f"({[(s.line, s.axis) for s in literal_axes]})")
+
+
+# --- per-rule red/green -----------------------------------------------------
+
+def test_gs001_red_green():
+    ids = fixture_ids("gs001_coverage_red.py")
+    assert ids.count("GS001") >= 2
+    assert fixture_ids("gs001_coverage_green.py") == []
+
+
+def test_gs001_reports_missing_inventory():
+    ds = check_source("PARTITION_RULES = ((r'^a', ()),)\n",
+                      declared=AXES, param_leaves=None)
+    assert [d.rule_id for d in ds] == ["GS001"]
+    assert "inventory unavailable" in ds[0].message
+
+
+def test_gs002_red():
+    ids = fixture_ids("gs002_axis_red.py")
+    assert ids == ["GS002"] * 4
+
+
+def test_gs003_pr2_eager_stack_red_green():
+    """The pre-guard PR-2 fused-dispatch shape is DETECTED; the current
+    guarded shape is clean (the ROADMAP item-2 contract)."""
+    assert fixture_ids("gs003_eager_stack_red.py") == ["GS003"]
+    assert fixture_ids("gs003_eager_stack_green.py") == []
+
+
+def test_gs004_red_green():
+    ids = fixture_ids("gs004_unguarded_io_red.py")
+    assert ids == ["GS004"] * 4
+    assert fixture_ids("gs004_unguarded_io_green.py") == []
+
+
+def test_gs005_red():
+    ids = fixture_ids("gs005_batch_contract_red.py")
+    assert ids == ["GS005"] * 2
+
+
+def test_gs000_syntax_error():
+    ds = check_source("def broken(:\n", declared=AXES, param_leaves=[])
+    assert [d.rule_id for d in ds] == ["GS000"]
+
+
+def test_gs004_module_level_and_nested_def_writes():
+    """Review-found blind spots, pinned: an import-time write in the
+    module body and a writer def'd under a compound statement are both
+    scanned (they run on every host like any other write)."""
+    top = ("import numpy as np\n"
+           "np.save('warm.npy', [1])\n")
+    ds = check_source(top, path="/x/pvraft_tpu/obs/foo.py",
+                      declared=AXES, param_leaves=[])
+    assert [d.rule_id for d in ds] == ["GS004"]
+    assert "<module>" in ds[0].message
+    nested = ("import numpy as np\n"
+              "if True:\n"
+              "    def writer(x):\n"
+              "        np.save('x.npy', x)\n")
+    ds = check_source(nested, path="/x/pvraft_tpu/obs/foo.py",
+                      declared=AXES, param_leaves=[])
+    assert [d.rule_id for d in ds] == ["GS004"]
+
+
+def test_gs004_mutual_recursion_not_proven_guarded():
+    """Review-found blind spot, pinned: a mutually-recursive writer
+    pair with no outside callers must NOT dominate itself into a guard
+    (least- not greatest-fixpoint)."""
+    src = ("import numpy as np\n"
+           "def a(x):\n"
+           "    np.save('a.npy', x)\n"
+           "    b(x)\n"
+           "def b(x):\n"
+           "    np.save('b.npy', x)\n"
+           "    a(x)\n")
+    ds = check_source(src, path="/x/pvraft_tpu/obs/foo.py",
+                      declared=AXES, param_leaves=[])
+    assert [d.rule_id for d in ds] == ["GS004", "GS004"]
+
+
+def test_gs002_axis_keyword_argument():
+    """Review-found blind spot, pinned: `axis_name=` keyword spellings
+    carry axis names too."""
+    src = ("from jax import lax\n"
+           "def f(x):\n"
+           "    return lax.psum(x, axis_name='typo_axis')\n")
+    ds = check_source(src, declared=AXES, param_leaves=[])
+    assert [d.rule_id for d in ds] == ["GS002"]
+    assert "typo_axis" in ds[0].message
+
+
+def test_rules_path_scoped_inside_package():
+    """GS004 only applies to engine/ + obs/ inside the package (the
+    serve plane is threadcheck's turf) but applies everywhere outside
+    it — fixtures stay testable."""
+    src = ("import numpy as np\n"
+           "def f(x):\n"
+           "    np.save('x.npy', x)\n")
+    flagged = check_source(src, path="/x/pvraft_tpu/obs/foo.py",
+                           declared=AXES, param_leaves=[])
+    assert [d.rule_id for d in flagged] == ["GS004"]
+    skipped = check_source(src, path="/x/pvraft_tpu/serve/foo.py",
+                           declared=AXES, param_leaves=[])
+    assert skipped == []
+
+
+# --- suppressions + the shared pragma grammar -------------------------------
+
+def test_gs_ids_known_to_stats():
+    ids = known_rule_ids()
+    for rid in ("GS000", "GS001", "GS002", "GS003", "GS004", "GS005"):
+        assert rid in ids
+
+
+def test_gs_suppression_honored():
+    src = ("import numpy as np\n"
+           "def f(x):\n"
+           "    np.save('x.npy', x)"
+           "  # graftlint: disable=GS004 -- fixture\n")
+    assert check_source(src, path="/x/pvraft_tpu/engine/foo.py",
+                        declared=AXES, param_leaves=[]) == []
+
+
+def test_reasonless_gs_pragma_fails_stats(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = 1  # graftlint: disable=GS004\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        rc = analysis_main(["lint", "--stats", str(bad)])
+    assert rc == 1
+    assert "reason-less" in buf.getvalue()
+    good = tmp_path / "good.py"
+    good.write_text("x = 1  # graftlint: disable=GS004 -- pinned fixture\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        rc = analysis_main(["lint", "--stats", str(good)])
+    assert rc == 0
+    assert "unknown rule" not in buf.getvalue()
+
+
+# --- the clean-tree gate ----------------------------------------------------
+
+def test_clean_tree_zero_findings():
+    """The lint.sh stage in test form: the shipped tree carries zero GS
+    findings with the real declared axes + the committed inventory."""
+    findings, nfiles = check_paths(list(default_scope()))
+    assert findings == [], [d.format() for d in findings]
+    assert nfiles > 40
+
+
+def test_default_inventory_loads():
+    leaves = default_param_leaves()
+    assert leaves and len(leaves) == 95
+    assert all(p.startswith("params/") for p in leaves)
+
+
+# --- CLI --------------------------------------------------------------------
+
+def test_cli_list_rules():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["sharding", "--list-rules"])
+    assert rc == 0
+    out = buf.getvalue()
+    for rid in ("GS001", "GS002", "GS003", "GS004", "GS005"):
+        assert rid in out
+
+
+def test_cli_red_fixture_and_select():
+    buf = io.StringIO()
+    path = os.path.join(FIXTURES, "gs005_batch_contract_red.py")
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        rc = analysis_main(["sharding", path])
+    assert rc == 1
+    assert "GS005" in buf.getvalue()
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        rc = analysis_main(["sharding", "--select", "GS002", path])
+    assert rc == 0  # GS005 findings filtered out
+
+
+# --- pod planner ------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def plan():
+    """One plan build shared by every planner assertion (each build
+    re-scans the whole gate scope — no reason to pay that per test)."""
+    return build_plan(COSTS, PARAMS)
+
+
+def test_plan_schema_and_structure(plan):
+    assert plan["schema"] == PLAN_SCHEMA
+    assert [(m["dp"], m["sp"]) for m in plan["meshes"]] == \
+        list(CANDIDATE_MESHES)
+    for mesh in plan["meshes"]:
+        assert [s["n_points"] for s in mesh["scenes"]] == \
+            list(SCENE_POINTS)
+        assert mesh["params_bytes_per_device"] > 0
+        assert mesh["optimizer_bytes_per_device"] == \
+            2 * mesh["params_bytes_per_device"]
+
+
+def test_plan_fits_verdicts_pinned(plan):
+    """The committed answers ROADMAP item 2 cites: the 16k scene fits
+    every candidate mesh per-device; the 100k scene needs the seq=4
+    meshes (4x4, 8x4) — seq=2 does not fit."""
+
+    def fits(dp, sp, n):
+        mesh = next(m for m in plan["meshes"]
+                    if m["dp"] == dp and m["sp"] == sp)
+        return next(s for s in mesh["scenes"]
+                    if s["n_points"] == n)["fits_16GiB_hbm"]
+
+    for dp, sp in CANDIDATE_MESHES:
+        assert fits(dp, sp, 16384)
+    assert not fits(2, 2, 100000)
+    assert not fits(4, 2, 100000)
+    assert fits(4, 4, 100000)
+    assert fits(8, 4, 100000)
+    assert "4x4, 8x4" in plan["scene_verdicts"]["100000"]
+
+
+def test_plan_cross_check_in_band(plan):
+    cross = plan["sharded_step_cross_check"]
+    lo, hi = CROSS_CHECK_BAND
+    assert cross["program"] == "dp_sp_2x2_train_step"
+    assert lo <= cross["model_vs_compiled_ratio"] <= hi
+    assert cross["compiled_live_bytes_per_device"] > \
+        cross["model_bytes_per_device"]
+
+
+def test_plan_ring_accounting():
+    """Ring traffic follows the ring.py geometry: sp-1 hops (the last
+    fold never forwards its chunk — the GJ002 fix), chunk bytes =
+    points/sp x (feature_dim + 3) floats."""
+    comms = ring_comms(4096, 4, 128)
+    assert comms["hops"] == 3
+    assert comms["corr_per_hop_bytes"] == 4096 * 131 * 4
+    assert comms["knn_per_hop_bytes"] == 4096 * 3 * 4
+    assert comms["total_bytes_per_step"] == \
+        3 * (2 * comms["knn_per_hop_bytes"]
+             + 2 * comms["corr_per_hop_bytes"])
+    assert ring_comms(4096, 1, 128)["total_bytes_per_step"] == 0
+
+
+def test_plan_param_bytes_honor_rules():
+    doc = load_params_tree(PARAMS)
+    # All rules replicate today: per-device bytes == total on any mesh.
+    assert param_bytes_per_device(doc["leaves"], {"data": 8, "seq": 4}) \
+        == doc["total_bytes"]
+
+
+def test_committed_plan_drift_detected(tmp_path):
+    doc = json.loads(open(PLAN, encoding="utf-8").read())
+    doc["scene_verdicts"]["100000"] = "fits everywhere, trust me"
+    edited = tmp_path / "pod_plan.json"
+    edited.write_text(json.dumps(doc))
+    problems = check_plan_file(str(edited), COSTS, PARAMS)
+    assert problems and "drifted" in problems[0]
+    assert "scene_verdicts" in problems[0]
+
+
+def test_plan_refuses_on_findings(tmp_path):
+    """A broken costs artifact (no activation basis) refuses the plan
+    instead of committing fiction."""
+    crippled = tmp_path / "costs.json"
+    crippled.write_text(json.dumps({"programs": []}))
+    with pytest.raises(ValueError, match="cannot be built"):
+        build_plan(str(crippled), PARAMS)
+
+
+def test_cli_plan_check_committed_up_to_date():
+    """The lint.sh regenerate-and-compare stage in test form (also THE
+    committed-plan freshness pin)."""
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        rc = analysis_main(["sharding", "--check", PLAN,
+                            "--costs", COSTS, "--params", PARAMS])
+    assert rc == 0
+    assert "OK" in buf.getvalue()
+
+
+def test_rule_table_complete():
+    rules = all_sharding_rules()
+    assert [r.id for r in rules] == \
+        ["GS001", "GS002", "GS003", "GS004", "GS005"]
+    for r in rules:
+        assert r.title and (r.__doc__ or "").strip()
