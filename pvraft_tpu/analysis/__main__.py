@@ -1,5 +1,5 @@
 """CLI: ``python -m pvraft_tpu.analysis
-{lint,trace,deepcheck,concurrency,kernels,sharding}``.
+{lint,trace,deepcheck,concurrency,kernels,sharding,determinism}``.
 
 ``lint`` is pure stdlib-AST and never initializes a jax backend
 (``--stats`` prints the suppression-debt report instead of findings).
@@ -26,6 +26,15 @@ multi-process planes (engine/obs/parallel/programs/models/ops/data);
 ``--plan`` joins the partition rules, the committed param-tree
 inventory and the cost inventory into ``pvraft_pod_plan/v1``
 (per-device memory + ring comms verdicts per candidate (dp, sp) mesh).
+``determinism`` (detcheck) runs the GD001+ rules — jax PRNG key
+reuse/consumed-without-split, host RNG or time-derived seeds outside
+the ``rng.derive`` stream contract, nondeterminism-hazard ops on
+programs without a ``determinism=`` declaration, backend determinism
+flags routed outside ``compat.py``, iteration-order hazards
+(set/unsorted-glob ordering feeding traces or checkpoints) — over the
+whole package; ``--replay`` builds the registered train step and serve
+dispatch twice from the same seed, diffs outputs bitwise, and emits
+the ``pvraft_determinism/v1`` artifact (``--check`` pins it).
 """
 
 from __future__ import annotations
@@ -278,6 +287,65 @@ def _sharding_plan(args) -> int:
     return 0
 
 
+def _cmd_determinism(args) -> int:
+    from pvraft_tpu.analysis.determinism.check import (
+        check_paths,
+        default_scope,
+    )
+    from pvraft_tpu.analysis.determinism.rules import all_determinism_rules
+
+    if args.list_rules:
+        for rule in all_determinism_rules():
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id}  {rule.title:<28} {doc}")
+        return 0
+    if args.replay or args.check:
+        return _determinism_replay(args)
+    paths = args.paths or list(default_scope())
+    select = tuple(args.select.split(",")) if args.select else ()
+    diags, nfiles = check_paths(paths, rule_ids=select)
+    for d in diags:
+        print(d.format())
+    print(f"detcheck: {len(diags)} finding(s) in {nfiles} file(s)",
+          file=sys.stderr)
+    return 1 if diags else 0
+
+
+def _determinism_replay(args) -> int:
+    """Build (or --check) the pvraft_determinism/v1 artifact: the
+    registered train step and serve dispatch run twice from the same
+    config seed, outputs diffed bitwise. Exit 1 on any divergence or
+    (with --check) committed-report drift."""
+    import json
+
+    from pvraft_tpu.analysis.determinism.replay import (
+        check_report,
+        replay_report,
+        write_report,
+    )
+
+    if args.check:
+        problems = check_report(args.check)
+        for p in problems:
+            print(p, file=sys.stderr)
+        if not problems:
+            print(f"{args.check}: OK (replay is bitwise and matches the "
+                  f"committed report)")
+        return 1 if problems else 0
+    report = replay_report(seed=args.seed)
+    if args.out:
+        write_report(args.out, report)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    for e in report["programs"]:
+        tag = "bitwise" if e["bitwise_identical"] else "DIVERGENT"
+        print(f"[replay] {e['name']}: {tag} "
+              f"({e['n_output_leaves']} leaves, {e['digest'][:16]})",
+              file=sys.stderr)
+    return 0 if report["verdict"] == "bitwise" else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pvraft_tpu.analysis",
@@ -391,6 +459,34 @@ def main(argv=None) -> int:
                          help="the committed pvraft_params_tree/v1 leaf "
                               "inventory to join against")
     p_shard.set_defaults(fn=_cmd_sharding)
+
+    p_det = sub.add_parser(
+        "determinism",
+        help="detcheck: seed/RNG-discipline static analysis (GD rules) "
+             "over the whole package, plus the --replay bitwise "
+             "replay harness",
+    )
+    p_det.add_argument("paths", nargs="*",
+                       help="files/directories to check (default: the "
+                            "whole pvraft_tpu package)")
+    p_det.add_argument("--list-rules", action="store_true",
+                       help="print the GD rule table and exit")
+    p_det.add_argument("--select", default="",
+                       help="comma-separated GD rule ids (default all)")
+    p_det.add_argument("--replay", action="store_true",
+                       help="run the registered train step and serve "
+                            "dispatch twice from the same seed and emit "
+                            "the pvraft_determinism/v1 artifact")
+    p_det.add_argument("--seed", type=int, default=0,
+                       help="with --replay: the config seed to replay "
+                            "from (default 0)")
+    p_det.add_argument("--out", default="",
+                       help="with --replay: write the artifact here "
+                            "instead of stdout")
+    p_det.add_argument("--check", default="", metavar="ARTIFACT",
+                       help="regenerate the replay and compare against a "
+                            "committed artifact (exit 1 on drift)")
+    p_det.set_defaults(fn=_cmd_determinism)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -24,6 +24,8 @@ import dataclasses
 import traceback
 from typing import Callable, Dict, List, Optional, Tuple
 
+from pvraft_tpu.rng import DEFAULT_SEED, derive
+
 # Symbolic dims: distinct so axis mixups fail loudly.
 B, N, M, D, K = 2, 24, 40, 16, 8
 
@@ -65,13 +67,16 @@ AUDIT_TAG = "audit"
 
 def audit_entry(name: str, precision: str = "f32",
                 spmd_group: Optional[str] = None,
-                tags: Tuple[str, ...] = ()):
+                tags: Tuple[str, ...] = (),
+                determinism: str = ""):
     """Register one audit entry as an ``"audit"``-tagged ProgramSpec.
 
     Extra ``tags`` classify the entry in the program inventory
     (``python -m pvraft_tpu.programs list``): "op", "model", "train",
-    "eval", "serve", "parallel", ... Duplicate names raise (the
-    registry enforces declare-exactly-once)."""
+    "eval", "serve", "parallel", ... ``determinism`` is the detcheck
+    GD003 stance for entries whose import closure reaches a
+    nondeterminism-hazard op. Duplicate names raise (the registry
+    enforces declare-exactly-once)."""
     from pvraft_tpu.programs.spec import ProgramSpec, register_spec
 
     def deco(thunk):
@@ -82,6 +87,7 @@ def audit_entry(name: str, precision: str = "f32",
             tags=(AUDIT_TAG,) + tuple(tags),
             precision=precision,
             spmd_group=spmd_group,
+            determinism=determinism,
             path=getattr(code, "co_filename", "") or "",
             line=getattr(code, "co_firstlineno", 0) or 0,
         ))
@@ -265,7 +271,8 @@ def _e_voxel_pallas():
     )
 
 
-@audit_entry("pallas.fused_corr_lookup", tags=("op", "pallas"))
+@audit_entry("pallas.fused_corr_lookup", tags=("op", "pallas"),
+             determinism="unique-index-scatter; replay-certified")
 def _e_fused():
     from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
 
@@ -286,7 +293,8 @@ def _ring_seq() -> int:
     return 2 if jax.device_count() >= 2 else 1
 
 
-@audit_entry("ring.ring_corr_init", tags=("parallel",))
+@audit_entry("ring.ring_corr_init", tags=("parallel",),
+             determinism="ring-fold order fixed by mesh topology")
 def _e_ring():
     from jax.sharding import PartitionSpec as P
 
@@ -311,7 +319,8 @@ def _e_ring():
     return fn, (_f32(B, N, D), _f32(B, M, D), _f32(B, M, 3))
 
 
-@audit_entry("ring.ring_knn_indices", tags=("parallel",))
+@audit_entry("ring.ring_knn_indices", tags=("parallel",),
+             determinism="ring-fold order fixed by mesh topology")
 def _e_ring_knn():
     from jax.sharding import PartitionSpec as P
 
@@ -349,23 +358,26 @@ def _model_entry(refine: bool, **cfg_kwargs):
     # inside the model cannot accidentally type-check (same discipline as
     # the op-level entries).
     def fn(pc1, pc2):
-        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        params = model.init(derive(DEFAULT_SEED, "model.init"), pc1, pc2, 3)
         return model.apply(params, pc1, pc2, 3)
 
     return fn, (_f32(B, N, 3), _f32(B, M, 3))
 
 
-@audit_entry("models.PVRaft", tags=("model",))
+@audit_entry("models.PVRaft", tags=("model",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_pvraft():
     return _model_entry(refine=False)
 
 
-@audit_entry("models.PVRaftRefine", tags=("model",))
+@audit_entry("models.PVRaftRefine", tags=("model",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_refine():
     return _model_entry(refine=True)
 
 
-@audit_entry("models.PVRaft[scatter_free+save_corr]", tags=("model",))
+@audit_entry("models.PVRaft[scatter_free+save_corr]", tags=("model",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_pvraft_opt():
     # The optimized backward path end to end: scatter-free VJPs +
     # checkpoint_name-tagged corr under the save_corr remat policy.
@@ -376,7 +388,8 @@ def _e_pvraft_opt():
 # --- engine (the jitted train step, end to end) ---------------------------
 
 @audit_entry("engine.train_step", spmd_group="train-step",
-             tags=("train",))
+             tags=("train",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_train_step():
     import jax
     import optax
@@ -390,7 +403,7 @@ def _e_train_step():
     tx = optax.sgd(1e-2)
 
     def fn(pc1, pc2, mask, gt):
-        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        params = model.init(derive(DEFAULT_SEED, "model.init"), pc1, pc2, 3)
         opt_state = tx.init(params)
         step = make_train_step(model, tx, 0.8, 3)
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
@@ -401,7 +414,8 @@ def _e_train_step():
 
 @audit_entry("engine.train_step[optimized_backward]",
              precision="bf16_grads", spmd_group="train-step",
-             tags=("train", "ab"))
+             tags=("train", "ab"),
+             determinism="unique-index-scatter; replay-certified")
 def _e_train_step_opt():
     # Full optimized train step: scatter-free VJPs, dots remat policy,
     # bf16 gradient cast — the bench A/B configuration, traced end to
@@ -423,7 +437,7 @@ def _e_train_step_opt():
     tx = optax.sgd(1e-2)
 
     def fn(pc1, pc2, mask, gt):
-        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        params = model.init(derive(DEFAULT_SEED, "model.init"), pc1, pc2, 3)
         opt_state = tx.init(params)
         step = make_train_step(model, tx, 0.8, 3, grad_dtype=grad_dtype)
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
@@ -433,7 +447,8 @@ def _e_train_step_opt():
 
 
 @audit_entry("engine.train_step[telemetry]", spmd_group="train-step",
-             tags=("train",))
+             tags=("train",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_train_step_telemetry():
     # The telemetry-armed step traces end to end: the in-jit monitors
     # (obs/monitors.py) ride back as an extra metrics leaf.
@@ -449,7 +464,7 @@ def _e_train_step_telemetry():
     tx = optax.sgd(1e-2)
 
     def fn(pc1, pc2, mask, gt):
-        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        params = model.init(derive(DEFAULT_SEED, "model.init"), pc1, pc2, 3)
         opt_state = tx.init(params)
         step = make_train_step(model, tx, 0.8, 3, telemetry=True)
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
@@ -458,7 +473,8 @@ def _e_train_step_telemetry():
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
-@audit_entry("engine.refine_train_step", tags=("train",))
+@audit_entry("engine.refine_train_step", tags=("train",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_refine_train_step():
     # Stage-2 step variant: frozen backbone, masked-L1 on the single
     # refined flow. In the corpus so deepcheck's donation and precision
@@ -475,7 +491,7 @@ def _e_refine_train_step():
     tx = optax.sgd(1e-2)
 
     def fn(pc1, pc2, mask, gt):
-        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        params = model.init(derive(DEFAULT_SEED, "model.init"), pc1, pc2, 3)
         opt_state = tx.init(params)
         step = make_refine_train_step(model, tx, 3)
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
@@ -484,7 +500,8 @@ def _e_refine_train_step():
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
-@audit_entry("engine.eval_step", tags=("eval",))
+@audit_entry("engine.eval_step", tags=("eval",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_eval_step():
     # The jitted eval step (no donation by design: params are reused
     # across every val batch) — deepcheck verifies exactly that.
@@ -498,7 +515,7 @@ def _e_eval_step():
     model = PVRaft(cfg)
 
     def fn(pc1, pc2, mask, gt):
-        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        params = model.init(derive(DEFAULT_SEED, "model.init"), pc1, pc2, 3)
         step = make_eval_step(model, 3, 0.8)
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
         return step(params, batch)
@@ -506,7 +523,8 @@ def _e_eval_step():
     return fn, (_f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
 
 
-@audit_entry("engine.eval_step[refine]", tags=("eval",))
+@audit_entry("engine.eval_step[refine]", tags=("eval",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_eval_step_refine():
     import jax
 
@@ -518,7 +536,7 @@ def _e_eval_step_refine():
     model = PVRaftRefine(cfg)
 
     def fn(pc1, pc2, mask, gt):
-        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        params = model.init(derive(DEFAULT_SEED, "model.init"), pc1, pc2, 3)
         step = make_eval_step(model, 3, 0.8, refine=True)
         batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
         return step(params, batch)
@@ -545,7 +563,7 @@ def _serve_predict_entry(**model_kwargs):
     predict = jax.jit(build_predict_fn(model, 3), donate_argnums=(1,))
 
     def fn(pc1, pc2, v1, v2):
-        params = model.init(jax.random.key(0), pc1, pc2, 3)
+        params = model.init(derive(DEFAULT_SEED, "model.init"), pc1, pc2, 3)
         return predict(params, pc1, pc2, v1, v2)
 
     # pc1 and pc2 share one bucket (the serve layout), so both are
@@ -553,12 +571,14 @@ def _serve_predict_entry(**model_kwargs):
     return fn, (_f32(B, N, 3), _f32(B, N, 3), _bool(B, N), _bool(B, N))
 
 
-@audit_entry("serve.predict", tags=("serve",))
+@audit_entry("serve.predict", tags=("serve",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_serve_predict():
     return _serve_predict_entry()
 
 
-@audit_entry("serve.predict[bf16]", precision="any", tags=("serve",))
+@audit_entry("serve.predict[bf16]", precision="any", tags=("serve",),
+             determinism="unique-index-scatter; replay-certified")
 def _e_serve_predict_bf16():
     # bf16 matmul compute is the serve fast path's POINT, not drift, and
     # there is no gradient cast to declare (inference-only program) —
@@ -566,7 +586,9 @@ def _e_serve_predict_bf16():
     return _serve_predict_entry(compute_dtype="bfloat16")
 
 
-@audit_entry("engine.train_step[telemetry_off_jaxpr]", tags=("train", "guarantee"))
+@audit_entry("engine.train_step[telemetry_off_jaxpr]",
+             tags=("train", "guarantee"),
+             determinism="unique-index-scatter; replay-certified")
 def _e_train_step_telemetry_off_jaxpr():
     # Guarantee audit (GL009's dynamic twin): with telemetry OFF the
     # train-step jaxpr is byte-identical to the pre-telemetry step body,
@@ -588,7 +610,8 @@ def _e_train_step_telemetry_off_jaxpr():
     pc1, pc2, mask, gt = (
         _f32(B, N, 3), _f32(B, M, 3), _f32(B, N), _f32(B, N, 3))
     params = jax.eval_shape(
-        lambda a, b: model.init(jax.random.key(0), a, b, 3), pc1, pc2)
+        lambda a, b: model.init(derive(DEFAULT_SEED, "model.init"), a, b, 3),
+        pc1, pc2)
     opt_state = jax.eval_shape(tx.init, params)
     batch = {"pc1": pc1, "pc2": pc2, "mask": mask, "flow": gt}
 

@@ -1,0 +1,25 @@
+"""detcheck: determinism/RNG-discipline static analysis (rules GD001+).
+
+The sixth analysis engine, symmetric with graftlint/deepcheck/
+threadcheck/kernelcheck/shardcheck: one :class:`~..engine.Diagnostic`
+type, one ``# graftlint: disable=GDxxx -- reason`` pragma grammar, and
+a dynamic twin (the bitwise replay harness in
+:mod:`pvraft_tpu.analysis.determinism.replay`).
+
+    python -m pvraft_tpu.analysis determinism            # static rules
+    python -m pvraft_tpu.analysis determinism --replay   # bitwise replay
+"""
+
+from pvraft_tpu.analysis.determinism.check import (  # noqa: F401
+    DEFAULT_SCOPE,
+    check_paths,
+    check_source,
+    declared_streams,
+    default_scope,
+    hazard_spec_records,
+)
+from pvraft_tpu.analysis.determinism.rules import (  # noqa: F401
+    DetContext,
+    HazardSpec,
+    all_determinism_rules,
+)

@@ -1,0 +1,323 @@
+"""detcheck driver: files -> det models -> GD rules -> diagnostics.
+
+Mirrors ``concurrency/check.py``/``kernels/check.py``/``sharding/
+check.py`` deliberately: the same ``Diagnostic`` type, the same
+``# graftlint: disable=GDxxx -- reason`` suppression grammar (one
+parser — what ``lint --stats`` counts is exactly what is honored
+here), the same stable ordering. Scope is the WHOLE package: entropy
+leaks everywhere, so unlike the plane-scoped engines detcheck walks
+``pvraft_tpu/`` end to end (rng.py and compat.py are per-rule
+exemptions as the contract owners, not scan holes).
+
+The declared context comes from the data planes, never hardcoded: the
+stream vocabulary is parsed from ``pvraft_tpu/rng.py``'s ``STREAMS``
+tuple (AST, no import), and the GD003 hazard set from the live program
+registry — each spec's thunk source yields its package imports
+(the GK005 inspection discipline: the thunk is read, never run), the
+package import graph closes them transitively, and any spec whose
+closure reaches a hazard-op module must carry a ``determinism=``
+declaration.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pvraft_tpu.analysis.engine import (
+    Diagnostic,
+    _expand_decorated_regions,
+    _suppressed,
+    _suppressions,
+    iter_py_files,
+)
+from pvraft_tpu.analysis.determinism.model import build_module_det_model
+from pvraft_tpu.analysis.determinism.rules import (
+    DetContext,
+    HazardSpec,
+    all_determinism_rules,
+)
+
+# Spelled as a constant for docs/tests; resolved lazily by the CLI.
+DEFAULT_SCOPE = ("pvraft_tpu",)
+
+
+def _pkg_root() -> str:
+    import pvraft_tpu
+
+    return os.path.dirname(os.path.abspath(pvraft_tpu.__file__))
+
+
+def default_scope() -> Tuple[str, ...]:
+    """The gate's scan scope, as absolute paths of this checkout."""
+    return (_pkg_root(),)
+
+
+def declared_streams() -> Optional[Tuple[str, ...]]:
+    """The stream vocabulary: first elements of the ``STREAMS`` tuple
+    declared at module level of ``pvraft_tpu/rng.py`` — parsed from the
+    AST so the checker arms without importing (and cannot drift from)
+    the runtime contract. None when unreadable: GD002 reports that as
+    a finding on any deriving file rather than silently skipping."""
+    path = os.path.join(_pkg_root(), "rng.py")
+    try:
+        with open(path, "r", encoding="utf-8-sig") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == "STREAMS"
+                   for t in targets):
+            continue
+        value = node.value
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            return None
+        names: List[str] = []
+        for entry in value.elts:
+            if isinstance(entry, (ast.Tuple, ast.List)) and entry.elts \
+                    and isinstance(entry.elts[0], ast.Constant) \
+                    and isinstance(entry.elts[0].value, str):
+                names.append(entry.elts[0].value)
+        return tuple(names)
+    return None
+
+
+# --- the GD003 registry inspection -----------------------------------------
+
+_PKG_IMPORT_RE = re.compile(r"(?:from|import)\s+(pvraft_tpu(?:\.\w+)*)")
+
+
+def _module_files() -> Dict[str, str]:
+    """Dotted module name -> absolute path, for every module in the
+    installed package (analysis/ excluded: the checker's own sources
+    mention hazard names as string data, not as programs)."""
+    root = _pkg_root()
+    out: Dict[str, str] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d != "__pycache__"
+            and not (dirpath == root and d == "analysis"))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, os.path.dirname(root))
+            dotted = rel[:-3].replace(os.sep, ".")
+            if dotted.endswith(".__init__"):
+                dotted = dotted[: -len(".__init__")]
+            out[dotted] = full
+    return out
+
+
+def _module_graph(files: Dict[str, str]
+                  ) -> Tuple[Dict[str, Set[str]], Dict[str, List[str]]]:
+    """(imports, hazards): per module, the package modules any import
+    statement anywhere in it names (lazy function-level imports
+    included — config-gated paths are still reachable code), and the
+    hazard-op kinds its AST contains."""
+    imports: Dict[str, Set[str]] = {}
+    hazards: Dict[str, List[str]] = {}
+    for dotted, path in files.items():
+        try:
+            with open(path, "r", encoding="utf-8-sig") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            imports[dotted] = set()
+            continue
+        mods: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] == "pvraft_tpu":
+                        mods.add(a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.split(".")[0] == "pvraft_tpu":
+                mods.add(node.module)
+                for a in node.names:
+                    # `from pvraft_tpu.data import loader` names a
+                    # submodule; symbol imports just miss the lookup.
+                    cand = f"{node.module}.{a.name}"
+                    if cand in files:
+                        mods.add(cand)
+        imports[dotted] = {m for m in mods if m in files}
+        model = build_module_det_model(tree)
+        kinds = sorted({h.kind for h in model.hazard_ops})
+        if kinds:
+            hazards[dotted] = kinds
+    return imports, hazards
+
+
+def _thunk_roots(spec, spec_module_tree: Optional[ast.Module],
+                 files: Dict[str, str]) -> Set[str]:
+    """Package modules the spec's thunk source imports, plus those of
+    same-module helper functions the thunk references (audit entries
+    delegate to ``_model_entry``-style builders) — a fixpoint within
+    the defining module."""
+    import inspect
+
+    try:
+        source = inspect.getsource(spec.thunk)
+    except (OSError, TypeError):
+        return set()
+    helper_imports: Dict[str, Set[str]] = {}
+    helper_names: Dict[str, Set[str]] = {}
+    if spec_module_tree is not None:
+        for node in spec_module_tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                src_names = {n.id for n in ast.walk(node)
+                             if isinstance(n, ast.Name)}
+                helper_names[node.name] = src_names
+                mods: Set[str] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Import):
+                        mods.update(a.name for a in sub.names
+                                    if a.name.split(".")[0] == "pvraft_tpu")
+                    elif isinstance(sub, ast.ImportFrom) and sub.module \
+                            and sub.module.split(".")[0] == "pvraft_tpu":
+                        mods.add(sub.module)
+                        mods.update(
+                            f"{sub.module}.{a.name}" for a in sub.names
+                            if f"{sub.module}.{a.name}" in files)
+                helper_imports[node.name] = mods
+
+    roots = set(_PKG_IMPORT_RE.findall(source))
+    # Helper fixpoint: pull in imports of same-module functions the
+    # thunk (or an already-pulled helper) references by name.
+    pulled: Set[str] = set()
+    frontier = [source]
+    while frontier:
+        text = frontier.pop()
+        for name, mods in helper_imports.items():
+            if name in pulled:
+                continue
+            if re.search(rf"\b{re.escape(name)}\b", text):
+                pulled.add(name)
+                roots.update(mods)
+                frontier.append(" ".join(sorted(helper_names[name])))
+    return {r for r in roots if r in files}
+
+
+def hazard_spec_records() -> List[HazardSpec]:
+    """Every registered ProgramSpec whose static import closure reaches
+    a nondeterminism-hazard op, with its declared stance. Import-light:
+    ``load_catalog`` registers specs without importing jax (thunks stay
+    lazy) and everything else is AST over package sources."""
+    from pvraft_tpu.programs import load_catalog
+    from pvraft_tpu.programs.spec import specs
+
+    load_catalog()
+    files = _module_files()
+    imports, hazards = _module_graph(files)
+
+    # Transitive closure memo: module -> hazard modules it reaches.
+    reach_memo: Dict[str, Set[str]] = {}
+
+    def reach(mod: str, seen: Set[str]) -> Set[str]:
+        if mod in reach_memo:
+            return reach_memo[mod]
+        if mod in seen:
+            return set()
+        seen.add(mod)
+        out: Set[str] = set()
+        if mod in hazards:
+            out.add(mod)
+        for dep in imports.get(mod, ()):
+            out |= reach(dep, seen)
+        reach_memo[mod] = out
+        return out
+
+    module_trees: Dict[str, Optional[ast.Module]] = {}
+
+    def tree_of(path: str) -> Optional[ast.Module]:
+        if path not in module_trees:
+            try:
+                with open(path, "r", encoding="utf-8-sig") as f:
+                    module_trees[path] = ast.parse(f.read(), filename=path)
+            except (OSError, SyntaxError):
+                module_trees[path] = None
+        return module_trees[path]
+
+    records: List[HazardSpec] = []
+    for spec in specs().values():
+        roots = _thunk_roots(spec, tree_of(spec.path) if spec.path else None,
+                             files)
+        hit: Dict[str, List[str]] = {}
+        for r in sorted(roots):
+            for hmod in sorted(reach(r, set())):
+                hit.setdefault(hmod, hazards[hmod])
+        if not hit:
+            continue
+        via = sorted(hit)[0]
+        kinds = sorted({k for ks in hit.values() for k in ks})
+        records.append(HazardSpec(
+            name=spec.name,
+            determinism=getattr(spec, "determinism", ""),
+            path=spec.path.replace("\\", "/"),
+            line=spec.line,
+            via=via.replace(".", "/") + ".py",
+            kinds=tuple(kinds)))
+    records.sort(key=lambda r: (r.path, r.line, r.name))
+    return records
+
+
+# --- the driver ------------------------------------------------------------
+
+def check_source(source: str, path: str = "<string>",
+                 rule_ids: Sequence[str] = (),
+                 streams: Optional[Sequence[str]] = None,
+                 hazard_specs: Optional[Sequence[HazardSpec]] = None,
+                 ) -> List[Diagnostic]:
+    """Run the GD rules over one source string (suppressions applied)."""
+    source = source.lstrip("\ufeff")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic(path, e.lineno or 1, e.offset or 0, "GD000",
+                           f"syntax error: {e.msg}")]
+    model = build_module_det_model(tree)
+    ctx = DetContext(path, source, tree, model,
+                     declared_streams=streams, hazard_specs=hazard_specs)
+    per_line, file_ids = _suppressions(source)
+    _expand_decorated_regions(tree, per_line)
+    out: List[Diagnostic] = []
+    for rule_cls in all_determinism_rules():
+        if rule_ids and rule_cls.id not in rule_ids:
+            continue
+        for d in rule_cls().check(ctx):
+            if not _suppressed(d, per_line, file_ids):
+                out.append(d)
+    out.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return out
+
+
+def check_paths(paths: Sequence[str], rule_ids: Sequence[str] = (),
+                streams: Optional[Sequence[str]] = None,
+                hazard_specs: Optional[Sequence[HazardSpec]] = None,
+                ) -> Tuple[List[Diagnostic], int]:
+    """Check files/directories. Returns (findings, files_checked).
+
+    ``streams``/``hazard_specs`` default to the live declarations
+    (rng.py's STREAMS, the registry hazard closure) so the clean-tree
+    gate always arms GD002/GD003 with real data."""
+    if streams is None:
+        streams = declared_streams()
+    if hazard_specs is None:
+        hazard_specs = hazard_spec_records()
+    findings: List[Diagnostic] = []
+    n = 0
+    for f in iter_py_files(paths):
+        n += 1
+        with open(f, "r", encoding="utf-8-sig") as fh:
+            findings.extend(check_source(
+                fh.read(), path=f, rule_ids=rule_ids, streams=streams,
+                hazard_specs=hazard_specs))
+    findings.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return findings, n

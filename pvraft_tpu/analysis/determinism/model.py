@@ -1,0 +1,351 @@
+"""detcheck model: one AST walk -> the determinism-relevant sites.
+
+Follows the kernelcheck/shardcheck discipline: a dataclass record per
+site class, extracted in a single pass with an import-alias map so
+``random.normal`` resolves to ``jax.random.normal`` in a file that did
+``from jax import random`` but to the stdlib in a file that did
+``import random`` — the distinction GD002 lives on. Rules never re-walk
+the tree for extraction; they read these records.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Name/Attribute chain, or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _tail(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def build_alias_map(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> fully qualified module/symbol, from every import
+    statement in the module (function-level imports included: the
+    repo's thunks import lazily)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".", 1)[0]] = (
+                    a.name if a.asname else a.name.split(".", 1)[0])
+                if a.asname:
+                    aliases[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def resolve_dotted(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Dotted name with its FIRST segment resolved through the alias
+    map: ``np.random.default_rng`` -> ``numpy.random.default_rng``,
+    ``random.normal`` -> ``jax.random.normal`` under ``from jax import
+    random``. Unresolved names pass through unchanged."""
+    dotted = _dotted(node)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition(".")
+    full = aliases.get(head, head)
+    return f"{full}.{rest}" if rest else full
+
+
+@dataclasses.dataclass(frozen=True)
+class RngConstructorSite:
+    """A raw RNG constructor/legacy-sampler call (GD002)."""
+
+    line: int
+    col: int
+    resolved: str  # fully resolved dotted callee
+
+
+@dataclasses.dataclass(frozen=True)
+class DeriveSite:
+    """A ``derive``/``host_rng``/``host_entropy`` call (GD002 streams)."""
+
+    line: int
+    col: int
+    func: str
+    stream_strs: Tuple[str, ...]  # string-constant args, in order
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeSeedSite:
+    """A time/entropy call inside an RNG-seeding expression (GD002)."""
+
+    line: int
+    col: int
+    via: str      # the time/entropy callee
+    seeding: str  # the rng call it feeds
+
+
+@dataclasses.dataclass(frozen=True)
+class FlagWriteSite:
+    """A watched determinism env/config flag written (GD004)."""
+
+    line: int
+    col: int
+    key: str
+    how: str  # "os.environ[...]", "jax.config.update", ...
+
+
+@dataclasses.dataclass(frozen=True)
+class UnsortedGlobSite:
+    """A filesystem enumeration not wrapped in sorted() (GD005)."""
+
+    line: int
+    col: int
+    callee: str
+
+
+@dataclasses.dataclass(frozen=True)
+class SetIterSite:
+    """Direct iteration over a set expression (GD005)."""
+
+    line: int
+    col: int
+    detail: str
+
+
+@dataclasses.dataclass(frozen=True)
+class HazardOpSite:
+    """A nondeterminism-hazard op (GD003's module-level evidence)."""
+
+    line: int
+    col: int
+    kind: str   # "scatter-accumulate" | "segment-reduction" | "ring-fold"
+    callee: str
+
+
+# GD002: numpy's legacy global API and Generator constructors, the
+# stdlib random module, and raw jax key construction. jax.random
+# SAMPLERS (normal, uniform, ...) are fine — they consume keys, they
+# don't mint entropy.
+_JAX_KEY_CONSTRUCTORS = ("jax.random.key", "jax.random.PRNGKey")
+_TIME_ENTROPY = ("time.time", "time.time_ns", "time.monotonic",
+                 "time.monotonic_ns", "time.perf_counter",
+                 "time.perf_counter_ns", "os.urandom", "os.getpid",
+                 "uuid.uuid1", "uuid.uuid4", "datetime.datetime.now",
+                 "datetime.datetime.utcnow", "secrets.token_bytes")
+_DERIVE_FUNCS = ("derive", "host_rng", "host_entropy")
+
+# GD003 hazard vocabularies (exact callee tails — `_scatter_add_onehot`
+# is a deliberate dense reformulation, not a scatter).
+_SEGMENT_REDUCTIONS = ("segment_sum", "segment_max", "segment_min",
+                      "segment_prod")
+_SCATTER_OPS = ("scatter_add", "scatter", "scatter_mul", "psum_scatter")
+_RING_OPS = ("ppermute",)
+_AT_ACCUM_METHODS = ("add", "max", "min", "multiply", "mul")
+
+# GD004 watched surfaces: the flags that silently change numerics or
+# RNG semantics. Deliberately narrow — jax_platforms, cache dirs and
+# the Pallas interpret escape hatch are placement/caching knobs, not
+# determinism levers.
+WATCHED_ENV_KEYS = ("XLA_FLAGS", "PYTHONHASHSEED")
+WATCHED_CONFIG_KEYS = ("jax_default_matmul_precision", "jax_enable_x64",
+                       "jax_threefry_partitionable",
+                       "jax_default_prng_impl")
+
+_FS_ENUM = {"glob.glob": "glob.glob", "glob.iglob": "glob.iglob",
+            "os.listdir": "os.listdir", "os.scandir": "os.scandir"}
+_FS_ENUM_METHODS = ("glob", "rglob", "iterdir")
+
+
+def _is_rng_constructor(resolved: str) -> bool:
+    if resolved.startswith("numpy.random."):
+        return True
+    if resolved == "random" or resolved.startswith("random."):
+        return True
+    return resolved in _JAX_KEY_CONSTRUCTORS
+
+
+@dataclasses.dataclass
+class ModuleDetModel:
+    """Everything the GD rules read about one module."""
+
+    aliases: Dict[str, str]
+    rng_constructors: List[RngConstructorSite]
+    derive_calls: List[DeriveSite]
+    time_seeds: List[TimeSeedSite]
+    flag_writes: List[FlagWriteSite]
+    unsorted_globs: List[UnsortedGlobSite]
+    set_iters: List[SetIterSite]
+    hazard_ops: List[HazardOpSite]
+
+
+def _at_accumulate(call: ast.Call) -> Optional[str]:
+    """``x.at[idx].add(...)``-shaped scatter-accumulate, or None."""
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in _AT_ACCUM_METHODS):
+        return None
+    sub = fn.value
+    if isinstance(sub, ast.Subscript) and \
+            isinstance(sub.value, ast.Attribute) and sub.value.attr == "at":
+        return f".at[].{fn.attr}"
+    return None
+
+
+def build_module_det_model(tree: ast.Module) -> ModuleDetModel:
+    aliases = build_alias_map(tree)
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    model = ModuleDetModel(aliases, [], [], [], [], [], [], [])
+
+    def seed_expr_taint(call: ast.Call, seeding: str) -> None:
+        for sub in ast.walk(call):
+            if isinstance(sub, ast.Call) and sub is not call:
+                r = resolve_dotted(sub.func, aliases)
+                if r in _TIME_ENTROPY:
+                    model.time_seeds.append(TimeSeedSite(
+                        sub.lineno, sub.col_offset, r, seeding))
+
+    for node in ast.walk(tree):
+        # -- iteration-order hazards (GD005) --------------------------------
+        if isinstance(node, (ast.For, ast.comprehension)):
+            it = node.iter
+            line = getattr(node, "lineno", None) or \
+                getattr(it, "lineno", 0)
+            col = getattr(node, "col_offset", None)
+            if col is None:
+                col = getattr(it, "col_offset", 0)
+            if isinstance(it, ast.Set):
+                model.set_iters.append(SetIterSite(
+                    line, col, "iterates a set literal"))
+            elif isinstance(it, ast.Call) and \
+                    _tail(it.func) in ("set", "frozenset") and \
+                    resolve_dotted(it.func, aliases) in ("set", "frozenset"):
+                model.set_iters.append(SetIterSite(
+                    line, col, f"iterates a {_tail(it.func)}() result"))
+
+        if not isinstance(node, ast.Call):
+            continue
+
+        resolved = resolve_dotted(node.func, aliases)
+        tail = _tail(node.func)
+
+        # -- raw RNG constructors + time-derived seeds (GD002) --------------
+        if resolved is not None and _is_rng_constructor(resolved):
+            model.rng_constructors.append(RngConstructorSite(
+                node.lineno, node.col_offset, resolved))
+            seed_expr_taint(node, resolved)
+        elif tail in _DERIVE_FUNCS and (
+                resolved in _DERIVE_FUNCS
+                or (resolved or "").startswith("pvraft_tpu.rng.")):
+            strs = tuple(
+                a.value for a in node.args
+                if isinstance(a, ast.Constant) and isinstance(a.value, str))
+            model.derive_calls.append(DeriveSite(
+                node.lineno, node.col_offset, tail, strs))
+            seed_expr_taint(node, f"{tail}(...)")
+
+        # -- watched flag writes (GD004): call shapes ----------------------
+        if resolved in ("os.environ.setdefault", "os.putenv",
+                        "os.environ.update", "jax.config.update",
+                        "config.update"):
+            key = None
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                key = node.args[0].value
+            elif resolved == "os.environ.update" and node.args and \
+                    isinstance(node.args[0], ast.Dict):
+                for k in node.args[0].keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str) and \
+                            k.value in WATCHED_ENV_KEYS:
+                        key = k.value
+                        break
+            watched = (key in WATCHED_ENV_KEYS
+                       or key in WATCHED_CONFIG_KEYS)
+            if key is not None and watched:
+                model.flag_writes.append(FlagWriteSite(
+                    node.lineno, node.col_offset, key, resolved))
+
+        # -- filesystem enumeration (GD005) ---------------------------------
+        fs_callee = None
+        if resolved in _FS_ENUM:
+            fs_callee = _FS_ENUM[resolved]
+        elif tail in _FS_ENUM_METHODS and isinstance(node.func,
+                                                     ast.Attribute):
+            head = resolve_dotted(node.func.value, aliases) or ""
+            # `glob.glob` already matched above; method form covers
+            # Path objects (p.glob/p.rglob/p.iterdir).
+            if head not in ("glob",):
+                fs_callee = f".{tail}()"
+        if fs_callee is not None:
+            parent = parents.get(id(node))
+            wrapped = (isinstance(parent, ast.Call)
+                       and _tail(parent.func) == "sorted")
+            if not wrapped:
+                model.unsorted_globs.append(UnsortedGlobSite(
+                    node.lineno, node.col_offset, fs_callee))
+
+        # -- nondeterminism-hazard ops (GD003 evidence) ---------------------
+        accum = _at_accumulate(node)
+        if accum is not None:
+            model.hazard_ops.append(HazardOpSite(
+                node.lineno, node.col_offset, "scatter-accumulate", accum))
+        elif tail in _SEGMENT_REDUCTIONS:
+            model.hazard_ops.append(HazardOpSite(
+                node.lineno, node.col_offset, "segment-reduction", tail))
+        elif tail in _SCATTER_OPS:
+            model.hazard_ops.append(HazardOpSite(
+                node.lineno, node.col_offset, "scatter-accumulate", tail))
+        elif tail in _RING_OPS:
+            model.hazard_ops.append(HazardOpSite(
+                node.lineno, node.col_offset, "ring-fold", tail))
+
+    # -- watched flag writes (GD004): subscript/attribute assignment -------
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                base = resolve_dotted(t.value, aliases)
+                key = None
+                sl = t.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    key = sl.value
+                if base == "os.environ" and key in WATCHED_ENV_KEYS:
+                    model.flag_writes.append(FlagWriteSite(
+                        node.lineno, node.col_offset, key,
+                        "os.environ[...]"))
+            elif isinstance(t, ast.Attribute):
+                dotted = resolve_dotted(t, aliases) or ""
+                leaf = dotted.rsplit(".", 1)[-1]
+                if ".config." in f".{dotted}" and \
+                        leaf in WATCHED_CONFIG_KEYS:
+                    model.flag_writes.append(FlagWriteSite(
+                        node.lineno, node.col_offset, leaf,
+                        "config attribute"))
+
+    for bucket in (model.rng_constructors, model.derive_calls,
+                   model.time_seeds, model.flag_writes,
+                   model.unsorted_globs, model.set_iters,
+                   model.hazard_ops):
+        bucket.sort(key=lambda s: (s.line, s.col))
+    return model

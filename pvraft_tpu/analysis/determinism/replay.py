@@ -1,0 +1,209 @@
+"""Bitwise replay: detcheck's dynamic twin.
+
+The static rules prove entropy is *declared*; this harness proves the
+declared entropy actually replays. It builds the registered train step
+and serve dispatch twice from scratch — fresh thunk, fresh trace, fresh
+compile, same config seed — runs both on identical stream-derived
+inputs, and diffs every output leaf bitwise. A divergence means
+something outside the seed contract leaked into the program (trace
+order, an unordered reduction, uninitialized padding), exactly the
+class of bug a convergence-parity campaign cannot afford to chase.
+
+    python -m pvraft_tpu.analysis determinism --replay
+    python -m pvraft_tpu.analysis determinism --replay \
+        --check artifacts/determinism_report.json
+
+The committed ``pvraft_determinism/v1`` artifact is regenerate-and-
+compare pinned by ``scripts/lint.sh`` (the kernel/pod-plan
+discipline). Platform honesty: the replay verdicts (each program
+bitwise-identical against ITSELF) are enforced on every host; raw
+digests are only compared against the committed ones when the platform
+matches (CPU CI cannot check TPU hashes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from pvraft_tpu.rng import DEFAULT_SEED, STREAM_NAMES, host_rng
+
+SCHEMA_VERSION = "pvraft_determinism/v1"
+
+# The replay corpus: the registered train step and a serve dispatch
+# (ISSUE 16). Audit-geometry specs — tiny dims, real code paths.
+REPLAY_PROGRAMS = ("engine.train_step", "serve.predict")
+
+
+def _materialize(args, seed: int) -> Tuple[Any, ...]:
+    """Concrete host arrays for a thunk's abstract args, derived from
+    the ``replay.input`` stream — leaf ``i`` always draws from the same
+    substream, so two materializations are bitwise identical."""
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    out = []
+    for i, leaf in enumerate(leaves):
+        shape = tuple(leaf.shape)
+        dtype = np.dtype(leaf.dtype)
+        rng = host_rng(seed, "replay.input", i)
+        if dtype == np.bool_:
+            # Mostly-valid masks: exercises masked stats without
+            # degenerate all-padding rows.
+            arr = rng.random(shape) < 0.8
+            if arr.ndim:
+                arr[..., 0] = True
+        elif np.issubdtype(dtype, np.floating):
+            arr = rng.standard_normal(shape).astype(dtype)
+        elif np.issubdtype(dtype, np.integer):
+            arr = rng.integers(0, 4, shape).astype(dtype)
+        else:
+            raise TypeError(f"unsupported replay leaf dtype {dtype}")
+        out.append(arr)
+    return tuple(jax.tree_util.tree_unflatten(treedef, out))
+
+
+def _digest(outputs) -> Tuple[str, int]:
+    """(sha256 hex over every output leaf's dtype+shape+bytes, #leaves)."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_leaves(outputs)
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest(), len(leaves)
+
+
+def _run_once(name: str, seed: int) -> Tuple[str, int]:
+    """Build the spec's program from scratch and run it on
+    stream-derived inputs. A FULL rebuild per call on purpose: the
+    second run re-traces and re-compiles, so trace-order
+    nondeterminism diverges here instead of being cached away."""
+    from pvraft_tpu.programs import load_catalog
+    from pvraft_tpu.programs.spec import get
+
+    load_catalog()
+    spec = get(name)
+    fn, args = spec.build()
+    concrete = _materialize(args, seed)
+    return _digest(fn(*concrete))
+
+
+def replay_report(seed: int = DEFAULT_SEED,
+                  programs: Sequence[str] = REPLAY_PROGRAMS
+                  ) -> Dict[str, Any]:
+    """Run each program twice from the same seed; diff bitwise."""
+    import jax
+
+    from pvraft_tpu.programs import load_catalog
+    from pvraft_tpu.programs.spec import get
+
+    load_catalog()
+    entries: List[Dict[str, Any]] = []
+    for name in programs:
+        spec = get(name)
+        d1, n1 = _run_once(name, seed)
+        d2, n2 = _run_once(name, seed)
+        entries.append({
+            "name": name,
+            "determinism": getattr(spec, "determinism", ""),
+            "n_output_leaves": n1,
+            "digest": d1,
+            "digest_rerun": d2,
+            "bitwise_identical": bool(d1 == d2 and n1 == n2),
+        })
+    all_ok = all(e["bitwise_identical"] for e in entries)
+    return {
+        "schema": SCHEMA_VERSION,
+        "seed": int(seed),
+        "platform": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "streams": list(STREAM_NAMES),
+        "programs": entries,
+        "verdict": "bitwise" if all_ok else "divergent",
+    }
+
+
+def write_report(path: str, report: Dict[str, Any]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_report(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("schema") != SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: schema {doc.get('schema')!r}, "
+            f"expected {SCHEMA_VERSION!r}")
+    return doc
+
+
+def check_report(path: str, fresh: Optional[Dict[str, Any]] = None
+                 ) -> List[str]:
+    """Regenerate-and-compare against the committed report.
+
+    Hard on every host: the fresh replay must be bitwise and cover the
+    committed program set with the committed seed/streams, and the
+    committed report must itself claim bitwise. Digests are compared
+    only when the committed platform matches this host's (platform
+    honesty: ratios and hashes from another backend are recorded
+    evidence, not cross-platform assertions). Returns problem strings;
+    empty means the pin holds.
+    """
+    try:
+        committed = load_report(path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        return [f"cannot read committed report: {e}"]
+    if fresh is None:
+        fresh = replay_report(seed=int(committed.get("seed", DEFAULT_SEED)))
+
+    problems: List[str] = []
+    if committed.get("verdict") != "bitwise":
+        problems.append(
+            f"committed verdict is {committed.get('verdict')!r}, "
+            f"not 'bitwise'")
+    if fresh["verdict"] != "bitwise":
+        for e in fresh["programs"]:
+            if not e["bitwise_identical"]:
+                problems.append(
+                    f"program {e['name']} does NOT replay bitwise on "
+                    f"this host: {e['digest'][:16]} vs "
+                    f"{e['digest_rerun'][:16]}")
+    if committed.get("seed") != fresh["seed"]:
+        problems.append(
+            f"seed drift: committed {committed.get('seed')}, "
+            f"fresh {fresh['seed']}")
+    if committed.get("streams") != fresh["streams"]:
+        problems.append(
+            "stream vocabulary drift: committed "
+            f"{committed.get('streams')} vs live {fresh['streams']} — "
+            "regenerate the report after editing rng.STREAMS")
+    want = {e["name"]: e for e in committed.get("programs", [])}
+    got = {e["name"]: e for e in fresh["programs"]}
+    if sorted(want) != sorted(got):
+        problems.append(
+            f"program set drift: committed {sorted(want)}, "
+            f"fresh {sorted(got)}")
+    same_platform = committed.get("platform") == fresh["platform"]
+    for name in sorted(set(want) & set(got)):
+        if not want[name].get("bitwise_identical"):
+            problems.append(f"committed entry {name} is not bitwise")
+        if want[name].get("determinism") != got[name].get("determinism"):
+            problems.append(
+                f"{name}: determinism stance drift — regenerate the "
+                f"report after editing the spec declaration")
+        if same_platform and want[name].get("digest") != \
+                got[name].get("digest"):
+            problems.append(
+                f"{name}: output digest drift on {fresh['platform']} — "
+                f"the program's numerics changed; regenerate "
+                f"artifacts/determinism_report.json if intended")
+    return problems

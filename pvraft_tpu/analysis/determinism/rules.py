@@ -1,0 +1,417 @@
+"""detcheck rules GD001-GD005 — the determinism/RNG failure classes.
+
+Every campaign the ROADMAP points at (paper-parity convergence, pod
+training with per-host seed derivation, the serve A/B canary) silently
+assumes replayable runs; PV-RAFT's 32-iteration GRU refinement is
+exactly the model where one nondeterministic reduction order compounds
+into divergent runs. These rules make the RNG contract
+(:mod:`pvraft_tpu.rng`), the hazard-op declarations (``determinism=``
+on ProgramSpecs), the flag-routing discipline (``compat.py``) and the
+iteration-order conventions machine-checked. Suppress with
+``# graftlint: disable=GDxxx -- reason`` (shared pragma grammar;
+reason-less suppressions fail ``lint --stats``).
+
+Path scoping: inside the installed package ``pvraft_tpu/rng.py`` is
+exempt from GD002 (it is the contract owner) and ``pvraft_tpu/compat.py``
+from GD004 (the flag-routing owner); outside the package (fixtures,
+inline test sources) every rule applies unconditionally so red/green
+corpora stay honest.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+from pvraft_tpu.analysis.engine import Diagnostic, LintContext, Rule
+from pvraft_tpu.analysis.determinism.model import (
+    ModuleDetModel,
+    _DERIVE_FUNCS,
+    _tail,
+    build_module_det_model,
+    resolve_dotted,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class HazardSpec:
+    """One registered ProgramSpec whose static import closure reaches a
+    nondeterminism-hazard op — the GD003 input, computed by
+    :func:`~pvraft_tpu.analysis.determinism.check.hazard_spec_records`
+    (or passed explicitly by fixtures)."""
+
+    name: str
+    determinism: str
+    path: str
+    line: int
+    via: str    # module (path suffix) holding the hazard
+    kinds: Tuple[str, ...]
+
+
+class DetContext(LintContext):
+    """LintContext + the extracted det model + the declared context.
+
+    ``declared_streams=None`` means the caller supplied no stream
+    vocabulary (rng.py unreadable): GD002 then reports the gap as a
+    finding on any file that derives, rather than silently skipping.
+    ``hazard_specs`` carries the registry's hazard closure; rules only
+    report the specs declared in THIS file, so findings anchor at the
+    registration line and the standard suppressions apply."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 model: Optional[ModuleDetModel] = None,
+                 declared_streams: Optional[Sequence[str]] = None,
+                 hazard_specs: Optional[Sequence[HazardSpec]] = None):
+        super().__init__(path, source, tree)
+        self.model = model if model is not None \
+            else build_module_det_model(tree)
+        self.declared_streams = (None if declared_streams is None
+                                 else tuple(declared_streams))
+        self.hazard_specs = tuple(hazard_specs or ())
+
+    def package_suffix(self) -> Optional[str]:
+        """'pvraft_tpu/...' relative suffix, or None for out-of-package
+        sources (fixtures, inline strings) — those see every rule."""
+        if "pvraft_tpu/" in self.norm_path:
+            return "pvraft_tpu/" + self.norm_path.rsplit(
+                "/pvraft_tpu/", 1)[-1]
+        return None
+
+    def diag_at(self, line: int, col: int, rule_id: str,
+                message: str) -> Diagnostic:
+        return Diagnostic(self.path, line, col, rule_id, message)
+
+
+class DetRule(Rule):
+    def check(self, ctx: DetContext) -> Iterable[Diagnostic]:
+        raise NotImplementedError
+
+
+_GD_REGISTRY: List[Type[DetRule]] = []
+
+
+def gd_register(cls: Type[DetRule]) -> Type[DetRule]:
+    if not cls.id or not cls.title:
+        raise ValueError(f"rule {cls.__name__} must set id and title")
+    if any(r.id == cls.id for r in _GD_REGISTRY):
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _GD_REGISTRY.append(cls)
+    return cls
+
+
+def all_determinism_rules() -> Tuple[Type[DetRule], ...]:
+    return tuple(sorted(_GD_REGISTRY, key=lambda r: r.id))
+
+
+def _exempt(ctx: DetContext, exempt: Tuple[str, ...]) -> bool:
+    suffix = ctx.package_suffix()
+    return suffix is not None and suffix in exempt
+
+
+# --- GD001 ----------------------------------------------------------------
+
+_KEY_PRODUCERS = ("jax.random.key", "jax.random.PRNGKey")
+_KEY_TRANSFORMS = ("split", "fold_in", "clone")
+
+
+def _produces_key(value: ast.AST, aliases: Dict[str, str]) -> bool:
+    """Does this expression mint or re-derive a PRNG key?"""
+    if not isinstance(value, ast.Call):
+        return False
+    resolved = resolve_dotted(value.func, aliases)
+    tail = _tail(value.func)
+    return (resolved in _KEY_PRODUCERS
+            or tail in _KEY_TRANSFORMS
+            or tail == "derive")
+
+
+@gd_register
+class KeyReuse(DetRule):
+    """jax PRNG key consumed twice, or consumed unsplit inside a loop.
+
+    A key is one-shot entropy: passing the same key to two samplers (or
+    to the same sampler every loop iteration) makes their draws
+    identical — dropout masks that repeat across layers, per-step noise
+    that repeats across steps. Tracked per function, in line order: an
+    assignment from ``key``/``PRNGKey``/``derive``/``split``/``fold_in``
+    makes a name fresh; any other call consuming it marks it spent;
+    consuming a spent key — or consuming inside a loop a key derived
+    outside it — is the finding. Fix: ``key, sub = jax.random.split(key)``
+    per consumption, or ``fold_in`` the loop index.
+    """
+
+    id = "GD001"
+    title = "key-reuse"
+
+    def check(self, ctx: DetContext) -> Iterable[Diagnostic]:
+        aliases = ctx.model.aliases
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(ctx, node, aliases)
+
+    def _check_fn(self, ctx: DetContext, fn: ast.AST,
+                  aliases: Dict[str, str]) -> Iterable[Diagnostic]:
+        # name -> {"depth": loop depth at assignment, "spent": line|None}
+        keys: Dict[str, Dict[str, object]] = {}
+
+        def assign_targets(node: ast.Assign) -> List[str]:
+            names: List[str] = []
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    names.extend(e.id for e in t.elts
+                                 if isinstance(e, ast.Name))
+            return names
+
+        def consumed_names(call: ast.Call) -> List[str]:
+            out = []
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in keys:
+                        out.append(sub.id)
+            return out
+
+        def visit(stmts: Sequence[ast.stmt],
+                  depth: int) -> Iterable[Diagnostic]:
+            for stmt in stmts:
+                # Consumption first where the statement holds calls
+                # (covers `x = sampler(key)` reading key before the
+                # assignment rebinds anything). Only the statement's
+                # OWN expressions are scanned — compound bodies are
+                # handled by the recursion below at their real loop
+                # depth, and nested defs get their own _check_fn pass.
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    scan: List[ast.AST] = [stmt.iter]
+                elif isinstance(stmt, (ast.While, ast.If)):
+                    scan = [stmt.test]
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    scan = [i.context_expr for i in stmt.items]
+                elif isinstance(stmt, (ast.Try, ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.ClassDef)):
+                    scan = []
+                else:
+                    scan = [stmt]
+                # One draw per statement: a nested consumer
+                # (`outs.append(normal(key))`) is one consumption, not
+                # one per enclosing call.
+                done: set = set()
+                for node in (n for root in scan for n in ast.walk(root)):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    tail = _tail(node.func)
+                    if tail in _KEY_TRANSFORMS or tail == "derive":
+                        continue  # split/fold_in re-derive, not consume
+                    for name in consumed_names(node):
+                        if name in done:
+                            continue
+                        done.add(name)
+                        st = keys[name]
+                        if st["spent"] is not None:
+                            yield ctx.diag_at(
+                                node.lineno, node.col_offset, self.id,
+                                f"PRNG key `{name}` already consumed at "
+                                f"line {st['spent']} — split it "
+                                f"(`{name}, sub = jax.random.split("
+                                f"{name})`) before each use")
+                        elif depth > int(st["depth"]):  # type: ignore[call-overload]
+                            yield ctx.diag_at(
+                                node.lineno, node.col_offset, self.id,
+                                f"PRNG key `{name}` (derived outside "
+                                f"this loop) consumed inside it — every "
+                                f"iteration draws identical randomness; "
+                                f"fold_in the loop index or split per "
+                                f"iteration")
+                            st["spent"] = node.lineno
+                        else:
+                            st["spent"] = node.lineno
+                # Then (re)binding.
+                if isinstance(stmt, ast.Assign):
+                    fresh = _produces_key(stmt.value, aliases)
+                    for name in assign_targets(stmt):
+                        if fresh:
+                            keys[name] = {"depth": depth, "spent": None}
+                        elif name in keys:
+                            del keys[name]  # rebound to a non-key
+                # Recurse into compound statements.
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    yield from visit(stmt.body, depth + 1)
+                    yield from visit(stmt.orelse, depth)
+                elif isinstance(stmt, ast.If):
+                    yield from visit(stmt.body, depth)
+                    yield from visit(stmt.orelse, depth)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    yield from visit(stmt.body, depth)
+                elif isinstance(stmt, ast.Try):
+                    yield from visit(stmt.body, depth)
+                    for h in stmt.handlers:
+                        yield from visit(h.body, depth)
+                    yield from visit(stmt.orelse, depth)
+                    yield from visit(stmt.finalbody, depth)
+
+        yield from visit(fn.body, 0)
+
+
+# --- GD002 ----------------------------------------------------------------
+
+@gd_register
+class UndeclaredEntropy(DetRule):
+    """Entropy minted outside the ``pvraft_tpu.rng`` stream contract.
+
+    Three shapes: (a) a raw RNG constructor — ``jax.random.key``/
+    ``PRNGKey``, ``np.random.default_rng``/legacy globals, stdlib
+    ``random`` — anywhere but ``rng.py`` invents a seed the config seed
+    does not govern (the old warm-up-vs-loadgen seed-0 collision);
+    (b) a time/pid/uuid-derived seed makes the run unreplayable by
+    construction; (c) a ``derive``/``host_rng`` call whose stream name
+    is not declared in :data:`pvraft_tpu.rng.STREAMS` bypasses the
+    vocabulary the whole contract hangs on. Fix: declare a stream and
+    call ``derive(seed, "<stream>", *indices)``.
+    """
+
+    id = "GD002"
+    title = "undeclared-entropy"
+
+    def check(self, ctx: DetContext) -> Iterable[Diagnostic]:
+        if _exempt(ctx, ("pvraft_tpu/rng.py",)):
+            return
+        for site in ctx.model.rng_constructors:
+            yield ctx.diag_at(
+                site.line, site.col, self.id,
+                f"raw RNG constructor `{site.resolved}` outside "
+                f"pvraft_tpu/rng.py — derive entropy from the config "
+                f"seed via a declared stream: rng.derive(seed, "
+                f"'<stream>') / rng.host_rng(seed, '<stream>')")
+        for site in ctx.model.time_seeds:
+            yield ctx.diag_at(
+                site.line, site.col, self.id,
+                f"time/entropy source `{site.via}` seeds `{site.seeding}` "
+                f"— a wall-clock seed is unreplayable by construction; "
+                f"thread the config seed through a declared stream")
+        for site in ctx.model.derive_calls:
+            if ctx.declared_streams is None:
+                yield ctx.diag_at(
+                    site.line, site.col, self.id,
+                    f"`{site.func}` call but the STREAMS vocabulary "
+                    f"could not be read from pvraft_tpu/rng.py — the "
+                    f"stream contract is unverifiable")
+                continue
+            if not site.stream_strs:
+                yield ctx.diag_at(
+                    site.line, site.col, self.id,
+                    f"`{site.func}` call carries no stream name "
+                    f"literal — name the stream: {site.func}(seed, "
+                    f"'<stream>', ...)")
+            for s in site.stream_strs:
+                if s not in ctx.declared_streams:
+                    yield ctx.diag_at(
+                        site.line, site.col, self.id,
+                        f"`{site.func}` uses undeclared stream {s!r} — "
+                        f"declare it in pvraft_tpu.rng.STREAMS "
+                        f"(known: {', '.join(ctx.declared_streams)})")
+
+
+# --- GD003 ----------------------------------------------------------------
+
+@gd_register
+class UndeclaredHazardProgram(DetRule):
+    """Hazard-op program registered without a ``determinism=`` stance.
+
+    Unordered scatter-accumulates, segment reductions and ring-fold
+    collectives are the ops whose float accumulation order is an
+    implementation detail — bitwise replay can hold on one topology and
+    silently break on another. A ProgramSpec whose static import
+    closure reaches such an op must declare ``determinism="..."`` at
+    its registration: the stance (unique-index scatter, topology-fixed
+    ring order, accepted tolerance) becomes reviewable data instead of
+    folklore, and the replay harness records it. Findings anchor at the
+    registration line in THIS file.
+    """
+
+    id = "GD003"
+    title = "undeclared-hazard-program"
+
+    def check(self, ctx: DetContext) -> Iterable[Diagnostic]:
+        norm = ctx.norm_path
+        for spec in ctx.hazard_specs:
+            spec_norm = spec.path.replace("\\", "/")
+            if not (spec_norm == norm or norm.endswith(spec_norm)
+                    or spec_norm.endswith(norm)):
+                continue
+            if spec.determinism:
+                continue
+            yield ctx.diag_at(
+                spec.line, 0, self.id,
+                f"program spec `{spec.name}` reaches nondeterminism-"
+                f"hazard ops ({', '.join(spec.kinds)} via {spec.via}) "
+                f"but declares no determinism= stance — state it at the "
+                f"registration (e.g. determinism='unique-index-scatter; "
+                f"replay-certified')")
+
+
+# --- GD004 ----------------------------------------------------------------
+
+@gd_register
+class UnroutedDeterminismFlag(DetRule):
+    """Backend determinism flag written outside ``compat.py``.
+
+    ``XLA_FLAGS``, ``PYTHONHASHSEED``, matmul precision, x64 and the
+    PRNG implementation/partitionability flags change numerics or RNG
+    semantics process-wide; scattered writes make "which flags was this
+    run under?" unanswerable and let two entry points disagree
+    silently. ``compat.py`` is the one-file owner of version- and
+    backend-fragile surfaces — route the write through a helper there
+    (the ``force_host_device_count`` precedent).
+    """
+
+    id = "GD004"
+    title = "unrouted-determinism-flag"
+
+    def check(self, ctx: DetContext) -> Iterable[Diagnostic]:
+        if _exempt(ctx, ("pvraft_tpu/compat.py",)):
+            return
+        for site in ctx.model.flag_writes:
+            yield ctx.diag_at(
+                site.line, site.col, self.id,
+                f"determinism flag `{site.key}` written via {site.how} "
+                f"outside pvraft_tpu/compat.py — route it through a "
+                f"compat helper so every entry point shares one "
+                f"declaration")
+
+
+# --- GD005 ----------------------------------------------------------------
+
+@gd_register
+class IterationOrderHazard(DetRule):
+    """Unordered iteration feeding data, trace or selection order.
+
+    ``glob``/``listdir`` order is filesystem-dependent: feeding it to
+    dataset indexing or checkpoint selection makes sample order (and
+    therefore every downstream draw) differ across machines — wrap the
+    enumeration in ``sorted(...)`` at the call. Set iteration order is
+    salted per process: driving pytree construction or trace order from
+    a set reorders jaxpr equations between runs — iterate
+    ``sorted(...)`` of the set instead. (Dicts are insertion-ordered
+    and fine.)
+    """
+
+    id = "GD005"
+    title = "iteration-order-hazard"
+
+    def check(self, ctx: DetContext) -> Iterable[Diagnostic]:
+        for site in ctx.model.unsorted_globs:
+            yield ctx.diag_at(
+                site.line, site.col, self.id,
+                f"filesystem enumeration `{site.callee}` is not wrapped "
+                f"in sorted() — listing order is filesystem-dependent; "
+                f"sort at the call site")
+        for site in ctx.model.set_iters:
+            yield ctx.diag_at(
+                site.line, site.col, self.id,
+                f"{site.detail} — set order is salted per process; "
+                f"iterate sorted(...) instead")
+
+
+# re-exported for check.py / fixtures
+DERIVE_FUNCS = _DERIVE_FUNCS

@@ -17,9 +17,25 @@ symbols anywhere outside this file.
 from __future__ import annotations
 
 import inspect
+import os
 from typing import Any
 
 import jax
+
+
+def force_host_device_count(n: int) -> None:
+    """Arrange ``n`` virtual host CPU devices for the replica pool —
+    must run BEFORE the jax backend initializes (the flag is read at
+    backend init, not jax import). Shared by the loadgen and A/B CLIs;
+    a caller-set count in XLA_FLAGS wins. Lives here so every
+    determinism-relevant backend flag (detcheck GD004) is written from
+    one declared owner."""
+    if not n:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}").strip()
 
 
 def _resolve_shard_map():

@@ -20,6 +20,8 @@ from typing import Dict, Iterator, Optional, Sequence
 
 import numpy as np
 
+from pvraft_tpu.rng import host_rng
+
 Item = Dict[str, np.ndarray]
 
 
@@ -61,7 +63,7 @@ class SceneFlowDataset:
             raise RuntimeError("no sample with enough points")
 
         n = self.nb_points
-        rng = np.random.default_rng((self._seed, self._epoch, j))
+        rng = host_rng(self._seed, "data.subsample", self._epoch, j)
         perm1 = rng.permutation(pc1.shape[0])
         perm2 = rng.permutation(pc2.shape[0])
         return {

@@ -20,6 +20,7 @@ from typing import Callable, Iterable, Iterator, Optional
 import numpy as np
 
 from pvraft_tpu.data.generic import Item, SceneFlowDataset, collate
+from pvraft_tpu.rng import host_rng
 
 
 def device_prefetch(
@@ -120,7 +121,7 @@ class PrefetchLoader:
         self.dataset.set_epoch(epoch)
         order = np.arange(len(self.dataset))
         if self.shuffle:
-            np.random.default_rng((self.seed, epoch)).shuffle(order)
+            host_rng(self.seed, "data.shuffle", epoch).shuffle(order)
         rank, world = self.shard
         if world > 1:
             # Truncate to FULL GLOBAL batches before slicing so every rank

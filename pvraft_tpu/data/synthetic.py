@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from pvraft_tpu.data.generic import SceneFlowDataset
+from pvraft_tpu.rng import host_rng
 
 
 def _random_rotation(rng: np.random.Generator, max_angle: float) -> np.ndarray:
@@ -59,7 +60,7 @@ class SyntheticDataset(SceneFlowDataset):
         return self.size
 
     def load_sequence(self, idx: int):
-        rng = np.random.default_rng(self.seed * 100003 + idx)
+        rng = host_rng(self.seed, "data.synthetic", idx)
         n = self.nb_points + (rng.integers(0, self.extra_points + 1) if self.extra_points else 0)
         if self.n_objects == 1:
             pc1 = rng.uniform(-1.0, 1.0, size=(n, 3)).astype(np.float32)

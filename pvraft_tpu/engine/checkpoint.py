@@ -296,7 +296,7 @@ def _orbax_write(path: str, payload: Dict[str, Any], extras=()) -> None:
         # A kill mid-background-write leaves orbax's own uncommitted temp
         # next to our target (tmp.orbax-checkpoint-tmp-*); clear them so
         # crashed runs don't accumulate multi-MB orphans.
-        for orphan in glob.glob(tmp + ".orbax-checkpoint-tmp-*"):
+        for orphan in sorted(glob.glob(tmp + ".orbax-checkpoint-tmp-*")):
             shutil.rmtree(orphan, ignore_errors=True)
     if jax.process_index() == 0:
         # Tiny epoch sidecar so recovery / resume can learn the epoch of a

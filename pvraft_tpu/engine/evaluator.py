@@ -28,6 +28,7 @@ from pvraft_tpu.parallel.mesh import (
     make_mesh,
     replicate,
 )
+from pvraft_tpu.rng import derive
 
 
 def build_eval_dataset(cfg: Config):
@@ -79,7 +80,9 @@ class Evaluator:
         sample = self.dataset[0]
         b = {k: jnp.asarray(v)[None] for k, v in sample.items()}
         self.params = replicate(
-            self.model.init(jax.random.key(0), b["pc1"], b["pc2"], 2),
+            self.model.init(
+                derive(cfg.train.seed, "model.init"),
+                b["pc1"], b["pc2"], 2),
             self.mesh,
         )
         self.eval_step = make_eval_step(

@@ -63,6 +63,7 @@ from pvraft_tpu.parallel.mesh import (
     replicate,
 )
 from pvraft_tpu.profiling import StepTimer, trace_context
+from pvraft_tpu.rng import derive
 
 
 def build_datasets(cfg: Config):
@@ -236,7 +237,7 @@ class Trainer:
         self.model = (PVRaftRefine if refine else PVRaft)(
             cfg.model, mesh=self.mesh if cfg.model.seq_shard else None
         )
-        rng = jax.random.key(cfg.train.seed)
+        rng = derive(cfg.train.seed, "model.init")
         sample = self._device_batch(next(iter(self.train_loader.epoch(0))))
         self.params = self.model.init(
             rng, sample["pc1"], sample["pc2"], cfg.train.iters

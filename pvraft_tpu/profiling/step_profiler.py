@@ -43,6 +43,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional
 
+from pvraft_tpu.rng import DEFAULT_SEED, derive, host_rng
+
 SCHEMA_VERSION = "pvraft_step_profile/v1"
 
 # Cumulative host-synced programs, in ladder order. The tuple is THE
@@ -257,7 +259,7 @@ def profile_step(
     model = PVRaft(cfg)
     platform = jax.devices()[0].platform
 
-    rng = np.random.default_rng(0)
+    rng = host_rng(DEFAULT_SEED, "profile.data")
     pc1 = jnp.asarray(
         rng.uniform(-1, 1, (batch, points, 3)).astype(np.float32))
     pc2 = jnp.asarray(
@@ -268,12 +270,14 @@ def profile_step(
     # must still hold >= truncate_k candidate points for corr_init.
     n_init = min(points, max(256, cfg.truncate_k))
     params = model.init(
-        jax.random.key(0), pc1[:, :n_init], pc2[:, :n_init], 2)
+        derive(DEFAULT_SEED, "model.init"),
+        pc1[:, :n_init], pc2[:, :n_init], 2)
     tx = optax.adam(lr)
     opt_state = tx.init(params)
 
     enc = make_encoder(cfg)
-    enc_params = enc.init(jax.random.key(1), pc1[:, :n_init])
+    enc_params = enc.init(
+        derive(DEFAULT_SEED, "encoder.init"), pc1[:, :n_init])
 
     programs = ladder_programs(
         cfg, model, enc, params, enc_params, tx, opt_state,

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from pvraft_tpu.programs import geometries as g
 from pvraft_tpu.programs.spec import register
+from pvraft_tpu.rng import DEFAULT_SEED, derive
 
 # Tiny trace dims for the profile.* specs — deliberately the audit
 # module's pairwise-distinct dims so an axis mixup cannot type-check.
@@ -73,7 +74,8 @@ def _k_voxel_grad():
 
 
 @register("pallas_fused_lookup_fwd", tags=("kernel", "pallas"),
-          topology=g.TOPOLOGY)
+          topology=g.TOPOLOGY,
+          determinism="unique-index-scatter; replay-certified")
 def _k_fused_fwd():
     """Fused corr-lookup Pallas kernel, forward, flagship geometry."""
     from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
@@ -84,7 +86,8 @@ def _k_fused_fwd():
 
 
 @register("pallas_fused_lookup_grad", tags=("kernel", "pallas"),
-          topology=g.TOPOLOGY)
+          topology=g.TOPOLOGY,
+          determinism="unique-index-scatter; replay-certified")
 def _k_fused_grad():
     """Fused corr-lookup Pallas kernel, VJP, flagship geometry."""
     import jax
@@ -106,7 +109,8 @@ def _abstract_params(model, batch, n_points):
 
     pc = jax.ShapeDtypeStruct((batch, n_points, 3), jnp.float32)
     return jax.eval_shape(
-        lambda r, a, b: model.init(r, a, b, 2), jax.random.key(0), pc, pc)
+        lambda r, a, b: model.init(r, a, b, 2),
+        derive(DEFAULT_SEED, "model.init"), pc, pc)
 
 
 def _flagship_thunk(kind, model_kwargs):
@@ -178,6 +182,7 @@ for _tag, _kwargs, _kinds, _expect in _FLAGSHIP_VARIANTS:
             precision="f32" if _tag.startswith("fp32") else "any",
             topology=g.TOPOLOGY,
             expect_failure=_expect if _kind == "train_step" else "",
+            determinism="unique-index-scatter; replay-certified",
             description=f"flagship {_kind} ({_tag}), "
                         f"{g.FLAGSHIP_POINTS} pts x {g.FLAGSHIP_ITERS} iters",
         )(_flagship_thunk(_kind, _kwargs))
@@ -185,6 +190,7 @@ for _tag, _kwargs, _kinds, _expect in _FLAGSHIP_VARIANTS:
 
 @register("dp_sp_2x2_train_step", tags=("flagship", "train", "sharded"),
           topology=g.TOPOLOGY, n_devices=4,
+          determinism="unique-index-scatter; ring-fold fixed by mesh",
           description="2x2 dp x sp sharded train step (ring correlation)")
 def _dp_sp(devices=None):
     """Batch over ``data``, points over ``seq`` (ring correlation),
@@ -300,6 +306,7 @@ for _tag, _kwargs, _geoms in g.SERVE_CERTIFIED:
             precision="f32" if _tag == "fp32" else "any",
             donate_argnums=g.SERVE_PREDICT_DONATE,
             topology=g.TOPOLOGY,
+            determinism="unique-index-scatter; replay-certified",
             description=f"serve predict ({_tag}) bucket {_bucket} x "
                         f"batch {_bs}, pc1 donated",
         )(_serve_thunk(_kwargs, _bucket, _bs))
@@ -329,8 +336,10 @@ def _profile_thunk(stage):
         tx = optax.adam(1e-3)
 
         def fn(pc1, pc2, mask, gt):
-            params = model.init(jax.random.key(0), pc1, pc2, 2)
-            enc_params = enc.init(jax.random.key(1), pc1)
+            params = model.init(
+                derive(DEFAULT_SEED, "model.init"), pc1, pc2, 2)
+            enc_params = enc.init(
+                derive(DEFAULT_SEED, "encoder.init"), pc1)
             opt_state = tx.init(params)
             progs = dict(ladder_programs(
                 cfg, model, enc, params, enc_params, tx, opt_state,
@@ -346,6 +355,7 @@ def _profile_thunk(stage):
 
 for _stage in g.PROFILE_LADDER_STAGES:
     register(f"profile.{_stage}", tags=("profile",),
+             determinism="unique-index-scatter; replay-certified",
              description=f"step-profiler ladder stage {_stage!r} "
                          "(profiling/step_profiler.py)")(
         _profile_thunk(_stage))

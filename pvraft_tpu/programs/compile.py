@@ -202,7 +202,8 @@ def run_compile(
     rec["total_s"] = round(time.monotonic() - t0, 1)
     if cache_dir and os.path.isdir(cache_dir):
         rec["cache_files"] = len(
-            [f for f in os.listdir(cache_dir) if not f.startswith(".")])
+            [f for f in sorted(os.listdir(cache_dir))
+             if not f.startswith(".")])
     rec["ok"] = all(r["ok"] or r.get("expected_failure") for r in results)
     return rec
 

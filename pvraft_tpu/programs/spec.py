@@ -53,7 +53,11 @@ class ProgramSpec:
     ``None`` means host-trace-only (audit/profile entries).
     ``expect_failure`` documents a known-expected compile outcome
     (``"hbm_oom"``: the program is KEPT in the sweep to document a chip
-    limit). ``path``/``line`` anchor the declaration site for findings
+    limit). ``determinism`` is the detcheck GD003 stance: a short
+    declared position on reduction/scatter ordering (e.g.
+    ``"unique-index-scatter; replay-certified"``) required of any spec
+    whose import closure reaches a nondeterminism-hazard op.
+    ``path``/``line`` anchor the declaration site for findings
     and suppressions."""
 
     name: str
@@ -65,6 +69,7 @@ class ProgramSpec:
     topology: Optional[str] = None
     n_devices: int = 1
     expect_failure: str = ""
+    determinism: str = ""
     description: str = ""
     path: str = ""
     line: int = 0
@@ -99,7 +104,8 @@ def register(name: str, *, tags: Tuple[str, ...] = (),
              precision: str = "f32", spmd_group: Optional[str] = None,
              donate_argnums: Tuple[int, ...] = (),
              topology: Optional[str] = None, n_devices: int = 1,
-             expect_failure: str = "", description: str = ""):
+             expect_failure: str = "", determinism: str = "",
+             description: str = ""):
     """Decorator form: anchor path/line at the ``register(...)`` call
     site — the actual declaration. For ``@register`` on a def that is
     the decorator line; for loop-registered factory thunks it is the
@@ -123,6 +129,7 @@ def register(name: str, *, tags: Tuple[str, ...] = (),
             topology=topology,
             n_devices=n_devices,
             expect_failure=expect_failure,
+            determinism=determinism,
             description=description or (doc.splitlines()[0] if doc else ""),
             path=anchor_path or getattr(code, "co_filename", "") or "",
             line=anchor_line or getattr(code, "co_firstlineno", 0) or 0,

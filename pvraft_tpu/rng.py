@@ -1,0 +1,129 @@
+"""Seed-derivation contract: every RNG in the package starts here.
+
+One config seed, many consumers. Before this module each plane invented
+its own entropy — ``jax.random.key(0)`` inits scattered across the
+audit/catalog/evaluator, ``np.random.default_rng(0)`` warm-up clouds in
+serve (silently colliding with loadgen traffic seeded 0), ad-hoc
+``seed * 100003 + idx`` arithmetic in the data plane. Determinism then
+depends on nobody reusing a constant, which no tool checked.
+
+The contract: a *stream* is a declared name below; every key/generator
+is ``derive(seed, stream, *indices)`` (jax) or ``host_rng(seed, stream,
+*indices)`` (numpy), where the stream name folds in as a stable tag so
+two streams can never collide even from the same seed — the
+``PARTITION_RULES`` discipline applied to entropy. ``detcheck`` (rules
+GD001-GD005, ``pvraft_tpu/analysis/determinism/``) statically enforces
+it: raw RNG constructors outside this file are GD002 findings, and
+stream strings are validated against :data:`STREAMS` both here at call
+time and there at lint time (the table is parsed from this file's AST,
+so the checker and the runtime cannot drift).
+
+Import-light on purpose: jax only inside :func:`derive`, numpy only
+inside :func:`host_rng` — the data plane (which must stay jax-free) and
+the registry (which must stay import-light) both use this module.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Tuple, Union
+
+# The seed used where no config seed exists (registry thunks, audit
+# entries, probe payloads): the de-facto value every hard-coded site
+# used, now spelled once.
+DEFAULT_SEED = 0
+
+# The declared stream vocabulary: (name, what it seeds). Declared as
+# data — like PARTITION_RULES for shardings and KERNEL_BINDINGS for
+# kernel geometry — so GD002 can parse this tuple statically and flag
+# any call site using a name that is not here.
+STREAMS: Tuple[Tuple[str, str], ...] = (
+    ("model.init", "network parameter initialization"),
+    ("encoder.init", "encoder-only init (step-profiler ladder)"),
+    ("data.shuffle", "epoch-level sample order (PrefetchLoader)"),
+    ("data.subsample", "per-scene subsample permutations"),
+    ("data.synthetic", "synthetic scene-flow scene generation"),
+    ("serve.probe", "supervisor health-probe payload cloud"),
+    ("serve.loadgen", "load-generator request payloads"),
+    ("serve.retry_jitter", "load-generator retry backoff jitter"),
+    ("profile.data", "step-profiler synthetic input clouds"),
+    ("replay.input", "determinism replay input materialization"),
+)
+
+STREAM_NAMES: Tuple[str, ...] = tuple(name for name, _ in STREAMS)
+
+
+def stream_tag(name: str) -> int:
+    """Stable 31-bit tag of a declared stream name.
+
+    crc32 of the name, masked positive: stable across processes and
+    python versions (unlike ``hash``), cheap, and collision-free over
+    the declared vocabulary (validated at import below).
+    """
+    if name not in STREAM_NAMES:
+        raise ValueError(
+            f"undeclared rng stream {name!r}; declare it in "
+            f"pvraft_tpu.rng.STREAMS (known: {', '.join(STREAM_NAMES)})")
+    return zlib.crc32(name.encode("utf-8")) & 0x7FFFFFFF
+
+
+# A tag collision would silently merge two streams; with a ~10-entry
+# vocabulary this is astronomically unlikely, but check once at import
+# so adding a colliding name fails loudly, not statistically.
+_tags = [zlib.crc32(n.encode("utf-8")) & 0x7FFFFFFF for n in STREAM_NAMES]
+if len(set(_tags)) != len(_tags):  # pragma: no cover - vocabulary bug
+    raise AssertionError("rng stream tag collision in STREAMS")
+del _tags
+
+
+def _fold_parts(parts: Tuple[Union[str, int], ...]) -> Tuple[int, ...]:
+    if not parts or not isinstance(parts[0], str):
+        raise ValueError(
+            "derive/host_rng need a declared stream name as the first "
+            "part: derive(seed, 'model.init', ...)")
+    out = []
+    for p in parts:
+        if isinstance(p, str):
+            out.append(stream_tag(p))
+        elif isinstance(p, (int,)) and not isinstance(p, bool):
+            out.append(int(p))
+        else:
+            raise TypeError(
+                f"rng derivation parts must be declared stream names or "
+                f"ints, got {type(p).__name__}: {p!r}")
+    return tuple(out)
+
+
+def derive(seed: int, *parts: Union[str, int]):
+    """A jax PRNG key for ``(seed, *parts)`` via a fold_in chain.
+
+    ``parts`` is a declared stream name followed by optional integer
+    indices (epoch, replica, item...). Every distinct part sequence is
+    an independent stream of the same config seed.
+    """
+    import jax
+
+    key = jax.random.key(int(seed))
+    for tag in _fold_parts(parts):
+        key = jax.random.fold_in(key, tag)
+    return key
+
+
+def host_rng(seed: int, *parts: Union[str, int]):
+    """A ``numpy.random.Generator`` for ``(seed, *parts)``.
+
+    The host-side twin of :func:`derive` (data plane, serve payloads,
+    profiler clouds — everywhere numpy sampling happens outside a
+    trace). The entropy tuple seeds a SeedSequence, so distinct streams
+    and indices are independent by construction; jax is never imported.
+    """
+    import numpy as np
+
+    return np.random.default_rng((int(seed),) + _fold_parts(parts))
+
+
+def host_entropy(seed: int, *parts: Union[str, int]) -> Tuple[int, ...]:
+    """The raw entropy tuple ``host_rng`` seeds with — for consumers
+    that derive outside numpy (the native C++ loader takes plain ints).
+    """
+    return (int(seed),) + _fold_parts(parts)

@@ -68,6 +68,7 @@ from pvraft_tpu.programs.geometries import (
     predict_program_name,
     serve_program_keys,
 )
+from pvraft_tpu.rng import DEFAULT_SEED, host_rng
 from pvraft_tpu.serve.aot import AotProgram, aot_compile
 
 
@@ -418,7 +419,7 @@ class InferenceEngine:
         a backend compile (the sealed retrace watchdog stays quiet).
         The engine owns the request contract, so the payload is built
         here, not in the supervisor."""
-        rng = np.random.default_rng(0)
+        rng = host_rng(DEFAULT_SEED, "serve.probe")
         scale = min(1.0, 0.5 * self.cfg.coord_limit)
         cloud = rng.uniform(
             -scale, scale,

@@ -37,20 +37,15 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pvraft_tpu.rng import host_rng
+
 SCHEMA_VERSION = "pvraft_serve_load/v1"
 
 
-def force_host_device_count(n: int) -> None:
-    """Arrange ``n`` virtual host CPU devices for the replica pool —
-    must run BEFORE the jax backend initializes (the flag is read at
-    backend init, not jax import). Shared by the loadgen and A/B CLIs;
-    a caller-set count in XLA_FLAGS wins."""
-    if not n:
-        return
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count={n}").strip()
+# Re-exported for the serve CLIs (scripts/serve_*.py): the flag write
+# itself now lives with the other backend declarations in compat.py
+# (detcheck GD004 — one owner for determinism-relevant XLA_FLAGS).
+from pvraft_tpu.compat import force_host_device_count  # noqa: F401
 
 
 def write_load_and_trace(out_path: str, artifact: Dict[str, Any],
@@ -314,9 +309,7 @@ def run_load(
     ``per_request`` entry carries an ``attempts`` list (schema-additive)
     and its top-level status/ms are the FINAL attempt's — a request that
     eventually succeeds counts ``ok``."""
-    import random
-
-    rng = np.random.default_rng(seed)
+    rng = host_rng(seed, "serve.loadgen")
     # Pre-generate the request payloads so client threads measure the
     # server, not numpy.
     payloads = []
@@ -339,7 +332,7 @@ def run_load(
                 if i >= n_requests:
                     return
                 cursor["i"] = i + 1
-            jitter = random.Random((seed + 1) * 100003 + i)
+            jitter = host_rng(seed, "serve.retry_jitter", i)
             attempts: List[Dict[str, Any]] = []
             for attempt in range(retries + 1):
                 t0 = time.monotonic()

@@ -48,6 +48,7 @@ import numpy as np
 from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
 from pvraft_tpu.obs.events import REPLICA_STATES
 from pvraft_tpu.programs.geometries import SUPERVISOR_DEFAULTS
+from pvraft_tpu.rng import DEFAULT_SEED, host_rng
 from pvraft_tpu.serve import faults
 
 
@@ -174,7 +175,7 @@ class ReplicaSupervisor:
             n_pts = max(int(getattr(ecfg, "min_points", 4)), 1)
             scale = min(1.0,
                         0.5 * float(getattr(ecfg, "coord_limit", 100.0)))
-            rng = np.random.default_rng(0)
+            rng = host_rng(DEFAULT_SEED, "serve.probe")
             self._probe_cloud = rng.uniform(
                 -scale, scale, (n_pts, 3)).astype(np.float32)
             self._probe_bucket = int(ecfg.buckets[0])

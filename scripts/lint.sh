@@ -74,6 +74,33 @@ echo "== shardcheck: committed pod memory/comms plan matches the declared inputs
 # 100k-point scene train on" that ROADMAP item 2 cites.
 python -m pvraft_tpu.analysis sharding --check artifacts/pod_plan.json
 
+echo "== detcheck: determinism/seed-discipline static analysis (GD rules) over the whole package"
+# The sixth analysis engine (ISSUE 16): jax PRNG key reuse /
+# consumed-without-split dataflow (GD001), entropy minted outside the
+# pvraft_tpu.rng stream contract — host RNG constructors, raw
+# jax.random.key, time-derived seeds, undeclared stream names —
+# (GD002), nondeterminism-hazard ops (unordered scatter-adds, segment
+# reductions, ring-fold accumulation) reachable from a registered
+# program that declares no determinism= stance (GD003), backend
+# determinism flags written outside compat.py (GD004), and
+# iteration-order hazards — set iteration feeding trace order,
+# unsorted filesystem listings feeding data/checkpoint selection —
+# (GD005). Zero findings on the clean tree — real violations get fixed
+# (the deepcheck precedent), not pragma'd. Pure stdlib AST + the
+# jax-free registry inspection; no jax.
+python -m pvraft_tpu.analysis determinism
+
+echo "== detcheck: committed bitwise-replay report matches a fresh replay"
+# artifacts/determinism_report.json (pvraft_determinism/v1) is the
+# dynamic half of the gate: the registered train step and serve
+# dispatch are rebuilt twice from the config seed and every output
+# leaf diffed bitwise. The check replays HERE and now — a program that
+# stops replaying bitwise on this host fails regardless of what the
+# committed report says; raw digests are additionally pinned when the
+# committed platform matches (CPU CI cannot check TPU hashes).
+JAX_PLATFORMS=cpu \
+  python -m pvraft_tpu.analysis determinism --check artifacts/determinism_report.json
+
 echo "== programs: committed kernel-compile evidence covers the kernel tag"
 # artifacts/programs_kernels.json must name exactly the kernel-tagged
 # registry specs, each with a successful Mosaic compile record — both

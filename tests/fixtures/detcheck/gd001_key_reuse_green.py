@@ -1,0 +1,22 @@
+"""GD001 green: the split/fold_in discipline — every consumption gets
+a fresh subkey; loops fold the iteration index in."""
+
+import jax
+
+
+def split_per_use(shape):
+    key = jax.random.key(0)
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, shape)
+    key, sub2 = jax.random.split(key)
+    b = jax.random.uniform(sub2, shape)
+    return a, b
+
+
+def fold_per_iteration(shape, n):
+    key = jax.random.key(1)
+    outs = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)
+        outs.append(jax.random.normal(k, shape))
+    return outs

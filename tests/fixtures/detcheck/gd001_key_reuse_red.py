@@ -1,0 +1,19 @@
+"""GD001 red: one key consumed twice, and a loop-invariant key
+consumed every iteration (both draw identical randomness)."""
+
+import jax
+
+
+def double_consume(shape):
+    key = jax.random.key(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)   # GD001: key already consumed
+    return a, b
+
+
+def loop_reuse(shape, n):
+    key = jax.random.key(1)
+    outs = []
+    for _ in range(n):
+        outs.append(jax.random.normal(key, shape))  # GD001: loop reuse
+    return outs

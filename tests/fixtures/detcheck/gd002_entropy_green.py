@@ -1,0 +1,14 @@
+"""GD002 green: entropy only via the declared stream contract; jax
+samplers CONSUME keys (they never mint entropy) so the `from jax
+import random` alias must not be mistaken for the stdlib module."""
+
+from jax import random
+
+from pvraft_tpu.rng import derive, host_rng
+
+
+def declared_streams(seed, shape):
+    key = derive(seed, "model.init")
+    noise = random.normal(key, shape)           # sampler, not a mint
+    order = host_rng(seed, "data.shuffle", 0)
+    return noise, order

@@ -1,0 +1,21 @@
+"""GD002 red: every undeclared-entropy shape — raw host/jax RNG
+constructors, a wall-clock seed, a streamless derive and an undeclared
+stream name (declared vocabulary in the test: model.init, data.shuffle)."""
+
+import random
+import time
+
+import numpy as np
+
+from pvraft_tpu.rng import derive, host_rng
+
+
+def mint_entropy(seed):
+    rng = np.random.default_rng(0)              # GD002: raw constructor
+    jitter = random.Random(seed)                # GD002: stdlib random
+    clock = np.random.default_rng(
+        int(time.time()))                       # GD002: x2, time-seeded
+    k = derive(seed)                            # GD002: no stream literal
+    k2 = derive(seed, "not.a.stream")           # GD002: undeclared stream
+    ok = host_rng(seed, "data.shuffle")         # fine: declared stream
+    return rng, jitter, clock, k, k2, ok

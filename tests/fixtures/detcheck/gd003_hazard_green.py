@@ -1,0 +1,12 @@
+"""GD003 green: the same registration with the stance declared — the
+test injects the HazardSpec carrying that non-empty determinism."""
+
+from pvraft_tpu.programs.spec import register
+
+
+@register("fixture.hazard_program", tags=("kernel",),
+          determinism="unique-index-scatter; replay-certified")
+def _hazard_thunk():
+    from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
+
+    return fused_corr_lookup, ()

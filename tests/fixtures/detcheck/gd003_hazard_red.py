@@ -1,0 +1,13 @@
+"""GD003 red: a registration site for a program whose import closure
+reaches a scatter-accumulate, with NO determinism= stance. The test
+injects the matching HazardSpec (the registry inspection's output) with
+``determinism=""`` — the finding must anchor at the register call."""
+
+from pvraft_tpu.programs.spec import register
+
+
+@register("fixture.hazard_program", tags=("kernel",))
+def _hazard_thunk():
+    from pvraft_tpu.ops.pallas.corr_lookup import fused_corr_lookup
+
+    return fused_corr_lookup, ()

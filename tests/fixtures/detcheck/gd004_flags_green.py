@@ -1,0 +1,12 @@
+"""GD004 green: placement/caching knobs are NOT determinism levers —
+the watched list is deliberately narrow."""
+
+import os
+
+import jax
+
+
+def placement_knobs(cache_dir):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("PVRAFT_PALLAS_INTERPRET", "1")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)

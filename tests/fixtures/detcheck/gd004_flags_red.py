@@ -1,0 +1,15 @@
+"""GD004 red: every watched flag-write shape outside compat.py —
+subscript env write, setdefault, config.update and the config
+attribute assignment."""
+
+import os
+
+import jax
+
+
+def scatter_flags():
+    os.environ["XLA_FLAGS"] = "--xla_foo"                   # GD004
+    os.environ.setdefault("PYTHONHASHSEED", "0")            # GD004
+    jax.config.update("jax_default_matmul_precision",       # GD004
+                      "float32")
+    jax.config.jax_enable_x64 = True                        # GD004

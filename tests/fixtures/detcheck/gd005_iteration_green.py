@@ -1,0 +1,17 @@
+"""GD005 green: sorted() at every enumeration; dicts (insertion-
+ordered) iterate freely."""
+
+import glob
+import os
+from pathlib import Path
+
+
+def ordered(params, ckpt_dir):
+    tree = {}
+    for name in sorted({"encoder", "gru", "head"}):
+        tree[name] = params[name]
+    for name in params:          # dict iteration is insertion-ordered
+        tree.setdefault(name, params[name])
+    files = sorted(glob.glob(os.path.join(ckpt_dir, "*.ckpt")))
+    latest = sorted(Path(ckpt_dir).rglob("*.orbax"))
+    return tree, files, latest

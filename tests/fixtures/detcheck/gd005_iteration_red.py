@@ -1,0 +1,16 @@
+"""GD005 red: set iteration feeding construction order, and
+filesystem enumerations whose order is filesystem-dependent."""
+
+import glob
+import os
+from pathlib import Path
+
+
+def unordered(params, ckpt_dir):
+    tree = {}
+    for name in {"encoder", "gru", "head"}:        # GD005: set literal
+        tree[name] = params[name]
+    stale = [p for p in set(tree)]                 # GD005: set() iter
+    files = glob.glob(os.path.join(ckpt_dir, "*.ckpt"))  # GD005
+    latest = Path(ckpt_dir).rglob("*.orbax")       # GD005: Path.rglob
+    return tree, stale, files, latest

@@ -1,0 +1,399 @@
+"""detcheck: the GD rules red/green over the fixture corpus, the rng
+stream contract (derivation determinism, tag uniqueness, declared
+vocabulary), the registry hazard closure, the clean-tree zero-findings
+gate, the CLI, the replay-report machinery (drift detection with an
+injected fresh report — no program execution) and the loader
+reproducibility guarantees the contract exists for."""
+
+import ast
+import contextlib
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pvraft_tpu.analysis.__main__ import main as analysis_main
+from pvraft_tpu.analysis.engine import known_rule_ids
+from pvraft_tpu.analysis.determinism.check import (
+    check_paths,
+    check_source,
+    declared_streams,
+    default_scope,
+    hazard_spec_records,
+)
+from pvraft_tpu.analysis.determinism.model import build_module_det_model
+from pvraft_tpu.analysis.determinism.replay import (
+    REPLAY_PROGRAMS,
+    SCHEMA_VERSION,
+    check_report,
+    load_report,
+    write_report,
+)
+from pvraft_tpu.analysis.determinism.rules import (
+    HazardSpec,
+    all_determinism_rules,
+)
+from pvraft_tpu import rng
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "detcheck")
+REPORT = os.path.join(REPO, "artifacts", "determinism_report.json")
+
+# The vocabulary the GD002 fixtures are checked against.
+TEST_STREAMS = ("model.init", "data.shuffle")
+
+
+def _fixture(name):
+    with open(os.path.join(FIXTURES, name), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def _check(name, rule, streams=TEST_STREAMS, hazard_specs=()):
+    return check_source(_fixture(name), path=os.path.join(FIXTURES, name),
+                        rule_ids=(rule,), streams=streams,
+                        hazard_specs=hazard_specs)
+
+
+# ------------------------------------------------------------- registry --
+
+def test_rule_table():
+    rules = all_determinism_rules()
+    assert [r.id for r in rules] == [
+        "GD001", "GD002", "GD003", "GD004", "GD005"]
+    for r in rules:
+        assert r.title and r.__doc__
+
+
+def test_gd_ids_in_shared_pragma_namespace():
+    ids = known_rule_ids()
+    assert {"GD000", "GD001", "GD002", "GD003", "GD004", "GD005"} <= ids
+
+
+# ------------------------------------------------------- the rng contract --
+
+def test_streams_declared_and_tags_unique():
+    assert len(rng.STREAM_NAMES) == len(set(rng.STREAM_NAMES))
+    tags = [rng.stream_tag(s) for s in rng.STREAM_NAMES]
+    assert len(tags) == len(set(tags))
+    with pytest.raises(ValueError):
+        rng.stream_tag("not.a.stream")
+
+
+def test_declared_streams_match_runtime():
+    assert declared_streams() == rng.STREAM_NAMES
+
+
+def test_derive_deterministic_and_stream_separated():
+    import jax
+
+    a = jax.random.key_data(rng.derive(0, "model.init"))
+    b = jax.random.key_data(rng.derive(0, "model.init"))
+    c = jax.random.key_data(rng.derive(0, "encoder.init"))
+    d = jax.random.key_data(rng.derive(1, "model.init"))
+    assert (np.asarray(a) == np.asarray(b)).all()
+    assert not (np.asarray(a) == np.asarray(c)).all()
+    assert not (np.asarray(a) == np.asarray(d)).all()
+
+
+def test_derive_rejects_undeclared_stream_and_bad_parts():
+    with pytest.raises(ValueError):
+        rng.derive(0, "no.such.stream")
+    with pytest.raises(ValueError):
+        rng.host_rng(0)          # stream name is mandatory
+    with pytest.raises(TypeError):
+        rng.host_rng(0, "model.init", True)  # bool is not an index
+
+
+def test_host_rng_deterministic_with_indices():
+    a = rng.host_rng(3, "data.shuffle", 7).random(8)
+    b = rng.host_rng(3, "data.shuffle", 7).random(8)
+    c = rng.host_rng(3, "data.shuffle", 8).random(8)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+# ------------------------------------------------------- fixtures: GD001 --
+
+def test_gd001_red():
+    diags = _check("gd001_key_reuse_red.py", "GD001")
+    assert len(diags) == 2
+    assert "already consumed" in diags[0].message
+    assert "consumed inside" in diags[1].message
+
+
+def test_gd001_green():
+    assert _check("gd001_key_reuse_green.py", "GD001") == []
+
+
+# ------------------------------------------------------- fixtures: GD002 --
+
+def test_gd002_red():
+    diags = _check("gd002_entropy_red.py", "GD002")
+    msgs = "\n".join(d.message for d in diags)
+    assert sum("raw RNG constructor" in d.message for d in diags) == 3
+    assert "time/entropy source `time.time`" in msgs
+    assert "no stream name literal" in msgs
+    assert "undeclared stream 'not.a.stream'" in msgs
+    # the declared-stream host_rng call is NOT flagged
+    assert "data.shuffle" not in msgs.replace(
+        "known: model.init, data.shuffle", "")
+
+
+def test_gd002_green():
+    assert _check("gd002_entropy_green.py", "GD002") == []
+
+
+def test_gd002_unreadable_vocabulary_is_a_finding():
+    diags = _check("gd002_entropy_green.py", "GD002", streams=None)
+    assert diags and "unverifiable" in diags[0].message
+
+
+# ------------------------------------------------------- fixtures: GD003 --
+
+def _hazard_spec(path, determinism):
+    return HazardSpec(
+        name="fixture.hazard_program", determinism=determinism,
+        path=path, line=9, via="pvraft_tpu/ops/pallas/corr_lookup.py",
+        kinds=("scatter-accumulate",))
+
+
+def test_gd003_red_and_green():
+    red = os.path.join(FIXTURES, "gd003_hazard_red.py")
+    diags = _check("gd003_hazard_red.py", "GD003",
+                   hazard_specs=(_hazard_spec(red, ""),))
+    assert len(diags) == 1
+    assert diags[0].line == 9
+    assert "determinism= stance" in diags[0].message
+
+    green = os.path.join(FIXTURES, "gd003_hazard_green.py")
+    assert _check("gd003_hazard_green.py", "GD003",
+                  hazard_specs=(_hazard_spec(
+                      green, "unique-index-scatter"),)) == []
+
+
+def test_gd003_other_files_unaffected():
+    # A hazard spec anchored elsewhere must not leak findings here.
+    spec = _hazard_spec("/somewhere/else/catalog.py", "")
+    assert _check("gd001_key_reuse_green.py", "GD003",
+                  hazard_specs=(spec,)) == []
+
+
+# ------------------------------------------------------- fixtures: GD004 --
+
+def test_gd004_red():
+    diags = _check("gd004_flags_red.py", "GD004")
+    keys = sorted(d.message.split("`")[1] for d in diags)
+    assert keys == ["PYTHONHASHSEED", "XLA_FLAGS",
+                    "jax_default_matmul_precision", "jax_enable_x64"]
+
+
+def test_gd004_green():
+    assert _check("gd004_flags_green.py", "GD004") == []
+
+
+# ------------------------------------------------------- fixtures: GD005 --
+
+def test_gd005_red():
+    diags = _check("gd005_iteration_red.py", "GD005")
+    msgs = "\n".join(d.message for d in diags)
+    assert len(diags) == 4
+    assert "set literal" in msgs
+    assert "set() result" in msgs
+    assert "glob.glob" in msgs
+    assert ".rglob()" in msgs
+
+
+def test_gd005_green():
+    assert _check("gd005_iteration_green.py", "GD005") == []
+
+
+# ------------------------------------------------- model extraction unit --
+
+def test_alias_resolution_distinguishes_jax_from_stdlib_random():
+    src = ("from jax import random\n"
+           "import random as pyrandom\n"
+           "def f(key, seed):\n"
+           "    a = random.normal(key, (2,))\n"
+           "    b = pyrandom.Random(seed)\n")
+    model = build_module_det_model(ast.parse(src))
+    assert [s.resolved for s in model.rng_constructors] == ["random.Random"]
+
+
+def test_suppression_pragma_honored():
+    src = ("import numpy as np\n"
+           "rng = np.random.default_rng(0)"
+           "  # graftlint: disable=GD002 -- fixture\n")
+    assert check_source(src, rule_ids=("GD002",), streams=TEST_STREAMS) == []
+    bare = "import numpy as np\nrng = np.random.default_rng(0)\n"
+    assert len(check_source(bare, rule_ids=("GD002",),
+                            streams=TEST_STREAMS)) == 1
+
+
+def test_syntax_error_is_gd000():
+    diags = check_source("def broken(:\n", streams=TEST_STREAMS)
+    assert [d.rule_id for d in diags] == ["GD000"]
+
+
+# ------------------------------------------------- registry hazard closure --
+
+def test_hazard_closure_covers_the_real_programs():
+    records = {r.name: r for r in hazard_spec_records()}
+    # The replay corpus must be hazard-bearing (that is WHY it replays).
+    for name in REPLAY_PROGRAMS:
+        assert name in records, sorted(records)
+    assert "ring.ring_corr_init" in records
+    assert records["ring.ring_corr_init"].kinds == ("ring-fold",)
+    assert "scatter-accumulate" in records["engine.train_step"].kinds
+
+
+def test_hazard_closure_all_declared():
+    # The GD003 clean-tree condition, stated directly: every
+    # hazard-bearing registered program carries a stance.
+    undeclared = [r.name for r in hazard_spec_records() if not r.determinism]
+    assert undeclared == []
+
+
+# ------------------------------------------------------------ clean tree --
+
+def test_clean_tree_zero_findings():
+    """The lint.sh stage in test form: the shipped tree carries zero GD
+    findings with the live stream vocabulary + registry closure."""
+    findings, nfiles = check_paths(list(default_scope()))
+    assert findings == [], [d.format() for d in findings]
+    assert nfiles > 100
+
+
+# ------------------------------------------------------------------- CLI --
+
+def test_cli_list_rules():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["determinism", "--list-rules"])
+    assert rc == 0
+    out = buf.getvalue()
+    for rid in ("GD001", "GD002", "GD003", "GD004", "GD005"):
+        assert rid in out
+
+
+def test_cli_red_fixture_and_select():
+    path = os.path.join(FIXTURES, "gd004_flags_red.py")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), \
+            contextlib.redirect_stderr(io.StringIO()):
+        rc = analysis_main(["determinism", "--select", "GD004", path])
+    assert rc == 1
+    assert "GD004" in buf.getvalue()
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), \
+            contextlib.redirect_stderr(io.StringIO()):
+        rc = analysis_main(["determinism", "--select", "GD001", path])
+    assert rc == 0
+
+
+# ---------------------------------------------------------- replay report --
+
+def _committed():
+    return load_report(REPORT)
+
+
+def test_committed_report_schema_and_verdict():
+    doc = _committed()
+    assert doc["schema"] == SCHEMA_VERSION
+    assert doc["verdict"] == "bitwise"
+    assert doc["streams"] == list(rng.STREAM_NAMES)
+    names = [e["name"] for e in doc["programs"]]
+    assert names == list(REPLAY_PROGRAMS)
+    for e in doc["programs"]:
+        assert e["bitwise_identical"]
+        assert e["digest"] == e["digest_rerun"]
+        assert e["determinism"]  # the stance rides into the evidence
+
+
+def test_check_report_accepts_identical_fresh():
+    assert check_report(REPORT, fresh=_committed()) == []
+
+
+def test_check_report_flags_divergence_and_drift(tmp_path):
+    committed = _committed()
+
+    fresh = json.loads(json.dumps(committed))
+    fresh["programs"][0]["digest_rerun"] = "0" * 64
+    fresh["programs"][0]["bitwise_identical"] = False
+    fresh["verdict"] = "divergent"
+    problems = check_report(REPORT, fresh=fresh)
+    assert any("does NOT replay bitwise" in p for p in problems)
+
+    fresh = json.loads(json.dumps(committed))
+    fresh["streams"] = fresh["streams"] + ["new.stream"]
+    assert any("stream vocabulary drift" in p
+               for p in check_report(REPORT, fresh=fresh))
+
+    fresh = json.loads(json.dumps(committed))
+    fresh["programs"][0]["name"] = "engine.other_step"
+    assert any("program set drift" in p
+               for p in check_report(REPORT, fresh=fresh))
+
+    # Digest drift fails on the same platform, passes cross-platform.
+    fresh = json.loads(json.dumps(committed))
+    fresh["programs"][0]["digest"] = "f" * 64
+    same = check_report(REPORT, fresh=fresh)
+    assert any("digest drift" in p for p in same)
+    fresh["platform"] = "tpu"
+    cross = check_report(REPORT, fresh=fresh)
+    assert not any("digest drift" in p for p in cross)
+
+    # A committed report that itself claims divergence always fails.
+    bad = json.loads(json.dumps(committed))
+    bad["verdict"] = "divergent"
+    path = tmp_path / "divergent.json"
+    write_report(str(path), bad)
+    assert any("committed verdict" in p
+               for p in check_report(str(path), fresh=committed))
+
+
+# --------------------------------------- loader reproducibility guarantees --
+
+def test_loader_order_invariant_to_num_workers():
+    from pvraft_tpu.data import PrefetchLoader, SyntheticDataset
+
+    ds = SyntheticDataset(size=12, nb_points=16, seed=9)
+    runs = []
+    for workers in (0, 1, 3):
+        loader = PrefetchLoader(ds, 3, shuffle=True, num_workers=workers,
+                                seed=11)
+        runs.append([b["pc1"] for b in loader.epoch(2)])
+    for other in runs[1:]:
+        assert len(runs[0]) == len(other)
+        for a, b in zip(runs[0], other):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_loader_epoch_replay_bitwise():
+    from pvraft_tpu.data import PrefetchLoader, SyntheticDataset
+
+    ds = SyntheticDataset(size=8, nb_points=16, seed=5)
+    loader = PrefetchLoader(ds, 2, shuffle=True, num_workers=2, seed=13)
+    first = [b["pc1"] for b in loader.epoch(4)]
+    again = [b["pc1"] for b in loader.epoch(4)]
+    other = [b["pc1"] for b in loader.epoch(5)]
+    for a, b in zip(first, again):
+        np.testing.assert_array_equal(a, b)
+    assert not all(np.array_equal(a, b) for a, b in zip(first, other))
+
+
+def test_generic_subsample_replays_bitwise():
+    from pvraft_tpu.data import SyntheticDataset
+
+    ds = SyntheticDataset(size=4, nb_points=32, extra_points=16, seed=2)
+    ds.set_epoch(3)
+    a = ds[1]
+    b = ds[1]
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+    ds.set_epoch(4)  # per-epoch resampling: a DIFFERENT draw, replayable
+    c = ds[1]
+    assert not np.array_equal(a["pc1"], c["pc1"])
+    d = ds[1]
+    np.testing.assert_array_equal(c["pc1"], d["pc1"])
