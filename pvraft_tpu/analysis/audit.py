@@ -418,8 +418,9 @@ def _e_train_step():
              determinism="unique-index-scatter; replay-certified")
 def _e_train_step_opt():
     # Full optimized train step: scatter-free VJPs, dots remat policy,
-    # bf16 gradient cast — the bench A/B configuration, traced end to
-    # end. The lever values come from the registry's single declaration
+    # bf16 gradient cast, fused GRU kernel — the bench A/B
+    # configuration, traced end to end. The lever values come from the
+    # registry's single declaration
     # (programs/geometries.AB_PRIMARY), so the variant bench.py measures
     # and the variant deepcheck walks cannot drift apart.
     import jax

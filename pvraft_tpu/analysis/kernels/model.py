@@ -206,8 +206,28 @@ class KernelModel:
         if self.operands is None or self.out_info is None or \
                 any(op is None for op in self.operands):
             return None
-        return (sum(op.nbytes for op in self.operands if op is not None),
-                sum(o.nbytes for o in self.out_info))
+        return (sum(_hbm_layout_bytes(op) for op in self.operands
+                    if op is not None),
+                sum(_hbm_layout_bytes(o) for o in self.out_info))
+
+
+def _hbm_layout_bytes(info: ArrayInfo) -> int:
+    """HBM bytes of one operand under XLA:TPU's argument layout.
+
+    Rank-2 arrays are stored (8, 128)-tiled — sublanes padded to 8,
+    lanes to 128 — with XLA free to transpose when that wastes less
+    (``pallas_gru_iter_fwd``'s (128, 64) weight lands as 64x128, zero
+    pad). Rank>=3 arrays get a compact layout: XLA picks the dim order,
+    and every kernel operand here has a >=128 axis to put minormost.
+    Matches the committed ``programs_kernels.json`` argument sizes
+    byte-exactly across all three kernels — the planner's fwd exactness
+    pin (tests/test_kernelcheck.py) rides on this agreement."""
+    if len(info.shape) != 2:
+        return info.nbytes
+    r, c = info.shape
+    pad = lambda v, m: -(-v // m) * m  # noqa: E731
+    elems = min(pad(r, 8) * pad(c, 128), pad(c, 8) * pad(r, 128))
+    return elems * DTYPE_BYTES.get(info.dtype, 4)
 
 
 @dataclasses.dataclass
@@ -243,6 +263,23 @@ def _fused_env() -> Dict[str, Any]:
     return env
 
 
+def _gru_env() -> Dict[str, Any]:
+    """``_gru_forward`` parameters at the flagship geometry: H=C=D=64
+    feature blocks, the FLOW_PAD=8 padded flow, and the packed weight
+    8-tuple from ``pack_gru_weights`` (shapes for hidden=64,
+    context=64). ``truncate_k`` drives the plan-certified tile choice."""
+    env = _flagship_env()
+    b, n, k = env["b"], env["n"], env["k"]
+    feat = ArrayInfo((b, n, 64))
+    weights = (ArrayInfo((64, 64)), ArrayInfo((8, 64)),
+               ArrayInfo((128, 64)), ArrayInfo((64, 192)),
+               ArrayInfo((64, 192)), ArrayInfo((64, 192)),
+               ArrayInfo((8, 192)), ArrayInfo((8, 192)))
+    env.update(net=feat, inp=feat, cor=feat, flow8=ArrayInfo((b, n, 8)),
+               weights=weights, truncate_k=k, dtype_name="float32")
+    return env
+
+
 # path suffix (forward slashes) -> {enclosing function: env factory}.
 # The env binds the enclosing function's PARAMETERS at the flagship
 # geometry — the same dims the kernel-tag ProgramSpecs Mosaic-compile at
@@ -255,6 +292,9 @@ KERNEL_BINDINGS: Dict[str, Dict[str, Callable[[], Dict[str, Any]]]] = {
     },
     "pvraft_tpu/ops/pallas/corr_lookup.py": {
         "_fused_forward": _fused_env,
+    },
+    "pvraft_tpu/ops/pallas/gru_iter.py": {
+        "_gru_forward": _gru_env,
     },
 }
 

@@ -329,6 +329,64 @@ def fused_gru_residency(n_points: int, truncate_k: Optional[int] = None,
     return out
 
 
+def shipped_gru_geometry() -> Dict[str, Any]:
+    """The tile geometry the SHIPPED fused kernel actually runs
+    (``ops/pallas/gru_iter.py``), derived from the kernel's own tile
+    policy and the real model dims — a hyperparameter or policy change
+    regenerates a different plan and the compare stage catches it.
+
+    The shipped kernel fuses MotionEncoder+ConvGRU **within one
+    iteration**; the cross-iteration residency the study rows above
+    model is precluded at exact parity because every iteration runs
+    global ops over the full point axis between GRU updates (GroupNorm
+    statistics inside the CorrLookup heads, the FlowHead's SetConv
+    graph gathers) — a tile cannot stay resident across an all-points
+    barrier. The per-iteration fusion still removes one full HBM
+    round-trip of the hx concat + gate activations per iteration.
+    """
+    from pvraft_tpu.ops.pallas.gru_iter import FLOW_PAD, _gru_tile
+    from pvraft_tpu.programs import geometries as g
+
+    d = _gru_dims()
+    h, c, f32 = d["hidden"], d["context"], 4
+    # Whole-array weight residency: wc, wf, wh, wn3, wi3, wh3, wf3, bias
+    # (the packed lane-stacked layout pack_gru_weights emits).
+    weight_bytes = f32 * (
+        h * h + FLOW_PAD * h + 2 * h * h
+        + (h + c + 2 * FLOW_PAD) * 3 * h
+    )
+    rows = []
+    for k in (d["k"], 128):
+        t = _gru_tile(g.FLAGSHIP_POINTS, k)
+        # Streamed per grid step: net/inp/cor (T, h) + flow8 (T, 8) in,
+        # net (T, h) out; GK002's double-buffer model (2x streamed).
+        stream_bytes = t * f32 * (4 * h + FLOW_PAD)
+        vmem = 2 * stream_bytes + weight_bytes
+        rows.append({
+            "truncate_k": k,
+            "n_points": g.FLAGSHIP_POINTS,
+            "tile_points": t,
+            "streamed_block_bytes": stream_bytes,
+            "resident_weight_bytes": weight_bytes,
+            "vmem_bytes": vmem,
+            "vmem_budget_bytes": VMEM_BUDGET_BYTES,
+            "fits": vmem <= VMEM_BUDGET_BYTES,
+        })
+    return {
+        "module": "pvraft_tpu/ops/pallas/gru_iter.py",
+        "scope": "per-iteration MotionEncoder+ConvGRU fusion",
+        "cross_iteration_residency": False,
+        "why_not_cross_iteration": (
+            "every refinement iteration runs full-point-axis global ops "
+            "between GRU updates (GroupNorm statistics in the CorrLookup "
+            "heads, SetConv graph gathers in the FlowHead), so a point "
+            "tile cannot stay VMEM-resident across iterations at exact "
+            "numerical parity; the study rows above remain the "
+            "what-if-restructured ceiling"),
+        "tiles": rows,
+    }
+
+
 # --- plan assembly ----------------------------------------------------------
 
 def build_plan(costs_path: str,
@@ -379,6 +437,7 @@ def build_plan(costs_path: str,
         "cross_validation_factor": CROSS_VALIDATION_FACTOR,
         "kernels": records,
         "fused_gru_residency": residency,
+        "shipped_fused_gru": shipped_gru_geometry(),
     }
 
 

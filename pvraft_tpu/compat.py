@@ -125,6 +125,18 @@ def import_pallas():
     return pallas
 
 
+def import_pallas_tpu():
+    """The pallas TPU extension module (``jax.experimental.pallas.tpu``).
+
+    Home of the TPU-only memory-space constructors (``pltpu.VMEM`` /
+    ``pltpu.SMEM``) used for persistent scratch allocations in
+    multi-phase kernels. No stable home yet, so routed here like
+    :func:`import_pallas`."""
+    from jax.experimental.pallas import tpu as pallas_tpu
+
+    return pallas_tpu
+
+
 def checkpoint_policies():
     """``jax.checkpoint_policies`` — the rematerialization policy
     namespace. Routed here because the remat utilities have moved homes

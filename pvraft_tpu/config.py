@@ -67,6 +67,16 @@ class ModelConfig:
     # XLA lookup path is affected (the fused Pallas kernel has its own
     # VJP).
     scatter_free_vjp: bool = False
+    # Fused MotionEncoder+ConvGRU update (ops/pallas/gru_iter.py): one
+    # Pallas kernel per GRU iteration runs the whole feature update from
+    # VMEM-resident point tiles (tile geometry per
+    # artifacts/kernel_plan.json) instead of eight separate Dense
+    # launches round-tripping every intermediate through HBM. Param tree
+    # and checkpoints are identical to the unfused path; forward + grad
+    # parity is test-gated within pinned tolerances
+    # (tests/test_fused_gru.py); jaxpr byte-identical when False.
+    # Orthogonal to use_pallas (which gates the lookup kernels).
+    fused_gru: bool = False
     # lax.approx_max_k for the correlation truncation: much faster on TPU
     # (recall ~0.95 by default); exact sort-based top-k when False.
     approx_topk: bool = False

@@ -74,7 +74,9 @@ class UpdateIter(nn.Module):
         flow = coords2 - coords1
         net, delta = UpdateBlock(
             self.cfg.hidden_dim, dtype=compute_dtype(self.cfg),
-            dense_vjp=self.cfg.scatter_free_vjp, name="update_block"
+            dense_vjp=self.cfg.scatter_free_vjp,
+            fused_gru=self.cfg.fused_gru, tile_k=self.cfg.truncate_k,
+            name="update_block"
         )(net, inp, corr, flow, graph, mask)
         coords2 = coords2 + delta
         return (net, coords2, coords1), coords2 - coords1

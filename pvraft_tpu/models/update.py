@@ -52,6 +52,49 @@ class ConvGRU(nn.Module):
         return ((1.0 - z) * h32 + z * q).astype(jnp.float32)
 
 
+class _DenseParams(nn.Module):
+    """Declares exactly ``nn.Dense``'s param tree (kernel + bias) without
+    computing: the fused-GRU path reads the raw weights for
+    ``pack_gru_weights`` while keeping the param paths — and therefore
+    the per-path init RNG folds — identical to the unfused Dense, so
+    checkpoints are interchangeable bit for bit."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (in_features, self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        return kernel, bias
+
+
+class _MotionEncoderParams(nn.Module):
+    """:class:`MotionEncoder`'s param tree, raw (fused path)."""
+
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, corr_ch: int):
+        wc, bc = _DenseParams(self.hidden, name="conv_corr")(corr_ch)
+        wf, bf = _DenseParams(self.hidden, name="conv_flow")(3)
+        wh, bh = _DenseParams(self.hidden - 3, name="conv")(2 * self.hidden)
+        return wc, bc, wf, bf, wh, bh
+
+
+class _ConvGRUParams(nn.Module):
+    """:class:`ConvGRU`'s param tree, raw (fused path)."""
+
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, hx_ch: int):
+        wz, bz = _DenseParams(self.hidden, name="convz")(hx_ch)
+        wr, br = _DenseParams(self.hidden, name="convr")(hx_ch)
+        wq, bq = _DenseParams(self.hidden, name="convq")(hx_ch)
+        return wz, bz, wr, br, wq, bq
+
+
 class FlowHead(nn.Module):
     """``model/update.py:57-72``: parallel Dense + SetConv over the hidden
     state, fused to a 3-channel flow delta (delta emitted in float32)."""
@@ -71,11 +114,22 @@ class FlowHead(nn.Module):
 
 
 class UpdateBlock(nn.Module):
-    """``model/update.py:75-87``."""
+    """``model/update.py:75-87``.
+
+    ``fused_gru=True`` replaces the MotionEncoder + ConvGRU pair with
+    the single Pallas kernel ``ops/pallas/gru_iter.fused_gru_update``
+    (parity test-gated, ``tests/test_fused_gru.py``); the param tree is
+    declared through the ``_*Params`` holders above so it stays
+    byte-identical to the unfused modules. ``tile_k`` feeds the kernel's
+    plan-certified point-tile selection (the model's ``truncate_k``).
+    The FlowHead stays unfused either way — its SetConv gathers graph
+    neighbors across the whole cloud, which no point tile can hold."""
 
     hidden: int = 64
     dtype: Optional[jnp.dtype] = None
     dense_vjp: bool = False
+    fused_gru: bool = False
+    tile_k: int = 512
 
     @nn.compact
     def __call__(
@@ -87,9 +141,26 @@ class UpdateBlock(nn.Module):
         graph: Graph,
         mask: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        motion = MotionEncoder(self.hidden, dtype=self.dtype, name="motion_encoder")(flow, corr)
-        x = jnp.concatenate([inp.astype(motion.dtype), motion], axis=-1)
-        net = ConvGRU(self.hidden, dtype=self.dtype, name="gru")(net, x)
+        if self.fused_gru:
+            from pvraft_tpu.ops.pallas.gru_iter import (
+                fused_gru_update,
+                pack_gru_weights,
+                pad_flow,
+            )
+
+            me = _MotionEncoderParams(
+                self.hidden, name="motion_encoder")(corr.shape[-1])
+            gru = _ConvGRUParams(
+                self.hidden, name="gru")(2 * self.hidden + inp.shape[-1])
+            weights = pack_gru_weights(me, gru, self.hidden, inp.shape[-1])
+            dtype_name = ("float32" if self.dtype is None
+                          else jnp.dtype(self.dtype).name)
+            net = fused_gru_update(net, inp, corr, pad_flow(flow),
+                                   weights, dtype_name, self.tile_k)
+        else:
+            motion = MotionEncoder(self.hidden, dtype=self.dtype, name="motion_encoder")(flow, corr)
+            x = jnp.concatenate([inp.astype(motion.dtype), motion], axis=-1)
+            net = ConvGRU(self.hidden, dtype=self.dtype, name="gru")(net, x)
         delta = FlowHead(dtype=self.dtype, dense_vjp=self.dense_vjp,
                          name="flow_head")(net, graph, mask)
         return net, delta

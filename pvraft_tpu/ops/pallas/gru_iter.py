@@ -1,0 +1,265 @@
+"""Fused MotionEncoder + ConvGRU update — Pallas TPU kernel.
+
+One kernel pass per tile of points runs the whole per-iteration feature
+update (reference ``model/update.py``: MotionEncoder's three 1x1 convs +
+ConvGRU's three gates) from VMEM-resident inputs:
+
+  * the corr features, context features and hidden state for a point
+    tile are read from HBM ONCE per iteration and every intermediate —
+    ``cor``/``flo`` motion features, the 192-channel ``hx`` concat, the
+    ``z``/``r``/``q`` gate activations — lives and dies in VMEM; the
+    unfused path materializes each of them to HBM between the eight
+    separate Dense launches;
+  * the three gate Denses are packed into single lane-stacked matmuls
+    (``wn3``/``wi3``/``wh3``/``wf3``: one (·, 3H) dot per input block
+    instead of three (·, H) dots), and the concat-Dense pairs of the
+    reference are decomposed into per-operand dots — exact math
+    (``concat(a, b) @ W == a @ W_a + b @ W_b``), different float
+    accumulation order, which is what the pinned parity tolerances in
+    ``tests/test_fused_gru.py`` absorb.
+
+Tiling follows the committed VMEM plan (``artifacts/kernel_plan.json``):
+tile=1024 at K=512, tile=2048 at K<=128 on the point axis — the same
+point-tile geometry the plan certifies VMEM-resident alongside the
+lookup working set. The plan's *cross-iteration* residency row (keep the
+candidate block on chip across all 32 iterations) is NOT implementable
+at exact parity: every GRU iteration contains cross-point global ops
+(GroupNorm over the point axis inside both CorrLookup heads, and the
+FlowHead's SetConv gathers graph neighbors across the full cloud), so
+the scan must sync the whole cloud each iteration. This kernel ships the
+per-iteration fusion the plan's geometry admits; the planner's
+``gru_iter`` rows record the shipped footprint honestly.
+
+Gradients: ``jax.custom_vjp`` whose backward differentiates the pure-XLA
+:func:`_gru_reference` (the same rank-agnostic :func:`_gru_math` the
+kernel body executes, so forward and backward describe one function —
+the ``corr_lookup._fused_bwd`` recompute-in-XLA precedent).
+
+Statically analyzed: kernelcheck models the single ``pallas_call`` site
+below at the flagship geometry via the ``KERNEL_BINDINGS`` row keyed on
+``_gru_forward`` and its parameter names. A rename or geometry change
+here must keep that row in sync; the gate fails with GK000 otherwise,
+never silently. Keep this module to ONE ``pallas_call`` site: the VMEM
+planner maps kernel-tagged ProgramSpecs to modules one-to-one.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pvraft_tpu.analysis.contracts import shapecheck
+from pvraft_tpu.compat import import_pallas
+from pvraft_tpu.ops.pallas import interpret_mode
+
+pl = import_pallas()
+
+# Flow is padded from 3 to FLOW_PAD channels (zero columns) so the
+# flow-input matmuls run on an 8-row (one fp32 sublane) operand; zero
+# rows contribute exact zeros, so the padded dot equals the 3-row dot.
+FLOW_PAD = 8
+
+
+def _gru_tile(n: int, k: int) -> int:
+    """Point-axis tile: the kernel_plan.json geometry (tile=1024 at
+    K=512, tile=2048 at K<=128), clamped to an 8-aligned tile that does
+    not exceed the cloud. Non-divisible ``n`` is fine — the grid rounds
+    up and Pallas masks the tail block's out-of-bounds lanes (per-point
+    rows are independent). Pure Python on ints: kernelcheck executes
+    this helper for real when modeling the launch geometry."""
+    target = 2048 if k <= 128 else 1024
+    aligned = max(8, (n // 8) * 8)
+    return min(target, aligned)
+
+
+def _gru_math(net, inp, cor_in, flow8, weights, dtype_name: str):
+    """The fused update's math, rank-agnostic over leading axes: the
+    kernel body runs it on (TILE, C) VMEM blocks, :func:`_gru_reference`
+    (and through it the custom-VJP backward) on (B, N, C) arrays —
+    one definition, so the two paths cannot drift.
+
+    Dtype discipline mirrors the unfused flax modules exactly:
+    ``nn.Dense(dtype=d)`` promotes inputs and params to ``d``; the GRU
+    carry stays float32 (``net32``) and the blend back to float32 is the
+    last op, token for token the unfused ``ConvGRU`` return line.
+    """
+    d = jnp.dtype(dtype_name)
+    h = net.shape[-1]
+    net32 = net.astype(jnp.float32)
+    netd = net32.astype(d)
+    inpd = inp.astype(d)
+    cord = cor_in.astype(d)
+    flod = flow8.astype(d)
+    wc, wf, wh, wn3, wi3, wh3, wf3, bias = (w.astype(d) for w in weights)
+    b_me = bias[0:1]                      # MotionEncoder biases, (1, 3H)
+    b_g = bias[1:2]                       # gate biases bz|br|bq, (1, 3H)
+
+    # MotionEncoder: conv_corr / conv_flow / conv (update.py:34-40).
+    cor = jax.nn.relu(jnp.dot(cord, wc) + b_me[..., 0:h])
+    flo = jax.nn.relu(jnp.dot(flod, wf) + b_me[..., h:2 * h])
+    hid = jax.nn.relu(jnp.dot(cor, wh[:h]) + jnp.dot(flo, wh[h:])
+                      + b_me[..., 2 * h:3 * h])
+
+    # ConvGRU gates (update.py:52-66), all three packed on the lane
+    # axis. px = the net-independent contribution dot(x, W*) + b* where
+    # x = concat(inp, hid, flow); z/r add dot(net, W*_net), q adds
+    # dot(r*net, Wq_net).
+    px = (jnp.dot(inpd, wi3) + jnp.dot(hid, wh3) + jnp.dot(flod, wf3)
+          + b_g)
+    zr = px[..., 0:2 * h] + jnp.dot(netd, wn3[..., 0:2 * h])
+    z = jax.nn.sigmoid(zr[..., 0:h])
+    r = jax.nn.sigmoid(zr[..., h:2 * h])
+    q = jnp.tanh(px[..., 2 * h:3 * h]
+                 + jnp.dot(r * netd, wn3[..., 2 * h:3 * h]))
+    return ((1.0 - z) * net32 + z * q).astype(jnp.float32)
+
+
+def _gru_kernel(net_ref, inp_ref, cor_ref, flow_ref, wc_ref, wf_ref,
+                wh_ref, wn3_ref, wi3_ref, wh3_ref, wf3_ref, bias_ref,
+                out_ref, *, dtype_name: str):
+    weights = (wc_ref[...], wf_ref[...], wh_ref[...], wn3_ref[...],
+               wi3_ref[...], wh3_ref[...], wf3_ref[...], bias_ref[...])
+    out_ref[0] = _gru_math(net_ref[0], inp_ref[0], cor_ref[0],
+                           flow_ref[0], weights, dtype_name)
+
+
+def _gru_forward(net, inp, cor, flow8, weights, truncate_k, dtype_name):
+    b, n, h = net.shape
+    c = inp.shape[2]
+    cw = cor.shape[2]
+    f = flow8.shape[2]
+    tile = _gru_tile(n, truncate_k)
+    wc, wf, wh, wn3, wi3, wh3, wf3, bias = weights
+    kernel = functools.partial(_gru_kernel, dtype_name=dtype_name)
+    net_spec = pl.BlockSpec((1, tile, h), lambda bi, ni: (bi, ni, 0))
+    inp_spec = pl.BlockSpec((1, tile, c), lambda bi, ni: (bi, ni, 0))
+    cor_spec = pl.BlockSpec((1, tile, cw), lambda bi, ni: (bi, ni, 0))
+    flow_spec = pl.BlockSpec((1, tile, f), lambda bi, ni: (bi, ni, 0))
+    # Weights ride along whole (block == array, constant index map):
+    # ~0.2 MiB total, dwarfed by the streamed point blocks.
+    wc_spec = pl.BlockSpec(wc.shape, lambda bi, ni: (0, 0))
+    wf_spec = pl.BlockSpec(wf.shape, lambda bi, ni: (0, 0))
+    wh_spec = pl.BlockSpec(wh.shape, lambda bi, ni: (0, 0))
+    wn3_spec = pl.BlockSpec(wn3.shape, lambda bi, ni: (0, 0))
+    wi3_spec = pl.BlockSpec(wi3.shape, lambda bi, ni: (0, 0))
+    wh3_spec = pl.BlockSpec(wh3.shape, lambda bi, ni: (0, 0))
+    wf3_spec = pl.BlockSpec(wf3.shape, lambda bi, ni: (0, 0))
+    bias_spec = pl.BlockSpec(bias.shape, lambda bi, ni: (0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(b, -(-n // tile)),
+        in_specs=[net_spec, inp_spec, cor_spec, flow_spec, wc_spec,
+                  wf_spec, wh_spec, wn3_spec, wi3_spec, wh3_spec,
+                  wf3_spec, bias_spec],
+        out_specs=pl.BlockSpec((1, tile, h), lambda bi, ni: (bi, ni, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, n, h), jnp.float32),
+        interpret=interpret_mode(),
+    )(net, inp, cor, flow8, wc, wf, wh, wn3, wi3, wh3, wf3, bias)
+
+
+def pad_flow(flow):
+    """Zero-pad the (B, N, 3) flow to (B, N, FLOW_PAD) channels.
+
+    Callers pad BEFORE :func:`fused_gru_update`: the padded array is the
+    custom VJP's flow operand, so the compiled program's flow argument is
+    byte-identical to the kernel operand the static HBM model counts
+    (the planner's exactness pin), and flow gradients reach the raw
+    3-channel estimate through this concat's transpose (a slice)."""
+    b, n, w = flow.shape
+    return jnp.concatenate(
+        [flow, jnp.zeros((b, n, FLOW_PAD - w), flow.dtype)], axis=-1)
+
+
+def pack_gru_weights(me_params, gru_params, hidden: int, context: int):
+    """Pack the raw flax Dense params into the kernel's operand layout.
+
+    ``me_params``: ``(wc, bc, wf, bf, wh, bh)`` — MotionEncoder's
+    conv_corr / conv_flow / conv kernels+biases; ``gru_params``:
+    ``(wz, bz, wr, br, wq, bq)``. Returns the 8-tuple
+    ``(wc, wf, wh, wn3, wi3, wh3, wf3, bias)``:
+
+      * flow-input kernels zero-padded from 3 to :data:`FLOW_PAD` rows
+        (matching :func:`pad_flow`'s zero columns — exact);
+      * the ``conv`` kernel's output padded ``hidden-3 -> hidden``
+        columns (the motion feature's flow channels are handled by the
+        separate ``wf3`` path, so the pad columns stay exactly zero);
+      * the three gate kernels lane-stacked to ``(·, 3*hidden)`` and
+        row-split by ``hx = concat(net, inp, hid, flow)`` segment;
+      * both bias sets in one sublane-padded ``(FLOW_PAD, 3*hidden)``
+        array (row 0: MotionEncoder, row 1: gates).
+
+    Runs OUTSIDE the custom VJP: only zero-pads, slices and concats, so
+    gradients flow back to the raw flax params exactly.
+    """
+    wc, bc, wf, bf, wh, bh = me_params
+    wz, bz, wr, br, wq, bq = gru_params
+    h = hidden
+    wf8 = jnp.pad(wf, ((0, FLOW_PAD - wf.shape[0]), (0, 0)))
+    whp = jnp.pad(wh, ((0, 0), (0, h - wh.shape[1])))
+    bhp = jnp.pad(bh, (0, h - bh.shape[0]))
+    wg = jnp.concatenate([wz, wr, wq], axis=1)        # (H+C+H, 3H)
+    wn3 = wg[0:h]
+    wi3 = wg[h:h + context]
+    hid_rows = wg[h + context:h + context + (h - 3)]
+    wh3 = jnp.pad(hid_rows, ((0, 3), (0, 0)))         # pad H-3 -> H rows
+    flow_rows = wg[h + context + (h - 3):]
+    wf3 = jnp.pad(flow_rows, ((0, FLOW_PAD - 3), (0, 0)))
+    bias2 = jnp.stack([jnp.concatenate([bc, bf, bhp]),
+                       jnp.concatenate([bz, br, bq])])
+    bias = jnp.pad(bias2, ((0, FLOW_PAD - 2), (0, 0)))
+    return (wc, wf8, whp, wn3, wi3, wh3, wf3, bias)
+
+
+def _gru_reference(net, inp, cor, flow8, weights, dtype_name):
+    """Pure-XLA twin of the kernel — same :func:`_gru_math`, whole-array
+    operands (flow already :func:`pad_flow`-padded, like the kernel's).
+    The custom VJP differentiates THIS, and the parity tests pin the
+    Pallas forward against it."""
+    return _gru_math(net, inp, cor, flow8, weights, dtype_name)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+@shapecheck("B N H", "B N C", "B N D", "B N 8", None, out="B N H")
+def fused_gru_update(
+    net: jnp.ndarray,
+    inp: jnp.ndarray,
+    cor: jnp.ndarray,
+    flow8: jnp.ndarray,
+    weights: Tuple[jnp.ndarray, ...],
+    dtype_name: str,
+    truncate_k: int,
+) -> jnp.ndarray:
+    """Fused MotionEncoder + ConvGRU hidden-state update.
+
+    net: (B, N, H) float32 GRU hidden state; inp: (B, N, C) context
+    features; cor: (B, N, D) correlation features (compute dtype);
+    flow8: (B, N, FLOW_PAD) flow estimate, zero-padded by
+    :func:`pad_flow` OUTSIDE this custom VJP; weights: the 8-tuple from
+    :func:`pack_gru_weights`. ``dtype_name`` is the compute dtype
+    (``"float32"`` / ``"bfloat16"``), ``truncate_k`` the model's
+    candidate count — it selects the plan-certified point tile.
+    Returns the new (B, N, H) float32 hidden state.
+    """
+    return _gru_forward(net, inp, cor, flow8, weights,
+                        truncate_k, dtype_name)
+
+
+def _fused_gru_fwd(net, inp, cor, flow8, weights, dtype_name, truncate_k):
+    out = fused_gru_update(net, inp, cor, flow8, weights, dtype_name,
+                           truncate_k)
+    return out, (net, inp, cor, flow8, weights)
+
+
+def _fused_gru_bwd(dtype_name, truncate_k, res, g):
+    net, inp, cor, flow8, weights = res
+    _, vjp = jax.vjp(
+        lambda n_, i_, c_, f_, w_: _gru_reference(n_, i_, c_, f_, w_,
+                                                  dtype_name),
+        net, inp, cor, flow8, weights)
+    return vjp(g)
+
+
+fused_gru_update.defvjp(_fused_gru_fwd, _fused_gru_bwd)

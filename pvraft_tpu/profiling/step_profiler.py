@@ -13,6 +13,9 @@ Measured cumulative programs (flagship step anatomy):
     encoder    PointEncoder forward on ONE cloud (kNN graph + 3 SetConvs)
     corr_cum   both clouds encoded + the truncated correlation build
     fwd1/fwdN  full model forward at 1 / N GRU iterations
+    gru_fused  fwdN with ModelConfig.fused_gru=True (the Pallas fused
+               MotionEncoder+ConvGRU kernel) — fwdN vs gru_fused is the
+               fused-kernel A/B; not part of the telescoped breakdown
     fwdbwd     value_and_grad of the sequence loss (no optimizer)
     step       the full train step (fwd + bwd + adam)
 
@@ -186,6 +189,20 @@ def ladder_programs(cfg, model, enc, params, enc_params, tx, opt_state,
 
         return f
 
+    # fwdN with the fused MotionEncoder+ConvGRU kernel: the param tree is
+    # identical by construction (models/update.py holder modules), so the
+    # SAME params apply — the stage pair (fwdN, gru_fused) is a pure
+    # kernel A/B. Excluded from the telescoped breakdown: it re-times a
+    # rung, it is not a new cumulative layer.
+    import dataclasses as _dc
+
+    fused_model = type(model)(_dc.replace(cfg, fused_gru=True))
+
+    @jax.jit
+    def f_gru_fused(eps):
+        flows, _ = fused_model.apply(params, pc1 + eps, pc2 + eps, iters)
+        return jnp.sum(flows[-1].astype(jnp.float32))
+
     def loss_fn(p, eps):
         flows, _ = model.apply(p, pc1 + eps, pc2 + eps, iters)
         return sequence_loss(flows, mask, gt, gamma)
@@ -212,6 +229,7 @@ def ladder_programs(cfg, model, enc, params, enc_params, tx, opt_state,
         "corr_cum": f_corr_cum,
         "fwd1": fwd(1),
         "fwdN": fwd(iters),
+        "gru_fused": f_gru_fused,
         "fwdbwd": f_fwdbwd,
         "step": f_step,
     }

@@ -100,6 +100,45 @@ def _k_fused_grad():
         (corr, rel, coords))
 
 
+def _gru_weight_structs():
+    """The packed weight 8-tuple's shapes (hidden=64, context=64) —
+    mirrors ``analysis/kernels/model._gru_env`` so the static VMEM model
+    and the Mosaic compile evidence describe one program."""
+    return (_f32(64, 64), _f32(8, 64), _f32(128, 64), _f32(64, 192),
+            _f32(64, 192), _f32(64, 192), _f32(8, 192), _f32(8, 192))
+
+
+@register("pallas_gru_iter_fwd", tags=("kernel", "pallas"),
+          topology=g.TOPOLOGY)
+def _k_gru_fwd():
+    """Fused MotionEncoder+ConvGRU kernel, forward, flagship geometry."""
+    from pvraft_tpu.ops.pallas.gru_iter import fused_gru_update
+
+    b, n = g.FLAGSHIP_BATCH, g.FLAGSHIP_POINTS
+    k = g.FLAGSHIP_TRUNCATE_K
+    feat = _f32(b, n, 64)
+    return (lambda ne, i, c, f, w: fused_gru_update(
+        ne, i, c, f, w, "float32", k),
+        (feat, feat, feat, _f32(b, n, 8), _gru_weight_structs()))
+
+
+@register("pallas_gru_iter_grad", tags=("kernel", "pallas"),
+          topology=g.TOPOLOGY)
+def _k_gru_grad():
+    """Fused MotionEncoder+ConvGRU kernel, VJP (all inputs incl. the
+    packed weights), flagship geometry."""
+    import jax
+
+    from pvraft_tpu.ops.pallas.gru_iter import fused_gru_update
+
+    b, n = g.FLAGSHIP_BATCH, g.FLAGSHIP_POINTS
+    k = g.FLAGSHIP_TRUNCATE_K
+    feat = _f32(b, n, 64)
+    return (jax.grad(lambda ne, i, c, f, w: fused_gru_update(
+        ne, i, c, f, w, "float32", k).sum(), argnums=(0, 1, 2, 3, 4)),
+        (feat, feat, feat, _f32(b, n, 8), _gru_weight_structs()))
+
+
 # --- flagship training programs -------------------------------------------
 
 def _abstract_params(model, batch, n_points):

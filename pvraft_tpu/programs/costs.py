@@ -81,8 +81,12 @@ def summarize_cost_analysis(analysis: Any) -> Dict[str, Any]:
     for props in analysis or ():
         if not isinstance(props, dict):
             continue
-        flops += float(props.get("flops", 0.0) or 0.0)
-        bytes_accessed += float(props.get("bytes accessed", 0.0) or 0.0)
+        # XLA reports -1 for properties it cannot count (a program whose
+        # only op is a Pallas custom call); fold the sentinel to 0 — the
+        # planner already reads zero flops as "uncounted Pallas body".
+        flops += max(0.0, float(props.get("flops", 0.0) or 0.0))
+        bytes_accessed += max(
+            0.0, float(props.get("bytes accessed", 0.0) or 0.0))
         if "optimal_seconds" in props:
             optimal_s = (optimal_s or 0.0) + float(props["optimal_seconds"])
     out: Dict[str, Any] = {

@@ -59,12 +59,17 @@ AB_LEVERS = (
      "kind": "str"},
     {"env": "PVRAFT_BENCH_GRAD_DTYPE", "field": "grad_dtype",
      "kind": "str", "step_arg": True},
+    # Fused MotionEncoder+ConvGRU Pallas kernel (ops/pallas/gru_iter.py,
+    # PR 17): a forward-path lever, enumerated here so the bench headline
+    # carries it in ab_flags like the backward levers.
+    {"env": "PVRAFT_BENCH_FUSED_GRU", "field": "fused_gru",
+     "kind": "flag"},
 )
 
-# The full optimized-backward configuration (all three levers armed) —
-# the decisive TPU A/B candidate (ROADMAP item 1).
+# The full optimized configuration (all four levers armed, forward and
+# backward) — the decisive TPU A/B candidate (ROADMAP item 1).
 AB_PRIMARY = {"scatter_free_vjp": True, "remat_policy": "dots",
-              "grad_dtype": "bfloat16"}
+              "grad_dtype": "bfloat16", "fused_gru": True}
 
 # --- step-profiler measurement ladder --------------------------------------
 
@@ -74,8 +79,11 @@ AB_PRIMARY = {"scatter_free_vjp": True, "remat_policy": "dots",
 # programs/catalog.py registers one `profile.<stage>` spec per entry.
 # Lives here (pure data) so the catalog can enumerate the ladder without
 # importing the profiler (which imports jax).
-PROFILE_LADDER_STAGES = ("encoder", "corr_cum", "fwd1", "fwdN", "fwdbwd",
-                         "step")
+# "gru_fused" re-times the fwdN rung with ModelConfig.fused_gru=True
+# (the Pallas fused-update kernel) — same params, same program shape, so
+# fwdN vs gru_fused is the fused-kernel A/B inside one profile artifact.
+PROFILE_LADDER_STAGES = ("encoder", "corr_cum", "fwd1", "fwdN",
+                         "gru_fused", "fwdbwd", "step")
 
 # The derived per-stage breakdown the ladder telescopes into
 # (step_profiler.BREAKDOWN_STAGES is this tuple). Also the train-side

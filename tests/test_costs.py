@@ -74,6 +74,17 @@ def test_summarize_flattens_multi_computation_lists():
                                            "bytes_accessed": 0.0}
 
 
+def test_summarize_folds_unknown_sentinel():
+    # XLA reports -1 for properties it cannot count (a program whose
+    # only op is a Pallas custom call, e.g. pallas_gru_iter_fwd); the
+    # sentinel folds to 0 instead of poisoning the schema-valid total.
+    out = summarize_cost_analysis([
+        {"flops": -1.0, "bytes accessed": 64.0},
+        {"flops": 10.0, "bytes accessed": -1.0},
+    ])
+    assert out == {"flops": 10.0, "bytes_accessed": 64.0}
+
+
 def test_summarize_matches_real_cpu_compile():
     f = jax.jit(lambda a, b: a @ b)
     compiled = f.lower(jax.ShapeDtypeStruct((8, 16), "float32"),

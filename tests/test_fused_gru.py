@@ -1,0 +1,234 @@
+"""Parity of the fused MotionEncoder+ConvGRU Pallas kernel
+(``ops/pallas/gru_iter.py``) against the unfused flax path, plus the
+flag-off jaxpr-unchanged guarantee and the tile-policy geometry.
+
+Tolerances are pinned at ~3-10x the measured CPU (interpret-mode)
+diffs so toolchain noise does not flake while a real regression (a
+mis-sliced gate, a dropped operand) still fails by orders of magnitude:
+
+  * op level, Pallas vs the pure-XLA twin: fp32 measured ~5e-7 (the
+    kernel body and the twin run the same ``_gru_math``; only block
+    tiling differs);
+  * model level, fused vs unfused flax: fp32 fwd ~1.2e-6 / grads
+    ~1.5e-5 at 2 iterations (the lane-stacked gate matmuls and
+    decomposed concat-dots reassociate float adds); bf16 fwd ~0.009 at
+    1 iteration (bf16 rounding feeds back through the discrete kNN
+    candidate selection across iterations, so multi-iteration bf16
+    diffs are selection flips, not kernel error — 1 iteration pins the
+    arithmetic itself).
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pvraft_tpu.config import ModelConfig
+from pvraft_tpu.ops.pallas.gru_iter import (
+    _gru_reference,
+    _gru_tile,
+    fused_gru_update,
+    pack_gru_weights,
+    pad_flow,
+)
+
+
+# --- tile policy ------------------------------------------------------------
+
+
+def test_gru_tile_geometry():
+    # The committed kernel_plan.json geometry at flagship sizes...
+    assert _gru_tile(8192, 512) == 1024
+    assert _gru_tile(8192, 128) == 2048
+    assert _gru_tile(8192, 16) == 2048
+    # ...clamped 8-aligned for small clouds (never exceeds the cloud).
+    assert _gru_tile(37, 512) == 32
+    assert _gru_tile(20, 16) == 16
+    assert _gru_tile(5, 512) == 8
+
+
+# --- op level: the Pallas program vs its pure-XLA twin ----------------------
+
+
+H, C, D = 8, 8, 16
+
+
+def _raw_params(rng):
+    def a(*s):
+        return jnp.asarray(0.5 * rng.normal(size=s).astype(np.float32))
+
+    me = (a(D, H), a(H), a(3, H), a(H), a(2 * H, H - 3), a(H - 3))
+    hx = 2 * H + C
+    gru = (a(hx, H), a(H), a(hx, H), a(H), a(hx, H), a(H))
+    return me, gru
+
+
+def _op_inputs(rng, n):
+    # flow enters the op pre-padded (pad_flow runs outside the custom
+    # VJP — the kernel operand IS the program argument).
+    net = jnp.asarray(np.tanh(rng.normal(size=(1, n, H))).astype(np.float32))
+    inp = jnp.asarray(np.abs(rng.normal(size=(1, n, C))).astype(np.float32))
+    cor = jnp.asarray(rng.normal(size=(1, n, D)).astype(np.float32))
+    flow = jnp.asarray(rng.normal(size=(1, n, 3)).astype(np.float32))
+    return net, inp, cor, pad_flow(flow)
+
+
+@pytest.mark.parametrize("n,k", [
+    (37, 512),      # tail tile: tile=32, grid 2, 5-point remainder
+    (2056, 512),    # K>128 target: tile=1024, grid 3, 8-point tail
+    (2056, 16),     # K<=128 target: tile=2048, grid 2, 8-point tail
+])
+def test_op_forward_parity_fp32(n, k):
+    rng = np.random.default_rng(0)
+    me, gru = _raw_params(rng)
+    w = pack_gru_weights(me, gru, H, C)
+    net, inp, cor, flow = _op_inputs(rng, n)
+    got = fused_gru_update(net, inp, cor, flow, w, "float32", k)
+    want = _gru_reference(net, inp, cor, flow, w, "float32")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_op_forward_parity_bf16():
+    rng = np.random.default_rng(1)
+    me, gru = _raw_params(rng)
+    w = pack_gru_weights(me, gru, H, C)
+    net, inp, cor, flow = _op_inputs(rng, 37)
+    got = fused_gru_update(net, inp, cor, flow, w, "bfloat16", 16)
+    want = _gru_reference(net, inp, cor, flow, w, "bfloat16")
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_op_grad_parity():
+    # The custom VJP differentiates _gru_reference itself, so this pins
+    # the defvjp plumbing (residuals, cotangent tree incl. the 8-tuple
+    # weights) rather than arithmetic — expect near-exact agreement.
+    rng = np.random.default_rng(2)
+    me, gru = _raw_params(rng)
+    w = pack_gru_weights(me, gru, H, C)
+    net, inp, cor, flow = _op_inputs(rng, 37)
+
+    def fused(ne, i, c, f, wt):
+        return jnp.sum(jnp.sin(
+            fused_gru_update(ne, i, c, f, wt, "float32", 16)))
+
+    def ref(ne, i, c, f, wt):
+        return jnp.sum(jnp.sin(_gru_reference(ne, i, c, f, wt, "float32")))
+
+    g_new = jax.grad(fused, (0, 1, 2, 3, 4))(net, inp, cor, flow, w)
+    g_ref = jax.grad(ref, (0, 1, 2, 3, 4))(net, inp, cor, flow, w)
+    for a, b in zip(jax.tree_util.tree_leaves(g_new),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+# --- model level: fused vs unfused flax -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def clouds():
+    rng = np.random.default_rng(0)
+    pc1 = jnp.asarray(rng.uniform(-1, 1, (1, 40, 3)).astype(np.float32))
+    pc2 = jnp.asarray(rng.uniform(-1, 1, (1, 40, 3)).astype(np.float32))
+    base = ModelConfig(truncate_k=16, corr_knn=8, graph_k=8,
+                       use_pallas=False)
+    return pc1, pc2, base
+
+
+def _apply(cfg, pc1, pc2, iters=2, masks=None):
+    from pvraft_tpu.models import PVRaft
+
+    model = PVRaft(cfg)
+    params = model.init(jax.random.key(0), pc1, pc2, iters)
+    args = (pc1, pc2, iters) + (masks if masks else ())
+    return model, params, model.apply(params, *args)[0]
+
+
+def test_model_init_identical(clouds):
+    # fused_gru must not change the param tree: the holder modules
+    # declare the same (path, shape, init) leaves the flax Dense stack
+    # does, so checkpoints swap freely between the two paths.
+    pc1, pc2, base = clouds
+    from pvraft_tpu.models import PVRaft
+
+    p_off = PVRaft(base).init(jax.random.key(0), pc1, pc2, 2)
+    p_on = PVRaft(dataclasses.replace(base, fused_gru=True)).init(
+        jax.random.key(0), pc1, pc2, 2)
+    leaves_off = jax.tree_util.tree_leaves_with_path(p_off)
+    leaves_on = jax.tree_util.tree_leaves_with_path(p_on)
+    assert [k for k, _ in leaves_off] == [k for k, _ in leaves_on]
+    for (_, a), (_, b) in zip(leaves_off, leaves_on):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_model_forward_parity_fp32(clouds, masked):
+    pc1, pc2, base = clouds
+    masks = None
+    if masked:
+        valid = jnp.arange(40) < 36
+        masks = (jnp.broadcast_to(valid, (1, 40)),) * 2
+    _, _, f_off = _apply(base, pc1, pc2, masks=masks)
+    _, _, f_on = _apply(dataclasses.replace(base, fused_gru=True),
+                        pc1, pc2, masks=masks)
+    np.testing.assert_allclose(np.asarray(f_on), np.asarray(f_off),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_model_grad_parity_fp32(clouds):
+    pc1, pc2, base = clouds
+    from pvraft_tpu.models import PVRaft
+
+    def grads(cfg):
+        model = PVRaft(cfg)
+        params = model.init(jax.random.key(0), pc1, pc2, 2)
+
+        def loss(p):
+            flows, _ = model.apply(p, pc1, pc2, 2)
+            return jnp.sum(flows ** 2)
+
+        return jax.grad(loss)(params)
+
+    g_off = grads(base)
+    g_on = grads(dataclasses.replace(base, fused_gru=True))
+    for a, b in zip(jax.tree_util.tree_leaves(g_off),
+                    jax.tree_util.tree_leaves(g_on)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_model_forward_parity_bf16_one_iter(clouds):
+    pc1, pc2, base = clouds
+    cfg = dataclasses.replace(base, compute_dtype="bfloat16")
+    _, _, f_off = _apply(cfg, pc1, pc2, iters=1)
+    _, _, f_on = _apply(dataclasses.replace(cfg, fused_gru=True),
+                        pc1, pc2, iters=1)
+    np.testing.assert_allclose(np.asarray(f_on), np.asarray(f_off),
+                               rtol=0.05, atol=0.03)
+
+
+# --- flag off: jaxpr untouched ----------------------------------------------
+
+
+def test_model_jaxpr_fused_only_when_opted_in(clouds):
+    pc1, pc2, base = clouds
+    from pvraft_tpu.models import PVRaft
+
+    def traced(cfg):
+        model = PVRaft(cfg)
+        params = jax.eval_shape(
+            lambda: model.init(jax.random.key(0), pc1, pc2, 2))
+        return str(jax.make_jaxpr(
+            lambda p: model.apply(p, pc1, pc2, 2))(params))
+
+    off = traced(base)
+    on = traced(dataclasses.replace(base, fused_gru=True))
+    # The default path traces no custom_vjp at all (same guarantee
+    # test_scatter_free pins) — fused_gru=False cannot have touched it.
+    assert "custom_vjp" not in off
+    assert "custom_vjp" in on

@@ -23,6 +23,7 @@ from pvraft_tpu.analysis.kernels.check import (
 from pvraft_tpu.analysis.kernels.model import (
     ArrayInfo,
     KERNEL_BINDINGS,
+    _hbm_layout_bytes,
     build_module_kernel_model,
 )
 from pvraft_tpu.analysis.kernels.planner import (
@@ -66,6 +67,18 @@ def test_array_info_subscripting():
     assert a[..., 0:1].shape == (2, 8192, 512, 1)
     assert a.nbytes == 2 * 8192 * 512 * 3 * 4
     assert ArrayInfo((4, 4), "bfloat16").nbytes == 32
+
+
+def test_hbm_layout_rank2_tiled_rank3_compact():
+    # The XLA:TPU argument-layout rule the fwd exactness pin rides on:
+    # rank-2 operands are (8, 128)-tiled with transpose-if-cheaper, so
+    # the gru kernel's (128, 64) weight lands as 64x128 with zero pad
+    # while (64, 192) pads its lanes to 256; rank>=3 stays compact.
+    assert _hbm_layout_bytes(ArrayInfo((128, 64))) == 64 * 128 * 4
+    assert _hbm_layout_bytes(ArrayInfo((64, 192))) == 64 * 256 * 4
+    assert _hbm_layout_bytes(ArrayInfo((8, 64))) == 8 * 128 * 4
+    assert _hbm_layout_bytes(ArrayInfo((8, 192), "bfloat16")) == 8 * 256 * 2
+    assert _hbm_layout_bytes(ArrayInfo((2, 8192, 3))) == 2 * 8192 * 3 * 4
 
 
 def test_real_voxel_kernel_models_concretely():
@@ -474,7 +487,8 @@ def test_plan_schema_and_kernel_coverage():
     names = {r["name"] for r in plan["kernels"]}
     assert names == set(spec_module_map())
     assert names == {"pallas_voxel_fwd", "pallas_voxel_grad",
-                     "pallas_fused_lookup_fwd", "pallas_fused_lookup_grad"}
+                     "pallas_fused_lookup_fwd", "pallas_fused_lookup_grad",
+                     "pallas_gru_iter_fwd", "pallas_gru_iter_grad"}
     for rec in plan["kernels"]:
         assert rec["bound"] in ("memory", "compute")
         assert rec["static_vmem_bytes"] < VMEM_BUDGET_BYTES

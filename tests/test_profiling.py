@@ -21,6 +21,7 @@ def _record(total=1.0):
         "corr_cum": {"sec": 0.25},
         "fwd1": {"sec": 0.3},
         "fwdN": {"sec": 0.5},
+        "gru_fused": {"sec": 0.45},
         "fwdbwd": {"sec": 0.9},
         "step": {"sec": total},
     }

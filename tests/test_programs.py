@@ -199,10 +199,11 @@ def test_no_duplicated_bucket_geometry_literals(relpath):
 
 def test_kernel_tag_covers_every_pallas_entry_point():
     """The Mosaic-drift gate (`programs compile --tag kernel`) must
-    sweep both Pallas kernels, forward AND backward."""
+    sweep every Pallas kernel, forward AND backward."""
     names = {s.name for s in by_tag("kernel") if s.topology}
     assert names == {"pallas_voxel_fwd", "pallas_voxel_grad",
-                     "pallas_fused_lookup_fwd", "pallas_fused_lookup_grad"}
+                     "pallas_fused_lookup_fwd", "pallas_fused_lookup_grad",
+                     "pallas_gru_iter_fwd", "pallas_gru_iter_grad"}
 
 
 def test_bench_enumeration_mirrors_registry():

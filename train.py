@@ -82,6 +82,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="scatter-free custom VJPs for the gather-heavy "
                         "backward (one-hot-matmul grads; "
                         "ops/scatter_free.py)")
+    p.add_argument("--fused_gru", action="store_true",
+                   help="fused MotionEncoder+ConvGRU Pallas iteration "
+                        "kernel (ops/pallas/gru_iter.py); parity-gated, "
+                        "default off")
     p.add_argument("--grad_dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="cast gradients once after value_and_grad (the "
@@ -148,6 +152,7 @@ def config_from_args(a: argparse.Namespace) -> Config:
             remat=a.remat,
             remat_policy=a.remat_policy,
             scatter_free_vjp=a.scatter_free_vjp,
+            fused_gru=a.fused_gru,
             approx_topk=a.approx_topk, approx_knn=a.approx_knn,
             graph_chunk=a.graph_chunk,
             scan_unroll=a.scan_unroll,
