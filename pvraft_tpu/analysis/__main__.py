@@ -1,5 +1,5 @@
 """CLI: ``python -m pvraft_tpu.analysis
-{lint,trace,deepcheck,concurrency,kernels,sharding,determinism}``.
+{lint,trace,deepcheck,concurrency,kernels,sharding,determinism,gate}``.
 
 ``lint`` is pure stdlib-AST and never initializes a jax backend
 (``--stats`` prints the suppression-debt report instead of findings).
@@ -35,6 +35,15 @@ flags routed outside ``compat.py``, iteration-order hazards
 whole package; ``--replay`` builds the registered train step and serve
 dispatch twice from the same seed, diffs outputs bitwise, and emits
 the ``pvraft_determinism/v1`` artifact (``--check`` pins it).
+``gate`` (gatecheck) is two things: with no flags it RUNS the declared
+gate — the old lint.sh stage list as ``GateStage`` data, scheduled
+dependency-aware in parallel with content-hash caching and a
+``pvraft_gate/v1`` report — and with ``--rules`` it runs the GE001+
+evidence/claims rules (dangling citations, validator coverage, stale
+``<!-- claim: -->`` numbers, schema-exactly-once, stage-set identity
+across registry/lint.sh/ci.yml); ``--check`` validates committed gate
+reports. Pure stdlib either way (the stages it launches are their own
+processes).
 """
 
 from __future__ import annotations
@@ -346,6 +355,85 @@ def _determinism_replay(args) -> int:
     return 0 if report["verdict"] == "bitwise" else 1
 
 
+def _cmd_gate(args) -> int:
+    from pvraft_tpu.analysis.gate.rules import all_gate_rules
+
+    if args.list_rules:
+        for rule in all_gate_rules():
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id}  {rule.title:<24} {doc}")
+        return 0
+    if args.list_stages:
+        from pvraft_tpu.analysis.gate.stages import GATE_STAGES
+
+        for stage in GATE_STAGES:
+            deps = f"  (after {', '.join(stage.deps)})" if stage.deps else ""
+            print(f"{stage.name:<22} {stage.command}{deps}")
+        return 0
+    if args.check:
+        from pvraft_tpu.analysis.gate.runner import check_report_file
+
+        rc = 0
+        for path in args.check:
+            problems = check_report_file(path)
+            for p in problems:
+                print(f"{path}: {p}", file=sys.stderr)
+            if problems:
+                rc = 1
+            else:
+                print(f"{path}: OK (full green gate run, stage set matches "
+                      f"the registry)")
+        return rc
+    if args.rules:
+        from pvraft_tpu.analysis.gate.check import check_repo
+
+        select = tuple(args.select.split(",")) if args.select else ()
+        diags, model = check_repo(root=args.root or None, rule_ids=select)
+        for d in diags:
+            print(d.format())
+        print(
+            f"gatecheck: {len(diags)} finding(s) over "
+            f"{len(model.tracked)} tracked artifact(s), "
+            f"{len(model.claims)} claim(s), {len(model.citations)} "
+            f"citation(s)",
+            file=sys.stderr,
+        )
+        return 1 if diags else 0
+
+    from pvraft_tpu.analysis.gate.runner import run_gate, validate_gate_report
+
+    try:
+        report = run_gate(
+            root=args.root or None,
+            only=tuple(args.only),
+            jobs=args.jobs,
+            use_cache=not args.no_cache,
+            changed_only=args.changed_only,
+            verbose=args.verbose,
+        )
+    except (ValueError, RuntimeError) as exc:
+        print(f"gate: {exc}", file=sys.stderr)
+        return 2
+    problems = validate_gate_report(report)
+    for p in problems:  # pragma: no cover - the runner emits valid reports
+        print(f"gate: report invalid: {p}", file=sys.stderr)
+    if args.out:
+        import json
+
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    counts = report["counts"]
+    print(
+        f"gate: {counts['ok']} ok, {counts['cached']} cached, "
+        f"{counts['failed']} failed, {counts['skipped']} skipped "
+        f"in {report['total_s']:.1f}s (jobs={report['jobs']})",
+        file=sys.stderr,
+    )
+    return 0 if report["ok"] and not problems else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pvraft_tpu.analysis",
@@ -487,6 +575,47 @@ def main(argv=None) -> int:
                        help="regenerate the replay and compare against a "
                             "committed artifact (exit 1 on drift)")
     p_det.set_defaults(fn=_cmd_determinism)
+
+    p_gate = sub.add_parser(
+        "gate",
+        help="gatecheck: run the declared gate (cached, parallel, "
+             "per-stage timing) or the GE evidence/claims rules "
+             "(--rules); --check validates committed gate reports",
+    )
+    p_gate.add_argument("--rules", action="store_true",
+                        help="run the GE001+ evidence/claims rules "
+                             "instead of executing the gate stages")
+    p_gate.add_argument("--list-rules", action="store_true",
+                        help="print the GE rule table and exit")
+    p_gate.add_argument("--select", default="",
+                        help="with --rules: comma-separated GE rule ids "
+                             "(default all)")
+    p_gate.add_argument("--list-stages", action="store_true",
+                        help="print the declared stage registry and exit")
+    p_gate.add_argument("--only", action="append", default=[],
+                        metavar="STAGE",
+                        help="run only this stage (repeatable)")
+    p_gate.add_argument("--jobs", type=int, default=None,
+                        help="parallel stages (default: min(4, cpus), "
+                             "at least 2)")
+    p_gate.add_argument("--changed-only", action="store_true",
+                        help="skip stages whose input globs intersect no "
+                             "file changed vs git HEAD")
+    p_gate.add_argument("--no-cache", action="store_true",
+                        help="ignore and do not write the content-hash "
+                             "stage cache (.gate_cache/)")
+    p_gate.add_argument("--out", default="",
+                        help="write the pvraft_gate/v1 report here")
+    p_gate.add_argument("--check", nargs="+", default=[],
+                        metavar="REPORT",
+                        help="validate committed pvraft_gate/v1 reports "
+                             "(full green run, stage set == registry)")
+    p_gate.add_argument("--root", default="",
+                        help="repo root (default: cwd)")
+    p_gate.add_argument("-v", "--verbose", action="store_true",
+                        help="echo every stage's buffered output, not "
+                             "just failures")
+    p_gate.set_defaults(fn=_cmd_gate)
 
     args = parser.parse_args(argv)
     return args.fn(args)

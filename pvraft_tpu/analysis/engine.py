@@ -341,10 +341,10 @@ def collect_suppressions(paths: Sequence[str]) -> List[Pragma]:
 
 def known_rule_ids() -> Set[str]:
     """Ids of every registered rule: AST (GL), jaxpr (GJ), concurrency
-    (GC), kernel (GK), sharding (GS) and determinism (GD) families —
-    one namespace for the shared pragma grammar, so ``lint --stats``
-    counts every engine's suppressions and flags none of them as
-    unknown."""
+    (GC), kernel (GK), sharding (GS), determinism (GD) and gate (GE)
+    families — one namespace for the shared pragma grammar, so ``lint
+    --stats`` counts every engine's suppressions and flags none of them
+    as unknown."""
     ids = {r.id for r in all_rules()}
     try:
         from pvraft_tpu.analysis.jaxpr.rules import all_jaxpr_rules
@@ -382,6 +382,13 @@ def known_rule_ids() -> Set[str]:
 
         ids |= {r.id for r in all_determinism_rules()}
         ids.add("GD000")  # the checker's syntax-error diagnostic
+    except ImportError:  # pragma: no cover - partial checkouts only
+        pass
+    try:
+        from pvraft_tpu.analysis.gate.rules import all_gate_rules
+
+        ids |= {r.id for r in all_gate_rules()}
+        ids.add("GE000")  # the evidence-model build-error diagnostic
     except ImportError:  # pragma: no cover - partial checkouts only
         pass
     return ids

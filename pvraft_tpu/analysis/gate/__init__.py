@@ -1,0 +1,35 @@
+"""gatecheck: evidence/claims static analysis + the declared gate runner.
+
+The SEVENTH analysis engine. Two halves:
+
+* The GE rules (``rules.py``, driven by ``check.py``) machine-check the
+  repo's evidence discipline: every cited artifact path exists and every
+  committed artifact is indexed (GE001), every committed artifact is
+  covered by a registered validator (GE002), every annotated headline
+  number still equals its artifact field (GE003, the
+  ``<!-- claim: artifacts/x.json#dotted.path -->`` convention), every
+  ``pvraft_*/v1`` schema string resolves to exactly one registered
+  validator (GE004), and the gate stage set is declared exactly once and
+  identical across the registry, ``scripts/lint.sh`` and CI (GE005).
+
+* The gate RUNNER (``stages.py`` + ``runner.py``): the old lint.sh bash
+  stage list as declared :class:`GateStage` data, executed by
+  ``python -m pvraft_tpu.analysis gate`` with a dependency-aware
+  parallel scheduler, content-hash caching over each stage's input
+  files, ``--changed-only`` for local dev, per-stage timing and a
+  validated ``pvraft_gate/v1`` report.
+"""
+
+from pvraft_tpu.analysis.gate.evidence import (  # noqa: F401
+    CLAIM_DOCS,
+    EPHEMERAL_PATHS,
+    VALIDATORS,
+    ValidatorSpec,
+)
+from pvraft_tpu.analysis.gate.stages import (  # noqa: F401
+    GATE_STAGES,
+    GateStage,
+    parse_manifest,
+    stage_names,
+    stage_problems,
+)

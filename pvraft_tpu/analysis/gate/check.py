@@ -1,0 +1,117 @@
+"""gatecheck driver: build the evidence model, run the GE rules.
+
+Mirrors the other engines' check.py shape (``check_repo`` instead of
+``check_paths`` — the evidence discipline is a repo-level property, not
+a per-file one). Suppressions use the one shared pragma grammar; in
+markdown docs a pragma rides inside an HTML comment
+(``<!-- # graftlint: disable=GE003 -- reason -->``) on the finding's
+line. The clean tree carries zero GE pragmas — findings get fixed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pvraft_tpu.analysis.engine import Diagnostic, _parse_pragma, _suppressions
+from pvraft_tpu.analysis.gate.evidence import CLAIM_DOCS, VALIDATORS
+from pvraft_tpu.analysis.gate.model import (
+    DEFAULT_MANIFESTS,
+    EvidenceModel,
+    build_evidence_model,
+)
+from pvraft_tpu.analysis.gate.rules import GateContext, all_gate_rules
+from pvraft_tpu.analysis.gate.stages import GATE_STAGES
+
+
+def _file_suppressions(path: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(per-line ids, file-level ids) for any text file.
+
+    Python files get the real tokenizer treatment (docstring examples
+    never suppress); other files are scanned line-wise for the pragma —
+    in markdown that means inside an HTML comment.
+    """
+    try:
+        with open(path, "r", encoding="utf-8-sig") as fh:
+            source = fh.read()
+    except OSError:
+        return {}, set()
+    if path.endswith(".py"):
+        per_line, file_ids = _suppressions(source)
+        return {k: set(v) for k, v in per_line.items()}, set(file_ids)
+    per_line: Dict[int, Set[str]] = {}
+    file_ids: Set[str] = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        parsed = _parse_pragma(line)
+        if parsed is None:
+            continue
+        kind, ids, _reason = parsed
+        if kind == "file":
+            file_ids.update(ids)
+        elif kind == "next":
+            per_line.setdefault(i + 1, set()).update(ids)
+        else:
+            per_line.setdefault(i, set()).update(ids)
+    return per_line, file_ids
+
+
+def _apply_suppressions(
+    diags: List[Diagnostic], root: str
+) -> List[Diagnostic]:
+    cache: Dict[str, Tuple[Dict[int, Set[str]], Set[str]]] = {}
+    out: List[Diagnostic] = []
+    for d in diags:
+        if d.path not in cache:
+            cache[d.path] = _file_suppressions(os.path.join(root, d.path))
+        per_line, file_ids = cache[d.path]
+        if "all" in file_ids or d.rule_id in file_ids:
+            continue
+        ids = per_line.get(d.line, set())
+        if "all" in ids or d.rule_id in ids:
+            continue
+        out.append(d)
+    return out
+
+
+def check_repo(
+    root: Optional[str] = None,
+    rule_ids: Sequence[str] = (),
+    validators=VALIDATORS,
+    stages=GATE_STAGES,
+    docs: Sequence[str] = CLAIM_DOCS,
+    manifest_paths: Sequence[str] = DEFAULT_MANIFESTS,
+    expected_manifests: Optional[Sequence[str]] = None,
+    use_git: bool = True,
+) -> Tuple[List[Diagnostic], EvidenceModel]:
+    """Run the GE rules over a repo tree.
+
+    ``expected_manifests`` defaults to ``manifest_paths`` — a missing
+    shim/CI manifest is a GE005 finding, not a silent skip. Fixture
+    tests pass their own tables and ``use_git=False`` (fixture trees are
+    subtrees of this repo, not repos of their own).
+    """
+    root = os.path.abspath(root or os.getcwd())
+    model = build_evidence_model(
+        root, docs=docs, manifest_paths=manifest_paths, use_git=use_git
+    )
+    if expected_manifests is None:
+        expected_manifests = manifest_paths
+    ctx = GateContext(
+        model=model,
+        validators=tuple(validators),
+        stages=tuple(stages),
+        expected_manifests=tuple(expected_manifests),
+    )
+    diags: List[Diagnostic] = [
+        Diagnostic(path, line, 0, "GE000", msg)
+        for path, line, msg in model.errors
+    ]
+    for rule_cls in all_gate_rules():
+        if rule_ids and rule_cls.id not in rule_ids:
+            continue
+        diags.extend(rule_cls().check(ctx))
+    if rule_ids:
+        diags = [d for d in diags if d.rule_id in rule_ids or d.rule_id == "GE000"]
+    diags = _apply_suppressions(diags, root)
+    diags.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id, d.message))
+    return diags, model

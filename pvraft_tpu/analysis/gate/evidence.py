@@ -1,0 +1,383 @@
+"""Declared evidence data plane for gatecheck.
+
+The PARTITION_RULES/KERNEL_BINDINGS precedent applied to the evidence
+discipline itself: which committed artifact is validated by which gate
+stage (``VALIDATORS``, an ordered first-match table like
+``scripts/artifact_budget.py``'s glob caps), which docs carry headline
+claims (``CLAIM_DOCS``), and which ``artifacts/`` subtrees are declared
+ephemeral run products rather than committed evidence
+(``EPHEMERAL_PATHS``). The GE rules (``rules.py``) are thin checks over
+this table plus the repo state — changing the evidence story means
+editing data here, and the rules keep the table honest against the
+tracked tree both ways.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Sequence, Tuple
+
+# Docs whose artifact citations and <!-- claim: --> annotations gatecheck
+# sweeps. artifacts/README.md is additionally the index GE001 checks the
+# tracked artifact set against.
+CLAIM_DOCS: Tuple[str, ...] = (
+    "README.md",
+    "BENCHMARKS.md",
+    "ROADMAP.md",
+    "artifacts/README.md",
+)
+
+# artifacts/ subtrees that are ephemeral run products (gitignored caches,
+# raw queue logs): citable as directories in prose, never required to
+# exist on a fresh checkout, never indexed per-file.
+EPHEMERAL_PATHS: Tuple[str, ...] = (
+    "artifacts/xla_cache",
+    "artifacts/logs",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ValidatorSpec:
+    """One row of the evidence registry.
+
+    ``schema``: the ``pvraft_*/v1`` schema string this row owns ("" for
+    evidence that predates the schema discipline or is pinned by other
+    means). GE004 enforces each schema appears on exactly one row.
+
+    ``globs``: artifact paths (repo-relative, fnmatch) this row covers.
+    First matching row across the table wins — keep specific globs
+    (``*.trace.json``) above broad ones (``serve_*.json``), the
+    artifact_budget.py discipline. Empty globs = a run-product schema
+    with no committed artifact (snapshots, advisor hints).
+
+    ``stage``: the gate stage (``stages.GATE_STAGES`` name) that
+    validates the covered artifacts ("" when the pin lives elsewhere —
+    the note says where). GE005 checks stage names resolve.
+
+    ``note``: how this evidence stays honest — shown in findings so a
+    GE002 hit tells the author what kind of row to add.
+    """
+
+    schema: str
+    globs: Tuple[str, ...]
+    stage: str
+    note: str
+
+
+# Ordered, first match wins (specific before broad — the serve_*.json row
+# must come after the trace/slo/calibration rows it would shadow).
+VALIDATORS: Tuple[ValidatorSpec, ...] = (
+    ValidatorSpec(
+        schema="pvraft_kernel_plan/v1",
+        globs=("artifacts/kernel_plan.json",),
+        stage="kernel-plan",
+        note="regenerate-and-compare vs the static kernel models",
+    ),
+    ValidatorSpec(
+        schema="pvraft_pod_plan/v1",
+        globs=("artifacts/pod_plan.json",),
+        stage="pod-plan",
+        note="regenerate-and-compare vs PARTITION_RULES x params_tree x costs",
+    ),
+    ValidatorSpec(
+        schema="pvraft_params_tree/v1",
+        globs=("artifacts/params_tree.json",),
+        stage="params-tree",
+        note="regenerate-and-compare vs the registry eval_shape tree",
+    ),
+    ValidatorSpec(
+        schema="pvraft_determinism/v1",
+        globs=("artifacts/determinism_report.json",),
+        stage="determinism-replay",
+        note="fresh bitwise replay on this host, digests pinned per platform",
+    ),
+    ValidatorSpec(
+        schema="pvraft_costs/v1",
+        globs=("artifacts/programs_costs.json",),
+        stage="costs-check",
+        note="schema + both-direction registry coverage",
+    ),
+    ValidatorSpec(
+        schema="",
+        globs=("artifacts/programs_kernels.json",),
+        stage="kernels-evidence",
+        note="pinned both directions vs the kernel-tag registry",
+    ),
+    ValidatorSpec(
+        schema="pvraft_bench/v1",
+        globs=("artifacts/bench_*.json",),
+        stage="validate-bench",
+        note="schema + bench_compare self-comparison wiring",
+    ),
+    ValidatorSpec(
+        schema="pvraft_capacity/v1",
+        globs=("artifacts/capacity_report.json",),
+        stage="validate-capacity",
+        note="schema + regenerate from the artifact's own recorded inputs",
+    ),
+    ValidatorSpec(
+        schema="pvraft_cost_calibration/v1",
+        globs=("artifacts/serve_calibration.json",),
+        stage="validate-calibration",
+        note="identity held at every snapshot; comparable=true off-TPU rejected",
+    ),
+    ValidatorSpec(
+        schema="pvraft_events/v1",
+        globs=("artifacts/*.events.jsonl",),
+        stage="validate-events",
+        note="every committed event log parses against the stream schema",
+    ),
+    ValidatorSpec(
+        schema="pvraft_trace/v1",
+        globs=("artifacts/*.trace.json",),
+        stage="validate-trace",
+        note="completeness/orphan counts recomputed from the spans",
+    ),
+    ValidatorSpec(
+        schema="pvraft_slo/v1",
+        globs=("artifacts/*.slo.json",),
+        stage="validate-slo",
+        note="stage-sum vs e2e honesty ratio checked at the declared band",
+    ),
+    # Broad serve row AFTER the trace/slo/calibration rows above.
+    ValidatorSpec(
+        schema="pvraft_serve_load/v1",
+        globs=("artifacts/serve_*.json",),
+        stage="validate-load",
+        note="loadgen evidence; server_metrics reconcile",
+    ),
+    ValidatorSpec(
+        schema="pvraft_step_profile/v1",
+        globs=("artifacts/step_profile.json",),
+        stage="validate-profile",
+        note="stage breakdown must telescope to the measured total",
+    ),
+    ValidatorSpec(
+        schema="pvraft_gate/v1",
+        globs=("artifacts/gate_*.json",),
+        stage="validate-gate-report",
+        note="committed gate reports: full run, all stages ok/cached",
+    ),
+    # Run-product schemas with no committed artifact: declared here so
+    # GE004 still sees exactly one owner for the schema string.
+    ValidatorSpec(
+        schema="pvraft_snapshot/v1",
+        globs=(),
+        stage="",
+        note="divergence snapshots live under experiments/, never committed",
+    ),
+    ValidatorSpec(
+        schema="pvraft_bucket_advisor/v1",
+        globs=(),
+        stage="",
+        note="serve bucket advisor hints are run products, never committed",
+    ),
+    # Pre-schema / otherwise-pinned evidence (schema=""): covered rows so
+    # GE002 stays quiet for the right reason, with the pin named.
+    ValidatorSpec(
+        schema="",
+        globs=("artifacts/programs_list.txt",),
+        stage="",
+        note="pinned both directions by tests/test_programs.py",
+    ),
+    ValidatorSpec(
+        schema="",
+        globs=(
+            "artifacts/convergence_*.json",
+            "artifacts/ft3d_pipeline_convergence*.json",
+            "artifacts/refine_convergence.json",
+        ),
+        stage="",
+        note="generator-gated convergence evidence (writer refuses on red gates)",
+    ),
+    ValidatorSpec(
+        schema="",
+        globs=(
+            "artifacts/grad_parity.json",
+            "artifacts/protocol_parity*.json",
+            "artifacts/trajectory_parity.json",
+            "artifacts/loader_parity.json",
+            "artifacts/loader_bench.json",
+        ),
+        stage="",
+        note="generator-gated parity/bench evidence vs the torch reference",
+    ),
+    ValidatorSpec(
+        schema="",
+        globs=(
+            "artifacts/scale16k_*.json",
+            "artifacts/eval_tpu.json",
+            "artifacts/tpu_consistency.json",
+            "artifacts/aot_readiness.json",
+            "artifacts/multistep_probe.jsonl",
+        ),
+        stage="",
+        note="pre-schema on-chip/queue evidence; superseding schemas tracked in ROADMAP",
+    ),
+    ValidatorSpec(
+        schema="",
+        globs=("artifacts/*.log", "artifacts/logs/*"),
+        stage="",
+        note="raw queue logs: history, not citable evidence",
+    ),
+)
+
+
+# --- citation / claim extraction -------------------------------------------
+
+# An artifacts/ path cited in prose. Template spellings survive the
+# match (<timestamp>, {a,b}, *) and are normalized by _normalize_citation.
+_CITE_RE = re.compile(r"artifacts/[A-Za-z0-9_.{},*<>/-]*[A-Za-z0-9_*>}]")
+
+# The GE003 machine-checkable citation convention. The value under check
+# is the LAST numeric token on the line before the claim comment. An
+# optional unit transform maps raw artifact units onto prose units:
+# ``@gib``/``@mib`` divide a byte field, ``@len`` takes a collection's
+# length ("95 leaves" against the leaves array itself).
+CLAIM_RE = re.compile(
+    r"<!--\s*claim:\s*(?P<src>artifacts/[A-Za-z0-9_./-]+)"
+    r"#(?P<field>[A-Za-z0-9_.-]+)(?:@(?P<unit>[a-z]+))?\s*-->"
+)
+
+CLAIM_UNITS = ("gib", "mib", "len")
+
+_NUM_RE = re.compile(r"[-+]?\d[\d,]*(?:\.\d+)?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Citation:
+    doc: str
+    line: int
+    raw: str
+    patterns: Tuple[str, ...]  # normalized fnmatch patterns
+
+
+@dataclasses.dataclass(frozen=True)
+class Claim:
+    doc: str
+    line: int
+    src: str
+    field: str
+    unit: str  # "" or one of CLAIM_UNITS
+    quoted: Optional[str]  # numeric token preceding the comment, or None
+
+
+def _expand_braces(pattern: str) -> List[str]:
+    """One level of {a,b} brace expansion (citation templates use one)."""
+    m = re.search(r"\{([^{}]*)\}", pattern)
+    if not m:
+        return [pattern]
+    out: List[str] = []
+    for alt in m.group(1).split(","):
+        out.extend(_expand_braces(pattern[: m.start()] + alt + pattern[m.end():]))
+    return out
+
+
+def _normalize_citation(raw: str) -> List[str]:
+    """Cited path -> fnmatch patterns (templates become globs)."""
+    raw = raw.rstrip(".,;:)")
+    raw = re.sub(r"<[^<>]*>", "*", raw)
+    return [p for p in _expand_braces(raw) if p not in ("artifacts", "artifacts/")]
+
+
+def extract_citations(doc: str, lines: Sequence[str]) -> List[Citation]:
+    out: List[Citation] = []
+    for i, line in enumerate(lines, start=1):
+        for m in _CITE_RE.finditer(line):
+            raw = m.group(0)
+            pats = tuple(_normalize_citation(raw))
+            if pats:
+                out.append(Citation(doc=doc, line=i, raw=raw, patterns=pats))
+    return out
+
+
+def extract_claims(doc: str, lines: Sequence[str]) -> List[Claim]:
+    """Claims on a line consume the numeric tokens left of each comment.
+
+    Multiple claims per line work left-to-right: each claim's quoted
+    value is the last number in the segment between the previous claim
+    comment and its own. Lines inside fenced code blocks are syntax
+    examples, not claims (the docstring-pragma discipline).
+    """
+    out: List[Claim] = []
+    fenced = False
+    for i, line in enumerate(lines, start=1):
+        if line.lstrip().startswith("```"):
+            fenced = not fenced
+            continue
+        if fenced:
+            continue
+        prev_end = 0
+        for m in CLAIM_RE.finditer(line):
+            segment = line[prev_end : m.start()]
+            nums = _NUM_RE.findall(segment)
+            out.append(
+                Claim(
+                    doc=doc,
+                    line=i,
+                    src=m.group("src"),
+                    field=m.group("field"),
+                    unit=m.group("unit") or "",
+                    quoted=nums[-1] if nums else None,
+                )
+            )
+            prev_end = m.end()
+    return out
+
+
+def resolve_field(obj: object, dotted: str):
+    """Walk a dotted path through dicts (keys) and lists (int indices).
+
+    Returns (found: bool, value).
+    """
+    cur = obj
+    for seg in dotted.split("."):
+        if isinstance(cur, dict):
+            if seg not in cur:
+                return False, None
+            cur = cur[seg]
+        elif isinstance(cur, list):
+            if not re.fullmatch(r"-?\d+", seg):
+                return False, None
+            idx = int(seg)
+            if not (-len(cur) <= idx < len(cur)):
+                return False, None
+            cur = cur[idx]
+        else:
+            return False, None
+    return True, cur
+
+
+def apply_unit(value: object, unit: str):
+    """Apply a claim unit transform. Returns (ok, transformed)."""
+    if not unit:
+        return True, value
+    if unit == "len":
+        if isinstance(value, (list, dict, str)):
+            return True, len(value)
+        return False, value
+    if unit in ("gib", "mib"):
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return False, value
+        return True, value / (2 ** 30 if unit == "gib" else 2 ** 20)
+    return False, value
+
+
+def claim_matches(quoted: str, value: object) -> bool:
+    """Quoted prose number vs artifact value, at the prose's precision.
+
+    The prose is allowed to round: ``10.46`` matches any value within
+    half its last printed digit (|v - p| <= 0.5 * 10^-d). Commas in the
+    prose are thousands separators.
+    """
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return False
+    text = quoted.replace(",", "")
+    try:
+        prose = float(text)
+    except ValueError:
+        return False
+    digits = len(text.split(".", 1)[1]) if "." in text else 0
+    tol = 0.5 * 10.0 ** (-digits)
+    return abs(float(value) - prose) <= tol + 1e-12

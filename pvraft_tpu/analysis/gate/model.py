@@ -1,0 +1,214 @@
+"""Build the evidence model the GE rules check.
+
+One read-only pass over the repo: the tracked artifact set, every
+citation and ``<!-- claim: -->`` in the claim docs, each artifact's
+``schema`` field, the backticked row tokens of the artifacts/README
+index, the ``# gate-stage:`` manifests, and every ``pvraft_*/vN``
+schema literal in package/scripts source. Pure stdlib; no jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+import subprocess
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pvraft_tpu.analysis.gate.evidence import (
+    CLAIM_DOCS,
+    EPHEMERAL_PATHS,
+    Citation,
+    Claim,
+    extract_citations,
+    extract_claims,
+)
+
+_SCHEMA_LITERAL_RE = re.compile(r"pvraft_[a-z0-9_]+/v\d+")
+
+# Backticked tokens in artifacts/README rows: the per-artifact index.
+_ROW_TOKEN_RE = re.compile(r"`([^`\s]+)`")
+
+
+@dataclasses.dataclass
+class EvidenceModel:
+    root: str
+    docs: Dict[str, List[str]]                      # relpath -> lines
+    tracked: List[str]                              # artifacts/... relpaths
+    citations: List[Citation]
+    claims: List[Claim]
+    artifact_schemas: Dict[str, Optional[str]]      # relpath -> schema field
+    index_patterns: List[Tuple[int, str]]           # artifacts/README rows
+    manifests: Dict[str, List[Tuple[int, str]]]     # path -> [(line, stage)]
+    source_schemas: List[Tuple[str, int, str]]      # (path, line, schema)
+    errors: List[Tuple[str, int, str]]              # GE000 material
+
+
+def _ephemeral(rel: str) -> bool:
+    return any(rel == e or rel.startswith(e + "/") for e in EPHEMERAL_PATHS)
+
+
+def tracked_artifacts(root: str, use_git: bool = True) -> List[str]:
+    """Committed evidence: git-tracked artifacts/ files, unioned with the
+    on-disk tree (minus declared-ephemeral subtrees) so a freshly written,
+    not-yet-added artifact is already checked before commit."""
+    found = set()
+    if use_git:
+        try:
+            out = subprocess.run(
+                ["git", "-C", root, "ls-files", "--", "artifacts"],
+                capture_output=True, text=True, timeout=30, check=False,
+            )
+            if out.returncode == 0:
+                for line in out.stdout.splitlines():
+                    line = line.strip()
+                    if line and not _ephemeral(line):
+                        found.add(line)
+        except OSError:
+            pass
+    art_dir = os.path.join(root, "artifacts")
+    if os.path.isdir(art_dir):
+        for dirpath, dirnames, filenames in os.walk(art_dir):
+            rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+            dirnames[:] = [
+                d for d in dirnames
+                if d != "__pycache__" and not _ephemeral(f"{rel_dir}/{d}")
+            ]
+            for fn in filenames:
+                rel = f"{rel_dir}/{fn}"
+                if not _ephemeral(rel):
+                    found.add(rel)
+    found.discard("artifacts/README.md")
+    return sorted(found)
+
+
+def _artifact_schema(path: str) -> Tuple[bool, Optional[str]]:
+    """(parsed_ok, schema field) of a .json / .jsonl artifact."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            if path.endswith(".jsonl"):
+                first = fh.readline()
+                doc = json.loads(first) if first.strip() else {}
+            else:
+                doc = json.load(fh)
+    except (OSError, ValueError):
+        return False, None
+    if isinstance(doc, dict):
+        schema = doc.get("schema")
+        return True, schema if isinstance(schema, str) else None
+    return True, None
+
+
+def _index_patterns(lines: Sequence[str]) -> List[Tuple[int, str]]:
+    """artifacts/README table rows -> (line, fnmatch pattern) per token.
+
+    Tokens are the backticked filenames in the first column (and inline
+    mentions): ``<...>`` templates become ``*``; a leading-dot token
+    like ``.events.jsonl`` indexes every artifact with that suffix.
+    """
+    out: List[Tuple[int, str]] = []
+    for i, line in enumerate(lines, start=1):
+        if not line.lstrip().startswith("|"):
+            continue
+        first_col = line.split("|")[1] if line.count("|") >= 2 else line
+        for tok in _ROW_TOKEN_RE.findall(first_col):
+            pat = re.sub(r"<[^<>]*>", "*", tok)
+            if pat.startswith("."):
+                pat = "*" + pat
+            if pat.startswith("artifacts/"):
+                pat = pat[len("artifacts/"):]
+            out.append((i, pat))
+    return out
+
+
+def _scan_source_schemas(root: str) -> List[Tuple[str, int, str]]:
+    out: List[Tuple[str, int, str]] = []
+    roots = [os.path.join(root, "pvraft_tpu"), os.path.join(root, "scripts")]
+    for base in roots:
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                try:
+                    with open(path, "r", encoding="utf-8") as fh:
+                        for i, line in enumerate(fh, start=1):
+                            for m in _SCHEMA_LITERAL_RE.finditer(line):
+                                out.append((rel, i, m.group(0)))
+                except OSError:
+                    continue
+    return out
+
+
+DEFAULT_MANIFESTS: Tuple[str, ...] = (
+    "scripts/lint.sh",
+    ".github/workflows/ci.yml",
+)
+
+
+def build_evidence_model(
+    root: Optional[str] = None,
+    docs: Sequence[str] = CLAIM_DOCS,
+    manifest_paths: Sequence[str] = DEFAULT_MANIFESTS,
+    use_git: bool = True,
+) -> EvidenceModel:
+    from pvraft_tpu.analysis.gate.stages import parse_manifest
+
+    root = os.path.abspath(root or os.getcwd())
+    model = EvidenceModel(
+        root=root, docs={}, tracked=[], citations=[], claims=[],
+        artifact_schemas={}, index_patterns=[], manifests={},
+        source_schemas=[], errors=[],
+    )
+
+    for doc in docs:
+        path = os.path.join(root, doc)
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError as exc:
+            model.errors.append((doc, 1, f"unreadable claim doc ({exc})"))
+            continue
+        model.docs[doc] = lines
+        model.citations.extend(extract_citations(doc, lines))
+        model.claims.extend(extract_claims(doc, lines))
+        if doc == "artifacts/README.md":
+            model.index_patterns = _index_patterns(lines)
+
+    model.tracked = tracked_artifacts(root, use_git=use_git)
+    for rel in model.tracked:
+        if rel.endswith((".json", ".jsonl")):
+            ok, schema = _artifact_schema(os.path.join(root, rel))
+            if not ok:
+                model.errors.append((rel, 1, "unparseable JSON artifact"))
+            model.artifact_schemas[rel] = schema
+
+    for mpath in manifest_paths:
+        path = os.path.join(root, mpath)
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                model.manifests[mpath] = parse_manifest(fh.read())
+        except OSError as exc:
+            model.errors.append((mpath, 1, f"unreadable manifest ({exc})"))
+
+    model.source_schemas = _scan_source_schemas(root)
+    return model
+
+
+def first_match(rel: str, validators) -> Optional[object]:
+    """First VALIDATORS row whose glob covers an artifact (None = none)."""
+    for spec in validators:
+        for pattern in spec.globs:
+            if fnmatch.fnmatch(rel, pattern):
+                return spec
+    return None

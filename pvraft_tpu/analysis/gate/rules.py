@@ -1,0 +1,353 @@
+"""The GE rules: evidence/claims discipline, machine-checked.
+
+Repo-level rules (one :class:`GateContext` per run, not per file) over
+the declared evidence tables (``evidence.VALIDATORS``,
+``stages.GATE_STAGES``) and the built :class:`EvidenceModel`. Findings
+share the one Diagnostic type and the ``# graftlint: disable=GExxx --
+reason`` pragma grammar with the other six engines; GE000 is the
+model-build error diagnostic (unreadable doc, unparseable artifact).
+
+Zero findings on the clean tree — real violations get fixed (the
+deepcheck precedent), not pragma'd.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import glob as _glob
+import json
+import os
+import re
+from typing import Iterator, List, Tuple
+
+from pvraft_tpu.analysis.engine import Diagnostic
+from pvraft_tpu.analysis.gate.evidence import (
+    EPHEMERAL_PATHS,
+    ValidatorSpec,
+    apply_unit,
+    claim_matches,
+    resolve_field,
+)
+from pvraft_tpu.analysis.gate.model import EvidenceModel, first_match
+from pvraft_tpu.analysis.gate.stages import GateStage, stage_problems
+
+
+@dataclasses.dataclass
+class GateContext:
+    model: EvidenceModel
+    validators: Tuple[ValidatorSpec, ...]
+    stages: Tuple[GateStage, ...]
+    # Manifest paths the repo is EXPECTED to carry (a deleted shim must
+    # not silently drop the GE005 identity check).
+    expected_manifests: Tuple[str, ...] = ()
+
+
+def _anchor_in(root: str, rel: str, needle: str) -> int:
+    """First line of ``needle`` in a file (1 when absent/unreadable) —
+    registry findings anchor at the declaring row, not the file top."""
+    try:
+        with open(os.path.join(root, rel), "r", encoding="utf-8") as fh:
+            for i, line in enumerate(fh, start=1):
+                if needle in line:
+                    return i
+    except OSError:
+        pass
+    return 1
+
+
+_EVIDENCE_PY = "pvraft_tpu/analysis/gate/evidence.py"
+_STAGES_PY = "pvraft_tpu/analysis/gate/stages.py"
+
+
+class GateRule:
+    id = "GE000"
+    title = "gate-rule"
+
+    def check(self, ctx: GateContext) -> Iterator[Diagnostic]:
+        raise NotImplementedError
+
+
+class DanglingEvidence(GateRule):
+    """Cited evidence must exist; committed evidence must be indexed.
+
+    Forward: every ``artifacts/...`` path cited in a claim doc must
+    resolve — as an existing file/directory, a glob over the tracked
+    set, or a declared-ephemeral subtree (caches and raw logs are
+    citable as directories without existing on a fresh checkout).
+    Reverse: every tracked ``artifacts/*`` file must be covered by an
+    artifacts/README index row (the "numbers without an artifact don't
+    count" ledger, enforced both ways).
+    """
+
+    id = "GE001"
+    title = "dangling-evidence"
+
+    def check(self, ctx: GateContext) -> Iterator[Diagnostic]:
+        model = ctx.model
+        tracked = set(model.tracked)
+        for cite in model.citations:
+            if self._resolves(model.root, cite.patterns, tracked):
+                continue
+            yield Diagnostic(
+                cite.doc, cite.line, 0, self.id,
+                f"cited evidence {cite.raw!r} matches no existing file "
+                f"(tracked artifacts, on-disk paths and declared-ephemeral "
+                f"subtrees all checked)",
+            )
+        if "artifacts/README.md" in model.docs:
+            patterns = [p for _, p in model.index_patterns]
+            for rel in model.tracked:
+                base = rel[len("artifacts/"):]
+                if any(
+                    fnmatch.fnmatch(base, p)
+                    or fnmatch.fnmatch(os.path.basename(base), p)
+                    for p in patterns
+                ):
+                    continue
+                yield Diagnostic(
+                    "artifacts/README.md", 1, 0, self.id,
+                    f"tracked artifact {rel!r} has no index row "
+                    f"(every committed evidence file needs one)",
+                )
+
+    @staticmethod
+    def _resolves(root: str, patterns, tracked) -> bool:
+        for pattern in patterns:
+            if any(
+                pattern == e or pattern.startswith(e + "/")
+                for e in EPHEMERAL_PATHS
+            ):
+                return True
+            if "*" in pattern or "?" in pattern:
+                if any(fnmatch.fnmatch(t, pattern) for t in tracked):
+                    return True
+                if sorted(_glob.glob(os.path.join(root, pattern))):
+                    return True
+            elif os.path.exists(os.path.join(root, pattern)):
+                return True
+        return False
+
+
+class UnvalidatedArtifact(GateRule):
+    """Every committed artifact is covered by a registered validator row.
+
+    The silent-drift class: an artifact no gate stage validates can rot
+    green forever. Coverage is first-match over ``VALIDATORS`` globs;
+    pre-schema evidence is covered by explicit note rows naming the pin
+    that replaces a validator (tests, generator gates).
+    """
+
+    id = "GE002"
+    title = "unvalidated-artifact"
+
+    def check(self, ctx: GateContext) -> Iterator[Diagnostic]:
+        for rel in ctx.model.tracked:
+            if first_match(rel, ctx.validators) is None:
+                yield Diagnostic(
+                    rel, 1, 0, self.id,
+                    f"committed artifact {rel!r} is matched by no "
+                    f"VALIDATORS glob — add a validator stage row, or a "
+                    f"note row naming the pin that covers it",
+                )
+
+
+class StaleClaim(GateRule):
+    """Annotated headline numbers must equal their artifact field.
+
+    The ``<!-- claim: artifacts/x.json#dotted.path -->`` convention: the
+    last numeric token before the comment is compared (at the prose's
+    own printed precision) against the artifact field. A claim whose
+    artifact is missing, whose field doesn't resolve, or whose number
+    drifted is a finding — the machine-checked half of BENCHMARKS.md
+    "Provenance".
+    """
+
+    id = "GE003"
+    title = "stale-claim"
+
+    def check(self, ctx: GateContext) -> Iterator[Diagnostic]:
+        model = ctx.model
+        cache: dict = {}
+        for claim in model.claims:
+            where = f"{claim.src}#{claim.field}" + (
+                f"@{claim.unit}" if claim.unit else ""
+            )
+            path = os.path.join(model.root, claim.src)
+            if claim.src not in cache:
+                cache[claim.src] = self._load(path)
+            doc_obj = cache[claim.src]
+            if doc_obj is None:
+                yield Diagnostic(
+                    claim.doc, claim.line, 0, self.id,
+                    f"claim {where} cites a missing or unparseable artifact",
+                )
+                continue
+            found, value = resolve_field(doc_obj, claim.field)
+            if not found:
+                yield Diagnostic(
+                    claim.doc, claim.line, 0, self.id,
+                    f"claim {where}: field does not resolve in the artifact",
+                )
+                continue
+            ok, value = apply_unit(value, claim.unit)
+            if not ok:
+                yield Diagnostic(
+                    claim.doc, claim.line, 0, self.id,
+                    f"claim {where}: unit {claim.unit!r} does not apply to "
+                    f"the artifact value {value!r}",
+                )
+                continue
+            if claim.quoted is None:
+                yield Diagnostic(
+                    claim.doc, claim.line, 0, self.id,
+                    f"claim {where}: no numeric value precedes the claim "
+                    f"comment on this line (artifact value: {value!r})",
+                )
+                continue
+            if not claim_matches(claim.quoted, value):
+                yield Diagnostic(
+                    claim.doc, claim.line, 0, self.id,
+                    f"stale claim {where}: prose says {claim.quoted!r}, "
+                    f"artifact says {value!r}",
+                )
+
+    @staticmethod
+    def _load(path: str):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                if path.endswith(".jsonl"):
+                    first = fh.readline()
+                    return json.loads(first) if first.strip() else None
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+
+class SchemaExactlyOnce(GateRule):
+    """Every ``pvraft_*/vN`` schema string has exactly one validator row.
+
+    Duplicated ownership, an artifact whose ``schema`` field resolves to
+    no registered validator, a first-match row whose declared schema
+    disagrees with the artifact's own field, and a schema literal in
+    package/scripts source the registry doesn't know are all findings —
+    the schema namespace stays a closed, declared set.
+    """
+
+    id = "GE004"
+    title = "schema-exactly-once"
+
+    def check(self, ctx: GateContext) -> Iterator[Diagnostic]:
+        root = ctx.model.root
+        owners: dict = {}
+        for spec in ctx.validators:
+            if spec.schema:
+                owners.setdefault(spec.schema, []).append(spec)
+        for schema, specs in sorted(owners.items()):
+            if len(specs) > 1:
+                yield Diagnostic(
+                    _EVIDENCE_PY, _anchor_in(root, _EVIDENCE_PY, schema),
+                    0, self.id,
+                    f"schema {schema!r} is declared by {len(specs)} "
+                    f"VALIDATORS rows (exactly one owns a schema)",
+                )
+        known = set(owners)
+        for rel, schema in sorted(ctx.model.artifact_schemas.items()):
+            if schema is None:
+                continue
+            if schema not in known:
+                yield Diagnostic(
+                    rel, 1, 0, self.id,
+                    f"artifact declares schema {schema!r} which resolves "
+                    f"to no registered validator",
+                )
+                continue
+            spec = first_match(rel, ctx.validators)
+            if spec is not None and spec.schema and spec.schema != schema:
+                yield Diagnostic(
+                    rel, 1, 0, self.id,
+                    f"artifact declares schema {schema!r} but its "
+                    f"first-match validator row owns {spec.schema!r} "
+                    f"(glob order routes it to the wrong validator)",
+                )
+        for path, line, schema in ctx.model.source_schemas:
+            if schema not in known:
+                yield Diagnostic(
+                    path, line, 0, self.id,
+                    f"schema literal {schema!r} is not declared by any "
+                    f"VALIDATORS row",
+                )
+
+
+class StageCoverage(GateRule):
+    """The gate stage set is declared exactly once, everywhere.
+
+    The registry must be well-formed (unique names, resolving deps, no
+    cycles), every ``stage=`` reference in VALIDATORS must name a
+    declared stage, and the ``# gate-stage:`` manifests in the lint.sh
+    shim and ci.yml must equal the registry's stage set both ways — so
+    bash, CI and the declared data cannot drift apart.
+    """
+
+    id = "GE005"
+    title = "stage-coverage"
+
+    def check(self, ctx: GateContext) -> Iterator[Diagnostic]:
+        root = ctx.model.root
+        for problem in stage_problems(ctx.stages):
+            m = re.search(r"'([^']+)'", problem)
+            needle = f'name="{m.group(1)}"' if m else ""
+            yield Diagnostic(
+                _STAGES_PY,
+                _anchor_in(root, _STAGES_PY, needle) if needle else 1,
+                0, self.id, problem,
+            )
+        declared = {s.name for s in ctx.stages}
+        for spec in ctx.validators:
+            if spec.stage and spec.stage not in declared:
+                yield Diagnostic(
+                    _EVIDENCE_PY,
+                    _anchor_in(root, _EVIDENCE_PY, f'stage="{spec.stage}"'),
+                    0, self.id,
+                    f"VALIDATORS row {spec.globs!r} names undeclared gate "
+                    f"stage {spec.stage!r}",
+                )
+        for expected in ctx.expected_manifests:
+            if expected not in ctx.model.manifests:
+                yield Diagnostic(
+                    expected, 1, 0, self.id,
+                    f"expected gate-stage manifest {expected!r} is missing "
+                    f"(the stage-set identity check cannot run without it)",
+                )
+        for mpath, entries in sorted(ctx.model.manifests.items()):
+            named = {}
+            for line, name in entries:
+                if name in named:
+                    yield Diagnostic(
+                        mpath, line, 0, self.id,
+                        f"manifest names stage {name!r} more than once",
+                    )
+                named.setdefault(name, line)
+            for name, line in sorted(named.items()):
+                if name not in declared:
+                    yield Diagnostic(
+                        mpath, line, 0, self.id,
+                        f"manifest names stage {name!r} which the registry "
+                        f"does not declare",
+                    )
+            for name in sorted(declared - set(named)):
+                yield Diagnostic(
+                    mpath, 1, 0, self.id,
+                    f"registry stage {name!r} is missing from this "
+                    f"gate-stage manifest",
+                )
+
+
+def all_gate_rules() -> List[type]:
+    return [
+        DanglingEvidence,
+        UnvalidatedArtifact,
+        StaleClaim,
+        SchemaExactlyOnce,
+        StageCoverage,
+    ]

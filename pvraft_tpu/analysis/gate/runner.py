@@ -1,0 +1,466 @@
+"""The gate runner: execute the declared stage registry.
+
+Dependency-aware parallel scheduler over ``stages.GATE_STAGES`` with
+content-hash caching: a stage whose command, environment pins and input
+file contents are unchanged since its last green run is recorded as
+``cached`` and skipped. ``--changed-only`` additionally skips stages
+whose input globs intersect no file changed vs git HEAD (local dev
+loop). Every run emits a validated ``pvraft_gate/v1`` report with
+per-stage timing; the committed ``artifacts/gate_cold.json`` /
+``gate_warm.json`` snapshots BENCHMARKS.md cites are checked by
+``check_report_file`` (full run, every stage ok or cached, stage set
+identical to the registry, and per-stage ``input_hash``/``n_inputs``
+provenance present — a synthesized report fails).
+
+Timings are wall-clock records of a real run — never regenerate-and-
+compared (they are not reproducible functions of the tree).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import glob as _glob
+import hashlib
+import json
+import os
+import re
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pvraft_tpu.analysis.gate.stages import GATE_STAGES, GateStage, stage_problems
+
+SCHEMA_VERSION = "pvraft_gate/v1"
+CACHE_DIR = ".gate_cache"
+CACHE_FILE = "cache.json"
+_STATUSES = ("ok", "cached", "failed", "skipped")
+
+# The one skip reason that SATISFIES dependents: --changed-only found no
+# changed input, so the stage's previous green result still stands (like
+# "cached"). Every other skip means the dependency never went green.
+_CHANGED_ONLY_SKIP = "no changed input (vs git HEAD)"
+
+# Pruned from input-glob expansion: ephemeral caches would churn the
+# content hash (costs-smoke writes xla_cache) without being evidence.
+_PRUNE_PARTS = ("/artifacts/xla_cache/", "/__pycache__/", "/.gate_cache/")
+
+
+def expand_inputs(root: str, patterns: Sequence[str]) -> List[str]:
+    """Input globs -> sorted repo-relative file list (ephemeral pruned)."""
+    out: Set[str] = set()
+    for pattern in patterns:
+        for hit in sorted(_glob.glob(os.path.join(root, pattern), recursive=True)):
+            if not os.path.isfile(hit):
+                continue
+            probe = hit.replace(os.sep, "/")
+            if any(part in "/" + probe + "/" for part in _PRUNE_PARTS):
+                continue
+            out.add(os.path.relpath(hit, root).replace(os.sep, "/"))
+    return sorted(out)
+
+
+def _matches_any(rel: str, patterns: Sequence[str]) -> bool:
+    import fnmatch
+
+    for pattern in patterns:
+        if fnmatch.fnmatch(rel, pattern):
+            return True
+        # glob's ``**/`` may match zero directories; fnmatch's cannot.
+        if "**/" in pattern and fnmatch.fnmatch(rel, pattern.replace("**/", "")):
+            return True
+    return False
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def stage_cache_key(
+    root: str,
+    stage: GateStage,
+    files: Sequence[str],
+    digest_cache: Optional[Dict[str, str]] = None,
+) -> str:
+    """Content hash of everything a stage's verdict is a function of.
+
+    ``digest_cache`` memoizes per-file digests across stages within one
+    run — the package globs overlap heavily, and hashing each file once
+    instead of once per stage is pure savings (files are not expected to
+    change mid-run; the cache is per-run, never persisted).
+    """
+    h = hashlib.sha256()
+    h.update(stage.command.encode())
+    h.update(repr(sorted(stage.env)).encode())
+    h.update(str(stage.virtual_devices).encode())
+    for rel in files:
+        h.update(rel.encode())
+        digest = digest_cache.get(rel) if digest_cache is not None else None
+        if digest is None:
+            try:
+                digest = _file_digest(os.path.join(root, rel))
+            except OSError:
+                digest = "<unreadable>"
+            if digest_cache is not None:
+                digest_cache[rel] = digest
+        h.update(digest.encode())
+    return h.hexdigest()
+
+
+def _stage_environ(stage: GateStage) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update(dict(stage.env))
+    if stage.virtual_devices:
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{stage.virtual_devices}"
+        ).strip()
+    return env
+
+
+def _changed_files(root: str) -> Optional[Set[str]]:
+    """Files changed vs HEAD (tracked diffs + untracked), or None when
+    git is unavailable — the caller then treats everything as changed."""
+    changed: Set[str] = set()
+    for args in (
+        ["git", "-C", root, "diff", "--name-only", "HEAD"],
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            out = subprocess.run(
+                args, capture_output=True, text=True, timeout=30, check=False
+            )
+        except OSError:
+            return None
+        if out.returncode != 0:
+            return None
+        changed.update(l.strip() for l in out.stdout.splitlines() if l.strip())
+    return changed
+
+
+def _load_cache(root: str) -> Dict[str, str]:
+    path = os.path.join(root, CACHE_DIR, CACHE_FILE)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(doc, dict):
+        return {}
+    return {str(k): str(v) for k, v in doc.get("stages", {}).items()}
+
+
+def _save_cache(root: str, cache: Dict[str, str]) -> None:
+    cache_dir = os.path.join(root, CACHE_DIR)
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, CACHE_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"schema": SCHEMA_VERSION, "stages": cache}, fh, indent=1)
+    os.replace(tmp, path)
+
+
+def _dep_satisfied(record: dict) -> bool:
+    """Does a completed dependency unblock its dependents?
+
+    ok/cached do; a --changed-only skip also does (nothing the dep
+    watches changed, so its last green result is still in force). A
+    failed dep, or one skipped because its OWN dependency was not
+    green, does not.
+    """
+    if record["status"] in ("ok", "cached"):
+        return True
+    return (
+        record["status"] == "skipped"
+        and record.get("reason") == _CHANGED_ONLY_SKIP
+    )
+
+
+def run_gate(
+    root: Optional[str] = None,
+    stages: Sequence[GateStage] = GATE_STAGES,
+    only: Sequence[str] = (),
+    jobs: Optional[int] = None,
+    use_cache: bool = True,
+    changed_only: bool = False,
+    verbose: bool = False,
+    echo=print,
+) -> dict:
+    """Execute the gate; returns the ``pvraft_gate/v1`` report dict.
+
+    Scheduling: every stage whose deps have completed satisfied
+    (ok/cached, or skipped under --changed-only with no changed input —
+    the previous green result stands) is eligible; eligible stages run
+    concurrently up to ``jobs``. A failed or dep-cascade-skipped
+    dependency skips its dependents (recorded, never silently dropped).
+    Output of each stage is buffered and echoed serialized on
+    completion, so parallel stages cannot interleave.
+    """
+    root = os.path.abspath(root or os.getcwd())
+    problems = stage_problems(tuple(stages))
+    if problems:
+        raise ValueError("; ".join(problems))
+    if only:
+        wanted = set(only)
+        unknown = wanted - {s.name for s in stages}
+        if unknown:
+            raise ValueError(f"unknown stage(s): {sorted(unknown)}")
+        # Keep declared order; deps outside the selection are not run
+        # (the caller asked for exactly these stages).
+        stages = [s for s in stages if s.name in wanted]
+    if jobs is None:
+        jobs = max(2, min(4, os.cpu_count() or 1))
+
+    cache = _load_cache(root) if use_cache else {}
+    changed = _changed_files(root) if changed_only else None
+    digest_cache: Dict[str, str] = {}
+    by_name = {s.name: s for s in stages}
+    selected = {s.name for s in stages}
+    done: Dict[str, dict] = {}
+    lock = threading.Lock()
+    new_cache = dict(cache)
+    t0 = time.monotonic()
+
+    def run_one(stage: GateStage) -> dict:
+        files = expand_inputs(root, stage.inputs)
+        record = {
+            "name": stage.name,
+            "status": "ok",
+            "duration_s": 0.0,
+            "n_inputs": len(files),
+            "deps": list(stage.deps),
+            "command": stage.command,
+        }
+        if changed_only and changed is not None:
+            touched = [
+                c for c in changed
+                if _matches_any(c, stage.inputs) or c in files
+            ]
+            if not touched:
+                record["status"] = "skipped"
+                record["reason"] = _CHANGED_ONLY_SKIP
+                return record
+        with lock:
+            key = stage_cache_key(root, stage, files, digest_cache)
+        record["input_hash"] = key[:16]
+        if use_cache and cache.get(stage.name) == key:
+            record["status"] = "cached"
+            return record
+        start = time.monotonic()
+        proc = subprocess.run(
+            ["bash", "-c", stage.command],
+            cwd=root,
+            env=_stage_environ(stage),
+            capture_output=True,
+            text=True,
+        )
+        record["duration_s"] = round(time.monotonic() - start, 3)
+        record["output"] = proc.stdout[-20000:] + (
+            ("\n[stderr]\n" + proc.stderr[-20000:]) if proc.stderr.strip() else ""
+        )
+        if proc.returncode == 0:
+            record["status"] = "ok"
+            with lock:
+                new_cache[stage.name] = key
+        else:
+            record["status"] = "failed"
+            record["returncode"] = proc.returncode
+        return record
+
+    def report_done(record: dict) -> None:
+        name, status = record["name"], record["status"]
+        dur = record["duration_s"]
+        mark = {"ok": "ok", "cached": "cached", "failed": "FAILED",
+                "skipped": "skipped"}[status]
+        line = f"[gate] {name:<22} {mark:<8} {dur:8.1f}s"
+        if record.get("reason"):
+            line += f"  ({record['reason']})"
+        echo(line)
+        output = record.pop("output", "")
+        if output and (status == "failed" or verbose):
+            for out_line in output.splitlines():
+                echo(f"    {out_line}")
+
+    pending = [by_name[n] for n in by_name]
+    futures = {}
+    with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as pool:
+        while pending or futures:
+            progressed = False
+            for stage in list(pending):
+                deps = [d for d in stage.deps if d in selected]
+                if any(d not in done for d in deps):
+                    continue
+                bad = [d for d in deps if not _dep_satisfied(done[d])]
+                pending.remove(stage)
+                progressed = True
+                if bad:
+                    record = {
+                        "name": stage.name, "status": "skipped",
+                        "duration_s": 0.0, "n_inputs": 0,
+                        "deps": list(stage.deps), "command": stage.command,
+                        "reason": f"dependency not green: {', '.join(bad)}",
+                    }
+                    done[stage.name] = record
+                    report_done(record)
+                else:
+                    futures[pool.submit(run_one, stage)] = stage.name
+            if not futures:
+                if not progressed and pending:
+                    raise RuntimeError("scheduler stalled (dependency cycle?)")
+                continue
+            finished, _ = concurrent.futures.wait(
+                futures, return_when=concurrent.futures.FIRST_COMPLETED
+            )
+            for fut in finished:
+                name = futures.pop(fut)
+                record = fut.result()
+                done[name] = record
+                report_done(record)
+
+    total = round(time.monotonic() - t0, 3)
+    if use_cache:
+        # Failed stages drop out of the cache so a re-run retries them.
+        for name, record in done.items():
+            if record["status"] == "failed":
+                new_cache.pop(name, None)
+        _save_cache(root, new_cache)
+
+    records = [done[s.name] for s in stages]
+    counts = {status: 0 for status in _STATUSES}
+    for record in records:
+        counts[record["status"]] += 1
+    report = {
+        "schema": SCHEMA_VERSION,
+        "jobs": jobs,
+        "changed_only": changed_only,
+        "only": sorted(only) if only else [],
+        "stages": records,
+        "counts": counts,
+        "total_s": total,
+        "ok": counts["failed"] == 0,
+    }
+    return report
+
+
+# --- pvraft_gate/v1 validation ---------------------------------------------
+
+def validate_gate_report(doc: dict) -> List[str]:
+    """Structural problems of a gate report ([] = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["report is not an object"]
+    for key in ("schema", "jobs", "changed_only", "stages", "counts",
+                "total_s", "ok"):
+        if key not in doc:
+            problems.append(f"missing key {key!r}")
+    if problems:
+        return problems
+    if doc["schema"] != SCHEMA_VERSION:
+        problems.append(f"schema {doc['schema']!r} != {SCHEMA_VERSION!r}")
+    names = []
+    max_duration = 0.0
+    counts = {status: 0 for status in _STATUSES}
+    for record in doc["stages"]:
+        name = record.get("name")
+        names.append(name)
+        status = record.get("status")
+        if status not in _STATUSES:
+            problems.append(f"stage {name!r}: invalid status {status!r}")
+            continue
+        counts[status] += 1
+        dur = record.get("duration_s")
+        if not isinstance(dur, (int, float)) or dur < 0:
+            problems.append(f"stage {name!r}: bad duration_s {dur!r}")
+        else:
+            max_duration = max(max_duration, float(dur))
+        deps = record.get("deps")
+        if not isinstance(deps, list):
+            problems.append(f"stage {name!r}: deps must be a list")
+    if len(set(names)) != len(names):
+        problems.append("duplicate stage names in report")
+    if doc["counts"] != counts:
+        problems.append(
+            f"counts {doc['counts']!r} do not recompute from the stage "
+            f"rows ({counts!r})"
+        )
+    if doc["ok"] != (counts["failed"] == 0):
+        problems.append("ok flag disagrees with the failure count")
+    total = doc["total_s"]
+    if not isinstance(total, (int, float)) or total < 0:
+        problems.append(f"bad total_s {total!r}")
+    elif total + 0.5 < max_duration:
+        problems.append(
+            f"total_s {total} is less than the longest stage "
+            f"({max_duration}) — wall clock cannot beat its parts"
+        )
+    return problems
+
+
+def check_report_file(
+    path: str, stages: Sequence[GateStage] = GATE_STAGES
+) -> List[str]:
+    """Committed-report discipline on top of the structural validation.
+
+    A committed snapshot must be a FULL, green run the shipped runner
+    actually produced: not --changed-only, no stage selection, every
+    stage ok or cached, the stage set identical to the current registry
+    (a report from a different stage era may not back today's claims),
+    and every ok/cached record carrying the provenance the runner
+    always writes — ``input_hash`` and a positive ``n_inputs`` (every
+    registry stage hashes real input files before any cache decision),
+    with a positive overall ``total_s`` (even a fully cached run spends
+    wall clock hashing its inputs). A synthesized report that skips the
+    work fails here instead of backing a timing claim.
+    """
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return [f"unreadable report ({exc})"]
+    problems = validate_gate_report(doc)
+    if problems:
+        return problems
+    if doc["changed_only"]:
+        problems.append("committed report is a --changed-only run")
+    if doc.get("only"):
+        problems.append("committed report ran a stage selection, not the gate")
+    if not (isinstance(doc["total_s"], (int, float)) and doc["total_s"] > 0):
+        problems.append(
+            f"committed report has total_s {doc['total_s']!r} — a real run "
+            f"spends wall clock even when every stage is cached"
+        )
+    for record in doc["stages"]:
+        if record["status"] not in ("ok", "cached"):
+            problems.append(
+                f"stage {record['name']!r} is {record['status']!r} "
+                f"(committed reports must be green)"
+            )
+            continue
+        n_inputs = record.get("n_inputs")
+        if not (isinstance(n_inputs, int) and not isinstance(n_inputs, bool)
+                and n_inputs > 0):
+            problems.append(
+                f"stage {record['name']!r}: n_inputs {n_inputs!r} — the "
+                f"runner records the expanded input count for every "
+                f"ok/cached stage, and no registry stage has zero inputs"
+            )
+        input_hash = record.get("input_hash")
+        if not (isinstance(input_hash, str)
+                and re.fullmatch(r"[0-9a-f]{16,64}", input_hash)):
+            problems.append(
+                f"stage {record['name']!r}: missing or malformed "
+                f"input_hash {input_hash!r} — the runner hashes a stage's "
+                f"inputs before any cache decision"
+            )
+    report_set = {record["name"] for record in doc["stages"]}
+    registry_set = {s.name for s in stages}
+    for name in sorted(registry_set - report_set):
+        problems.append(f"registry stage {name!r} missing from the report")
+    for name in sorted(report_set - registry_set):
+        problems.append(f"report stage {name!r} is not in the registry")
+    return problems

@@ -1,0 +1,467 @@
+"""The gate stage registry: lint.sh's bash stage list as declared data.
+
+Each :class:`GateStage` row carries the stage's shell command, the input
+globs its result is a pure function of (the content-hash cache key), its
+dependencies, and its environment pins. ``scripts/lint.sh`` is now a
+thin shim over ``python -m pvraft_tpu.analysis gate``; both it and
+``.github/workflows/ci.yml`` carry a ``# gate-stage: <name>`` manifest
+line per stage, and GE005 pins manifest == registry in both directions
+so bash, CI and this table cannot drift.
+
+Input globs err wide on purpose: a stage that re-runs unnecessarily
+costs minutes once; a stage that stays cached across a real change
+costs the gate its meaning. Stages whose commands import the model
+stack therefore hash the whole package.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Tuple
+
+# Shared glob vocabularies. PKG covers every Python file in the package
+# (glob's ``**`` includes the empty path, so top-level modules match).
+PKG = ("pvraft_tpu/**/*.py",)
+ANALYSIS_CORE = (
+    "pvraft_tpu/analysis/engine.py",
+    "pvraft_tpu/analysis/__main__.py",
+)
+LINT_SCOPE = PKG + ("tests/**/*.py", "scripts/*.py")
+
+# Environment pin vocabularies (merged over os.environ by the runner).
+CPU = (("JAX_PLATFORMS", "cpu"),)
+
+
+@dataclasses.dataclass(frozen=True)
+class GateStage:
+    """One declared gate stage.
+
+    ``command`` runs under ``bash -c`` from the repo root. ``inputs``
+    are repo-relative globs (``**`` recursive); the stage is cached iff
+    every matched file's content hash is unchanged since the last green
+    run of the same command+env. ``deps`` name stages that must finish
+    ok first (e.g. the warm ``artifacts/xla_cache`` handoff).
+    ``virtual_devices`` > 0 appends
+    ``--xla_force_host_platform_device_count=N`` to XLA_FLAGS — the
+    lint.sh ``_audit_flags`` idiom (a real 2-shard seq axis so deepcheck
+    walks contain the ring ppermutes, not a degenerate p=1 loop).
+    ``doc`` preserves the old lint.sh stage comment.
+    """
+
+    name: str
+    command: str
+    inputs: Tuple[str, ...]
+    deps: Tuple[str, ...] = ()
+    env: Tuple[Tuple[str, str], ...] = ()
+    virtual_devices: int = 0
+    doc: str = ""
+
+
+GATE_STAGES: Tuple[GateStage, ...] = (
+    GateStage(
+        name="graftlint",
+        command="python -m pvraft_tpu.analysis lint pvraft_tpu/ tests/ scripts/",
+        inputs=LINT_SCOPE,
+        doc="AST rules over pvraft_tpu/ + tests/ + scripts/. Same scope as "
+            "the --stats pass: what the debt report counts as a blind spot "
+            "must be a file the rules actually run on.",
+    ),
+    GateStage(
+        name="lint-stats",
+        command="python -m pvraft_tpu.analysis lint --stats pvraft_tpu/ tests/ scripts/",
+        inputs=LINT_SCOPE,
+        doc="The gate's blind spots, enumerated: per-rule counts of active "
+            "`graftlint: disable` pragmas (one shared grammar across the "
+            "engines); any suppression without a `-- reason` exits non-zero.",
+    ),
+    GateStage(
+        name="gatecheck",
+        command="python -m pvraft_tpu.analysis gate --rules",
+        inputs=(
+            "README.md",
+            "BENCHMARKS.md",
+            "ROADMAP.md",
+            "artifacts/README.md",
+            "artifacts/**",
+            "scripts/lint.sh",
+            ".github/workflows/ci.yml",
+            "pvraft_tpu/analysis/gate/*.py",
+        ) + ANALYSIS_CORE + ("scripts/*.py",),
+        doc="The seventh engine checking the evidence discipline itself: "
+            "dangling citations/unindexed artifacts (GE001), artifacts no "
+            "validator covers (GE002), stale <!-- claim: --> numbers "
+            "(GE003), schema-exactly-once (GE004), stage-set identity "
+            "across registry/lint.sh/ci.yml (GE005).",
+    ),
+    GateStage(
+        name="threadcheck",
+        command="python -m pvraft_tpu.analysis concurrency",
+        inputs=(
+            "pvraft_tpu/serve/**/*.py",
+            "pvraft_tpu/obs/**/*.py",
+            "pvraft_tpu/data/*.py",
+            "pvraft_tpu/analysis/concurrency/*.py",
+        ) + ANALYSIS_CORE,
+        doc="Concurrency static analysis (GC rules) over serve/obs/loader: "
+            "guarded-by discipline, lock-order cycles, check-then-act "
+            "shapes, un-joined non-daemon threads. Pure stdlib AST, no jax. "
+            "The dynamic half is opt-in at test time (PVRAFT_CHECKS=1 turns "
+            "the serve/obs locks into OrderedLocks).",
+    ),
+    GateStage(
+        name="kernelcheck",
+        command="python -m pvraft_tpu.analysis kernels",
+        inputs=(
+            "pvraft_tpu/ops/**/*.py",
+            "pvraft_tpu/programs/*.py",
+            "pvraft_tpu/analysis/kernels/*.py",
+        ) + ANALYSIS_CORE,
+        doc="Pallas/Mosaic static analysis (GK rules) over ops/pallas: tile "
+            "alignment vs the (sublane, lane) layout, static double-buffered "
+            "VMEM budget, grid x block coverage, the Mosaic lowering hazard "
+            "table, kernel-tag registry coverage, interpret_mode(). Pure "
+            "stdlib AST, no jax; layout notes print but never fail.",
+    ),
+    GateStage(
+        name="kernel-plan",
+        command="python -m pvraft_tpu.analysis kernels --check artifacts/kernel_plan.json",
+        inputs=(
+            "pvraft_tpu/ops/**/*.py",
+            "pvraft_tpu/programs/*.py",
+            "pvraft_tpu/analysis/kernels/*.py",
+            "artifacts/kernel_plan.json",
+            "artifacts/programs_costs.json",
+        ) + ANALYSIS_CORE,
+        doc="artifacts/kernel_plan.json is a pure function of the static "
+            "kernel models + the committed cost inventory: regenerate and "
+            "compare, enforcing the static-vs-Mosaic HBM cross-validation "
+            "(pinned factor 2.0) that keeps the fused-GRU residency verdict "
+            "honest.",
+    ),
+    GateStage(
+        name="shardcheck",
+        command="python -m pvraft_tpu.analysis sharding",
+        inputs=PKG + ("artifacts/params_tree.json",),
+        doc="SPMD/multi-host static analysis (GS rules) over the "
+            "multi-process planes: partition-rule exactly-once coverage vs "
+            "the committed param-tree inventory, mesh-axis discipline, the "
+            "eager-stack idiom, unguarded process-0 I/O, batch-contract "
+            "arithmetic. Pure stdlib AST + the jax-free data planes.",
+    ),
+    GateStage(
+        name="pod-plan",
+        command="python -m pvraft_tpu.analysis sharding --check artifacts/pod_plan.json",
+        inputs=PKG + (
+            "artifacts/pod_plan.json",
+            "artifacts/params_tree.json",
+            "artifacts/programs_costs.json",
+        ),
+        doc="artifacts/pod_plan.json is a pure function of PARTITION_RULES x "
+            "params_tree.json x programs_costs.json x the candidate meshes: "
+            "regenerate and compare, enforcing the sharded-step honesty "
+            "cross-check vs the compiled dp_sp_2x2_train_step live bytes.",
+    ),
+    GateStage(
+        name="detcheck",
+        command="python -m pvraft_tpu.analysis determinism",
+        inputs=PKG,
+        doc="Determinism/seed-discipline static analysis (GD rules) over the "
+            "whole package: PRNG key reuse, entropy outside the rng stream "
+            "contract, nondeterminism-hazard ops without a declared stance, "
+            "backend flags outside compat.py, iteration-order hazards.",
+    ),
+    GateStage(
+        name="determinism-replay",
+        command="python -m pvraft_tpu.analysis determinism --check artifacts/determinism_report.json",
+        inputs=PKG + ("artifacts/determinism_report.json",),
+        env=CPU,
+        doc="The dynamic half of detcheck: rebuild the registered train step "
+            "and serve dispatch twice from the config seed and diff every "
+            "output leaf bitwise, HERE and now; raw digests additionally "
+            "pinned when the committed platform matches.",
+    ),
+    GateStage(
+        name="kernels-evidence",
+        command="python -m pvraft_tpu.programs compile --check artifacts/programs_kernels.json",
+        inputs=(
+            "pvraft_tpu/programs/*.py",
+            "pvraft_tpu/ops/**/*.py",
+            "artifacts/programs_kernels.json",
+        ),
+        doc="artifacts/programs_kernels.json must name exactly the "
+            "kernel-tagged registry specs, each with a successful Mosaic "
+            "compile record — both directions. Pure validation, no "
+            "toolchain, no compiles.",
+    ),
+    GateStage(
+        name="programs-verify",
+        command="python -m pvraft_tpu.programs verify",
+        inputs=PKG,
+        env=CPU,
+        virtual_devices=8,
+        doc="Registry-wide eval_shape verify (zero-FLOP abstract traces): "
+            "every ProgramSpec — audit entries, the AOT catalog, the "
+            "profiler ladder. CPU pin: shape propagation needs no "
+            "accelerator and must not grab one.",
+    ),
+    GateStage(
+        name="params-tree",
+        command="python -m pvraft_tpu.programs params --check artifacts/params_tree.json",
+        inputs=PKG + ("artifacts/params_tree.json",),
+        env=CPU,
+        virtual_devices=8,
+        doc="artifacts/params_tree.json is the jax-free cache of the "
+            "flagship param tree the GS001 gate and the pod planner join "
+            "against; one eval_shape regenerates and compares.",
+    ),
+    GateStage(
+        name="deepcheck",
+        command="python -m pvraft_tpu.analysis deepcheck",
+        inputs=PKG,
+        env=CPU,
+        virtual_devices=8,
+        doc="jaxpr-level semantic analysis (GJ rules) over the audit corpus: "
+            "collective consistency, donation efficacy, precision flow, "
+            "retrace hazards. Tracing only — zero FLOPs, CPU-safe. The 8 "
+            "virtual devices give the ring audit entries a REAL 2-shard seq "
+            "axis, so the walks contain the ring ppermutes.",
+    ),
+    GateStage(
+        name="kernel-compile",
+        command="python -m pvraft_tpu.programs compile --tag kernel --allow-missing-toolchain",
+        inputs=PKG,
+        env=CPU,
+        doc="Deviceless Mosaic compile of every Pallas kernel entry point "
+            "through the REAL XLA:TPU pipeline against the declared v5e "
+            "topology — toolchain drift fails here, not silently at HEAD. "
+            "--allow-missing-toolchain: hosts with no libtpu skip LOUDLY.",
+    ),
+    GateStage(
+        name="costs-smoke",
+        command="python -m pvraft_tpu.programs costs --tag kernel --allow-missing-toolchain",
+        inputs=PKG,
+        deps=("kernel-compile",),
+        env=CPU,
+        doc="pvraft_costs/v1 smoke over the Pallas kernel specs (same "
+            "deviceless Mosaic topology; depends on kernel-compile so the "
+            "shared artifacts/xla_cache is warm) — a cost_analysis()/"
+            "memory_analysis() API drift fails HERE, not at the next full "
+            "regeneration. Same loud-skip semantics without libtpu.",
+    ),
+    GateStage(
+        name="costs-check",
+        command="python -m pvraft_tpu.programs costs --check artifacts/programs_costs.json",
+        inputs=PKG + ("artifacts/programs_costs.json",),
+        env=CPU,
+        virtual_devices=8,
+        doc="artifacts/programs_costs.json must be schema-valid AND cover "
+            "every non-expect_failure ProgramSpec, both directions. Pure "
+            "validation — no toolchain, no compiles.",
+    ),
+    GateStage(
+        name="validate-bench",
+        command=(
+            'bench_artifacts=$(ls artifacts/bench_*.json 2>/dev/null || true); '
+            'if [ -n "$bench_artifacts" ]; then '
+            "python -m pvraft_tpu.obs validate-bench $bench_artifacts && "
+            "python scripts/bench_compare.py artifacts/bench_baseline.json "
+            "artifacts/bench_baseline.json; "
+            'else echo "(no committed bench artifacts)"; fi'
+        ),
+        inputs=(
+            "pvraft_tpu/obs/**/*.py",
+            "scripts/bench_compare.py",
+            "artifacts/bench_*.json",
+        ),
+        doc="pvraft_bench/v1: the committed baseline must parse against the "
+            "schema (platform/comparable first-class — a CPU fallback can "
+            "never masquerade as a TPU number), and bench_compare must "
+            "accept a self-comparison (schema -> comparability -> noise "
+            "band -> exit code, end to end).",
+    ),
+    GateStage(
+        name="validate-capacity",
+        command=(
+            "python -m pvraft_tpu.obs validate-capacity artifacts/capacity_report.json && "
+            "python scripts/capacity_report.py --check artifacts/capacity_report.json"
+        ),
+        inputs=(
+            "pvraft_tpu/obs/**/*.py",
+            "pvraft_tpu/serve/**/*.py",
+            "scripts/capacity_report.py",
+            "artifacts/capacity_report.json",
+            "artifacts/programs_costs.json",
+            "artifacts/serve_cpu_synthetic.json",
+            "artifacts/serve_cpu_synthetic.slo.json",
+        ),
+        env=CPU,
+        doc="pvraft_capacity/v1: schema-validate (chips-needed recomputed, "
+            "not trusted), then regenerate from the artifact's OWN recorded "
+            "inputs and compare — a hand-edited chips number, or drift "
+            "between planner code and committed plan, fails here.",
+    ),
+    GateStage(
+        name="validate-calibration",
+        command="python -m pvraft_tpu.obs validate-calibration artifacts/serve_calibration.json",
+        inputs=(
+            "pvraft_tpu/obs/**/*.py",
+            "artifacts/serve_calibration.json",
+        ),
+        env=CPU,
+        doc="pvraft_cost_calibration/v1: predicted-vs-measured ledger from a "
+            "real loadgen run with the cost surface armed — the identity "
+            "must have held at every polled snapshot, ratios recompute, and "
+            "comparable=true off-TPU is a schema violation.",
+    ),
+    GateStage(
+        name="artifact-budget",
+        command="python scripts/artifact_budget.py",
+        inputs=("scripts/artifact_budget.py", "artifacts/**"),
+        doc="Per-glob byte caps over committed evidence.",
+    ),
+    GateStage(
+        name="validate-events",
+        command=(
+            'event_logs=$(ls artifacts/*.events.jsonl tests/fixtures/*.events.jsonl 2>/dev/null || true); '
+            'if [ -n "$event_logs" ]; then '
+            "python -m pvraft_tpu.obs validate $event_logs; "
+            'else echo "(no committed event logs)"; fi'
+        ),
+        inputs=(
+            "pvraft_tpu/obs/**/*.py",
+            "artifacts/*.events.jsonl",
+            "tests/fixtures/*.events.jsonl",
+        ),
+        doc="pvraft_events/v1: any event log shipped as evidence plus the "
+            "golden test fixture must parse against the schema — a drifted "
+            "writer fails the gate before a TPU run produces unreadable "
+            "telemetry.",
+    ),
+    GateStage(
+        name="validate-load",
+        command=(
+            "serve_artifacts=$(ls artifacts/serve_*.json 2>/dev/null "
+            "| grep -v -e '\\.trace\\.json$' -e '\\.slo\\.json$' "
+            "-e 'serve_calibration\\.json$' || true); "
+            'if [ -n "$serve_artifacts" ]; then '
+            "python -m pvraft_tpu.serve validate-load $serve_artifacts; "
+            'else echo "(no committed serve artifacts)"; fi'
+        ),
+        inputs=(
+            "pvraft_tpu/serve/**/*.py",
+            "artifacts/serve_*.json",
+        ),
+        doc="pvraft_serve_load/v1: the serve latency/throughput evidence "
+            "must parse against its schema. The trace/SLO siblings and the "
+            "calibration evidence have their own validators in other "
+            "stages — excluded here (the VALIDATORS first-match order).",
+    ),
+    GateStage(
+        name="validate-trace",
+        command=(
+            'trace_artifacts=$(ls artifacts/*.trace.json 2>/dev/null || true); '
+            'if [ -n "$trace_artifacts" ]; then '
+            "python -m pvraft_tpu.obs validate-trace $trace_artifacts; "
+            'else echo "(no committed trace artifacts)"; fi'
+        ),
+        inputs=(
+            "pvraft_tpu/obs/**/*.py",
+            "artifacts/*.trace.json",
+        ),
+        doc="pvraft_trace/v1: span trees grouped per trace; the validator "
+            "recomputes completeness and orphan counts from the spans, so a "
+            "hand-edited 'complete' flag cannot pass.",
+    ),
+    GateStage(
+        name="validate-slo",
+        command=(
+            'slo_artifacts=$(ls artifacts/*.slo.json 2>/dev/null || true); '
+            'if [ -n "$slo_artifacts" ]; then '
+            "python -m pvraft_tpu.obs validate-slo $slo_artifacts; "
+            'else echo "(no committed SLO reports)"; fi'
+        ),
+        inputs=(
+            "pvraft_tpu/obs/**/*.py",
+            "artifacts/*.slo.json",
+        ),
+        doc="pvraft_slo/v1: loadgen client latencies joined to span trees by "
+            "trace id, with the stage-p99-sum/e2e-p99 honesty ratio checked "
+            "at the report's declared band.",
+    ),
+    GateStage(
+        name="validate-profile",
+        command="python -m pvraft_tpu.profiling validate artifacts/step_profile.json",
+        inputs=(
+            "pvraft_tpu/profiling/*.py",
+            "artifacts/step_profile.json",
+        ),
+        doc="pvraft_step_profile/v1: the committed per-stage train-step "
+            "breakdown must telescope to the measured total (host-fetch "
+            "synced). Previously only pinned by tests; now a gate stage so "
+            "the artifact is validator-covered (GE002).",
+    ),
+    GateStage(
+        name="validate-gate-report",
+        command="python -m pvraft_tpu.analysis gate --check artifacts/gate_cold.json artifacts/gate_warm.json",
+        inputs=(
+            "pvraft_tpu/analysis/gate/*.py",
+            "artifacts/gate_cold.json",
+            "artifacts/gate_warm.json",
+        ),
+        doc="pvraft_gate/v1: the committed cold/warm gate reports BENCHMARKS "
+            "cites must validate — full (not --changed-only) runs, every "
+            "stage ok or cached, stage set identical to this registry. "
+            "Timings are wall-clock records, never regenerate-compared.",
+    ),
+)
+
+
+def stage_names() -> List[str]:
+    return [s.name for s in GATE_STAGES]
+
+
+def stage_problems(stages: Tuple[GateStage, ...] = GATE_STAGES) -> List[str]:
+    """Structural problems of a stage registry ([] = well-formed).
+
+    Exactly-once names, deps resolve, no dependency cycles.
+    """
+    problems: List[str] = []
+    seen = set()
+    for s in stages:
+        if s.name in seen:
+            problems.append(f"stage {s.name!r} declared more than once")
+        seen.add(s.name)
+    names = {s.name for s in stages}
+    for s in stages:
+        for dep in s.deps:
+            if dep not in names:
+                problems.append(f"stage {s.name!r} depends on unknown stage {dep!r}")
+            if dep == s.name:
+                problems.append(f"stage {s.name!r} depends on itself")
+    # Cycle check: repeatedly strip stages whose deps are all stripped.
+    remaining = {s.name: set(d for d in s.deps if d in names) for s in stages}
+    while True:
+        free = [n for n, deps in remaining.items() if not deps]
+        if not free:
+            break
+        for n in free:
+            del remaining[n]
+        for deps in remaining.values():
+            deps.difference_update(free)
+    for n in sorted(remaining):
+        problems.append(f"stage {n!r} is part of a dependency cycle")
+    return problems
+
+
+_MANIFEST_RE = re.compile(r"#\s*gate-stage:\s*(?P<name>[A-Za-z0-9_-]+)")
+
+
+def parse_manifest(text: str) -> List[Tuple[int, str]]:
+    """``# gate-stage: <name>`` lines of a shim/CI file -> [(line, name)]."""
+    out: List[Tuple[int, str]] = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _MANIFEST_RE.search(line)
+        if m:
+            out.append((i, m.group("name")))
+    return out

@@ -1,0 +1,47 @@
+"""CLI for the profiling plane: validate committed step-profile evidence.
+
+``python -m pvraft_tpu.profiling validate artifacts/step_profile.json``
+schema-validates a ``pvraft_step_profile/v1`` record with
+:func:`validate_step_profile` — the same check ``tests/test_profiling.py``
+applies, exposed as a command so the gate runner's ``validate-profile``
+stage covers the artifact (GE002) without importing test code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from pvraft_tpu.profiling.step_profiler import validate_step_profile
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m pvraft_tpu.profiling")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    val = sub.add_parser("validate", help="validate step-profile artifacts")
+    val.add_argument("paths", nargs="+")
+    args = parser.parse_args(argv)
+
+    rc = 0
+    for path in args.paths:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                record = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"{path}: unreadable ({exc})")
+            rc = 1
+            continue
+        problems = validate_step_profile(record)
+        if problems:
+            rc = 1
+            for p in problems:
+                print(f"{path}: {p}")
+        else:
+            print(f"{path}: OK ({record.get('platform')}, "
+                  f"total_step_s={record.get('total_step_s')})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

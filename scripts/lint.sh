@@ -1,258 +1,52 @@
 #!/usr/bin/env bash
-# Static-analysis gate: graftlint AST rules, threadcheck, kernelcheck,
-# shardcheck, the registry verify/deepcheck/Mosaic-compile legs and the
-# committed-artifact validators. Runs before training jobs (run.sh) and as the
-# standing gate for kernel/sharding PRs (ROADMAP.md). Exits non-zero on
-# any finding.
+# Static-analysis gate — a thin shim over the declared gate runner.
+#
+# The stage list that used to live here as ~260 lines of sequential bash
+# is now DECLARED DATA: `GateStage` rows in
+# pvraft_tpu/analysis/gate/stages.py (name, command, input globs,
+# dependencies, env pins), executed by `python -m pvraft_tpu.analysis
+# gate` with a dependency-aware parallel scheduler, content-hash caching
+# over each stage's input files (unchanged -> recorded as cached),
+# `--changed-only` for the local dev loop, per-stage timing and a
+# validated pvraft_gate/v1 report. Each old stage's explanatory comment
+# rides along as the row's `doc` field.
+#
+# The manifest below names every declared stage. gatecheck rule GE005
+# pins it against the registry BOTH WAYS (and does the same for
+# .github/workflows/ci.yml), so bash, CI and the declared data cannot
+# drift apart. Adding a gate stage means: add the GateStage row, then
+# add its line here and in ci.yml — forgetting either fails the gate.
+#
+#   # gate-stage: graftlint
+#   # gate-stage: lint-stats
+#   # gate-stage: gatecheck
+#   # gate-stage: threadcheck
+#   # gate-stage: kernelcheck
+#   # gate-stage: kernel-plan
+#   # gate-stage: shardcheck
+#   # gate-stage: pod-plan
+#   # gate-stage: detcheck
+#   # gate-stage: determinism-replay
+#   # gate-stage: kernels-evidence
+#   # gate-stage: programs-verify
+#   # gate-stage: params-tree
+#   # gate-stage: deepcheck
+#   # gate-stage: kernel-compile
+#   # gate-stage: costs-smoke
+#   # gate-stage: costs-check
+#   # gate-stage: validate-bench
+#   # gate-stage: validate-capacity
+#   # gate-stage: validate-calibration
+#   # gate-stage: artifact-budget
+#   # gate-stage: validate-events
+#   # gate-stage: validate-load
+#   # gate-stage: validate-trace
+#   # gate-stage: validate-slo
+#   # gate-stage: validate-profile
+#   # gate-stage: validate-gate-report
+#
+# Runs before training jobs (run.sh) and as the standing gate for
+# kernel/sharding PRs (ROADMAP.md). Exits non-zero on any finding.
 set -e
 cd "$(dirname "$0")/.."
-
-echo "== graftlint: AST rules over pvraft_tpu/ + tests/ + scripts/"
-# Same scope as the --stats pass below: what the debt report counts as a
-# blind spot must be a file the rules actually run on.
-python -m pvraft_tpu.analysis lint pvraft_tpu/ tests/ scripts/
-
-echo "== graftlint: suppression-debt report (reason-less pragmas fail)"
-# The gate's blind spots, enumerated: per-rule counts of active
-# `graftlint: disable` pragmas (GL + GJ + GC — one shared grammar); any
-# suppression without a `-- reason` exits non-zero.
-python -m pvraft_tpu.analysis lint --stats pvraft_tpu/ tests/ scripts/
-
-echo "== threadcheck: concurrency static analysis (GC rules) over serve/obs/loader"
-# The third analysis engine (ISSUE 11): guarded-by discipline (GC001),
-# lock-order cycles (GC002), check-then-act/TOCTOU shapes (GC003) and
-# un-joined non-daemon threads (GC004) over the hand-threaded planes.
-# Zero findings on the clean tree — real violations get fixed (the
-# deepcheck precedent), not pragma'd. Pure stdlib AST, no jax import.
-# The dynamic half is opt-in at test time: PVRAFT_CHECKS=1 turns the
-# serve/obs locks into OrderedLocks, so the threaded tier-1 tests
-# double as a runtime lock-order sanitizer run.
-python -m pvraft_tpu.analysis concurrency
-
-echo "== kernelcheck: Pallas/Mosaic static analysis (GK rules) over ops/pallas"
-# The fourth analysis engine (ISSUE 12): tile alignment vs the TPU
-# (sublane, lane) layout (GK001), static double-buffered VMEM budget
-# (GK002), grid x block coverage (GK003), the Mosaic lowering hazard
-# table — integer min/max reductions, the PR-5 regression class; 1D
-# iota; f64 casts — (GK004), kernel-tag registry coverage (GK005), and
-# the interpret_mode() escape hatch the CPU tier relies on (GK006).
-# Zero findings on the clean tree — real violations get fixed (the
-# deepcheck/threadcheck precedent), not pragma'd. Pure stdlib AST, no
-# jax import; layout notes (whole-axis small blocks) print but never
-# fail.
-python -m pvraft_tpu.analysis kernels
-
-echo "== kernelcheck: committed VMEM/roofline plan matches the static model"
-# artifacts/kernel_plan.json (pvraft_kernel_plan/v1) is a pure function
-# of the static kernel models + the committed cost inventory: this
-# regenerates it and compares, enforcing on the way that
-# every kernel-tag spec's static HBM estimate agrees with the real
-# deviceless Mosaic memory_analysis within the pinned factor (2.0) —
-# the cross-validation that keeps the fused-GRU residency verdict
-# honest before the kernel is written (ROADMAP item 1).
-python -m pvraft_tpu.analysis kernels --check artifacts/kernel_plan.json
-
-echo "== shardcheck: SPMD/multi-host static analysis (GS rules) over the multi-process planes"
-# The fifth analysis engine (ISSUE 15): partition-rule exactly-once
-# coverage vs the committed param-tree inventory (GS001), mesh-axis
-# discipline at PartitionSpec/collective sites incl. the compat.py
-# routing of fragile in-jit spellings (GS002), the eager-stack-of-
-# sharded-batches idiom behind the multi-process guards (GS003),
-# unguarded process-0 I/O in engine/+obs/ (GS004), and batch-contract
-# arithmetic outside parallel/mesh.py (GS005). Zero findings on the
-# clean tree — real violations get fixed (the deepcheck precedent),
-# not pragma'd. Pure stdlib AST + the jax-free data planes; no jax.
-python -m pvraft_tpu.analysis sharding
-
-echo "== shardcheck: committed pod memory/comms plan matches the declared inputs"
-# artifacts/pod_plan.json (pvraft_pod_plan/v1) is a pure function of
-# PARTITION_RULES x artifacts/params_tree.json x programs_costs.json x
-# the candidate (dp, sp) meshes: this regenerates and compares,
-# enforcing on the way that the byte model's estimate for the REAL
-# compiled dp_sp_2x2_train_step stays inside the pinned band of its
-# live_bytes_estimate — the committed answer to "which mesh does a
-# 100k-point scene train on" that ROADMAP item 2 cites.
-python -m pvraft_tpu.analysis sharding --check artifacts/pod_plan.json
-
-echo "== detcheck: determinism/seed-discipline static analysis (GD rules) over the whole package"
-# The sixth analysis engine (ISSUE 16): jax PRNG key reuse /
-# consumed-without-split dataflow (GD001), entropy minted outside the
-# pvraft_tpu.rng stream contract — host RNG constructors, raw
-# jax.random.key, time-derived seeds, undeclared stream names —
-# (GD002), nondeterminism-hazard ops (unordered scatter-adds, segment
-# reductions, ring-fold accumulation) reachable from a registered
-# program that declares no determinism= stance (GD003), backend
-# determinism flags written outside compat.py (GD004), and
-# iteration-order hazards — set iteration feeding trace order,
-# unsorted filesystem listings feeding data/checkpoint selection —
-# (GD005). Zero findings on the clean tree — real violations get fixed
-# (the deepcheck precedent), not pragma'd. Pure stdlib AST + the
-# jax-free registry inspection; no jax.
-python -m pvraft_tpu.analysis determinism
-
-echo "== detcheck: committed bitwise-replay report matches a fresh replay"
-# artifacts/determinism_report.json (pvraft_determinism/v1) is the
-# dynamic half of the gate: the registered train step and serve
-# dispatch are rebuilt twice from the config seed and every output
-# leaf diffed bitwise. The check replays HERE and now — a program that
-# stops replaying bitwise on this host fails regardless of what the
-# committed report says; raw digests are additionally pinned when the
-# committed platform matches (CPU CI cannot check TPU hashes).
-JAX_PLATFORMS=cpu \
-  python -m pvraft_tpu.analysis determinism --check artifacts/determinism_report.json
-
-echo "== programs: committed kernel-compile evidence covers the kernel tag"
-# artifacts/programs_kernels.json must name exactly the kernel-tagged
-# registry specs, each with a successful Mosaic compile record — both
-# directions (the programs_list.txt / programs_costs.json drift
-# discipline; until now this evidence could go stale silently). Pure
-# validation — no toolchain, no compiles.
-python -m pvraft_tpu.programs compile --check artifacts/programs_kernels.json
-
-# 8 virtual CPU devices (appended to any caller-set XLA_FLAGS) so the
-# ring audit entries trace with a REAL 2-shard seq axis — the programs
-# deepcheck walks then contain the ring ppermutes, not a degenerate p=1
-# loop with no collectives at all.
-_audit_flags="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
-
-echo "== programs: registry-wide eval_shape verify (zero-FLOP abstract traces)"
-# Supersedes the old `analysis trace` stage: the audit corpus is the
-# "audit"-tagged slice of the program registry, and `programs verify`
-# traces EVERY ProgramSpec — audit entries plus the AOT catalog
-# (flagship/serve/kernel geometries) and the profiler ladder.
-# CPU pin: shape propagation needs no accelerator and must not grab one.
-JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
-  python -m pvraft_tpu.programs verify
-
-echo "== programs: committed param-tree inventory matches the registry's eval_shape tree"
-# artifacts/params_tree.json (pvraft_params_tree/v1) is the jax-free
-# cache of the flagship param tree the GS001 gate and the pod planner
-# join against; one eval_shape regenerates it here and compares (the
-# programs_list.txt discipline — a model change that moves a leaf
-# regenerates a different inventory, and the stale committed plan
-# fails the shardcheck compare stage above instead of rotting green).
-JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
-  python -m pvraft_tpu.programs params --check artifacts/params_tree.json
-
-echo "== deepcheck: jaxpr-level semantic analysis (GJ rules) over the audit corpus"
-# Traces every registered audit entry to a ClosedJaxpr and checks
-# collective consistency, donation efficacy, precision flow and retrace
-# hazards. Tracing only — zero FLOPs, CPU-safe.
-JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
-  python -m pvraft_tpu.analysis deepcheck
-
-echo "== programs: deviceless Mosaic compile of every Pallas kernel entry point"
-# The kernel-compile gate (ROADMAP item 1): lowers the `kernel`-tagged
-# registry programs (both Pallas kernels, fwd + VJP, flagship geometry)
-# through the REAL XLA:TPU + Mosaic pipeline against the declared v5e
-# topology — toolchain drift broke the fused-lookup kernel silently at
-# HEAD once (integer-iota argmin, fixed in PR 5); now it fails here.
-# --allow-missing-toolchain: on hosts with no libtpu (some CI runners)
-# the stage skips LOUDLY instead of failing on a missing compiler.
-JAX_PLATFORMS=cpu \
-  python -m pvraft_tpu.programs compile --tag kernel --allow-missing-toolchain
-
-echo "== programs: pvraft_costs/v1 smoke (cost/HBM analysis of the kernel tag)"
-# The cost-inventory machinery runs end-to-end over the Pallas kernel
-# specs (same deviceless Mosaic topology as the compile gate above; the
-# shared artifacts/xla_cache makes the second pass cheap) — so a
-# cost_analysis()/memory_analysis() API drift fails HERE, not at the
-# next full regeneration. Same loud-skip semantics as the kernel leg
-# when the runner has no libtpu.
-JAX_PLATFORMS=cpu \
-  python -m pvraft_tpu.programs costs --tag kernel --allow-missing-toolchain
-
-echo "== programs: committed cost inventory validates + covers the registry"
-# artifacts/programs_costs.json must be schema-valid AND cover every
-# non-expect_failure ProgramSpec, both directions (the programs_list
-# drift discipline). Pure validation — no toolchain, no compiles.
-JAX_PLATFORMS=cpu XLA_FLAGS="$_audit_flags" \
-  python -m pvraft_tpu.programs costs --check artifacts/programs_costs.json
-
-echo "== pvraft_bench/v1: committed bench artifacts validate + the gate wires"
-# The bench baseline must parse against the schema (platform/comparable
-# first-class — a CPU fallback can never masquerade as a TPU number),
-# and bench_compare must accept a self-comparison (end-to-end wiring:
-# schema -> comparability checks -> noise band -> exit code).
-bench_artifacts=$(ls artifacts/bench_*.json 2>/dev/null || true)
-if [ -n "$bench_artifacts" ]; then
-  # shellcheck disable=SC2086 -- word splitting over the file list is intended
-  python -m pvraft_tpu.obs validate-bench $bench_artifacts
-  python scripts/bench_compare.py artifacts/bench_baseline.json \
-    artifacts/bench_baseline.json
-else
-  echo "(no committed bench artifacts)"
-fi
-
-echo "== pvraft_capacity/v1: committed capacity plan validates + regenerates"
-# The capacity planner (ISSUE 14): artifacts/capacity_report.json is a
-# pure function of committed inputs (cost surface + traffic histogram +
-# SLO report) — schema-validate it, then regenerate from the artifact's
-# OWN recorded inputs and compare (the kernel_plan.json discipline; a
-# hand-edited chips-needed number, or drift between the planner code
-# and the committed plan, fails here).
-JAX_PLATFORMS=cpu python -m pvraft_tpu.obs validate-capacity \
-  artifacts/capacity_report.json
-JAX_PLATFORMS=cpu \
-  python scripts/capacity_report.py --check artifacts/capacity_report.json
-
-echo "== pvraft_cost_calibration/v1: committed calibration evidence validates"
-# The predicted-vs-measured ledger from a real loadgen run with the
-# cost surface armed (scripts/serve_calibration.py): the identity must
-# have held at every polled snapshot, ratios must recompute, and
-# comparable=true off-TPU is a schema violation (the pvraft_bench/v1
-# platform-honesty rule, enforced structurally).
-JAX_PLATFORMS=cpu python -m pvraft_tpu.obs validate-calibration \
-  artifacts/serve_calibration.json
-
-echo "== artifact size budget (per-glob byte caps over committed evidence)"
-python scripts/artifact_budget.py
-
-echo "== pvraft_events/v1: committed event logs validate"
-# Any event log shipped as evidence (artifacts/) plus the golden test
-# fixture must parse against the schema — a drifted writer fails the
-# gate here, before a TPU run produces unreadable telemetry.
-event_logs=$(ls artifacts/*.events.jsonl tests/fixtures/*.events.jsonl 2>/dev/null || true)
-if [ -n "$event_logs" ]; then
-  # shellcheck disable=SC2086 -- word splitting over the file list is intended
-  python -m pvraft_tpu.obs validate $event_logs
-else
-  echo "(no committed event logs)"
-fi
-
-echo "== pvraft_serve_load/v1: committed load-gen artifacts validate"
-# The serve latency/throughput evidence (scripts/serve_loadgen.py) must
-# parse against its schema, same discipline as the event logs. The
-# trace/SLO siblings (*.trace.json / *.slo.json) and the calibration
-# evidence (pvraft_cost_calibration/v1) have their own validators in
-# other stages — exclude them here.
-serve_artifacts=$(ls artifacts/serve_*.json 2>/dev/null \
-  | grep -v -e '\.trace\.json$' -e '\.slo\.json$' \
-            -e 'serve_calibration\.json$' || true)
-if [ -n "$serve_artifacts" ]; then
-  # shellcheck disable=SC2086 -- word splitting over the file list is intended
-  python -m pvraft_tpu.serve validate-load $serve_artifacts
-else
-  echo "(no committed serve artifacts)"
-fi
-
-echo "== pvraft_trace/v1 + pvraft_slo/v1: committed trace/SLO artifacts validate"
-# The request-tracing evidence: span trees grouped per trace
-# (serve_loadgen writes them) and the SLO report joining loadgen +
-# spans (scripts/slo_report.py). The validators recompute completeness
-# and orphan counts from the spans themselves, so a hand-edited
-# "complete" flag cannot pass.
-trace_artifacts=$(ls artifacts/*.trace.json 2>/dev/null || true)
-if [ -n "$trace_artifacts" ]; then
-  # shellcheck disable=SC2086 -- word splitting over the file list is intended
-  python -m pvraft_tpu.obs validate-trace $trace_artifacts
-else
-  echo "(no committed trace artifacts)"
-fi
-slo_artifacts=$(ls artifacts/*.slo.json 2>/dev/null || true)
-if [ -n "$slo_artifacts" ]; then
-  # shellcheck disable=SC2086 -- word splitting over the file list is intended
-  python -m pvraft_tpu.obs validate-slo $slo_artifacts
-else
-  echo "(no committed SLO reports)"
-fi
+exec python -m pvraft_tpu.analysis gate "$@"

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# fixture shim: manifest equals the fixture registry stage set.
+#   # gate-stage: validate-report
+exec true

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# fixture shim: names a stage the registry does not declare.
+#   # gate-stage: validate-report
+#   # gate-stage: phantom-stage
+exec true

@@ -1,0 +1,406 @@
+"""gate runner: content-hash caching (both directions), dependency
+ordering and dep-failure skips, parallel output isolation, env and
+virtual-device pins, --changed-only against a real git tree, the
+pvraft_gate/v1 report validator red/green, committed-report discipline,
+and the stage-set identity pin between the registry and the real
+lint.sh/ci.yml manifests."""
+
+import json
+import os
+import subprocess
+
+from pvraft_tpu.analysis.gate.runner import (
+    check_report_file,
+    expand_inputs,
+    run_gate,
+    stage_cache_key,
+    validate_gate_report,
+)
+from pvraft_tpu.analysis.gate.stages import (
+    GATE_STAGES,
+    GateStage,
+    parse_manifest,
+    stage_names,
+    stage_problems,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stage(name, command, inputs=(), **kw):
+    return GateStage(name=name, command=command, inputs=tuple(inputs), **kw)
+
+
+def _statuses(report):
+    return {r["name"]: r["status"] for r in report["stages"]}
+
+
+# ------------------------------------------------------------- caching ---
+
+
+def test_cache_hits_when_inputs_unchanged_and_misses_on_edit(tmp_path):
+    root = str(tmp_path)
+    (tmp_path / "input.txt").write_text("v1\n", encoding="utf-8")
+    stages = [_stage("copy", "cat input.txt > out.txt", ["input.txt"])]
+
+    first = run_gate(root=root, stages=stages, echo=lambda _line: None)
+    assert _statuses(first) == {"copy": "ok"}
+
+    second = run_gate(root=root, stages=stages, echo=lambda _line: None)
+    assert _statuses(second) == {"copy": "cached"}
+    assert second["stages"][0]["duration_s"] == 0.0
+
+    (tmp_path / "input.txt").write_text("v2\n", encoding="utf-8")
+    third = run_gate(root=root, stages=stages, echo=lambda _line: None)
+    assert _statuses(third) == {"copy": "ok"}
+    assert (tmp_path / "out.txt").read_text(encoding="utf-8") == "v2\n"
+
+
+def test_failed_stage_is_never_cached(tmp_path):
+    root = str(tmp_path)
+    stages = [_stage("bad", "exit 3")]
+    for _ in range(2):
+        report = run_gate(root=root, stages=stages, echo=lambda _line: None)
+        assert _statuses(report) == {"bad": "failed"}
+        assert report["ok"] is False
+    record = report["stages"][0]
+    assert record["returncode"] == 3
+
+
+def test_cache_key_covers_command_env_and_content(tmp_path):
+    root = str(tmp_path)
+    (tmp_path / "a.txt").write_text("x", encoding="utf-8")
+    base = _stage("s", "true", ["a.txt"])
+    key = stage_cache_key(root, base, ["a.txt"])
+    assert stage_cache_key(root, base, ["a.txt"]) == key
+    assert stage_cache_key(
+        root, _stage("s", "false", ["a.txt"]), ["a.txt"]
+    ) != key
+    assert stage_cache_key(
+        root, _stage("s", "true", ["a.txt"], env=(("K", "v"),)), ["a.txt"]
+    ) != key
+    (tmp_path / "a.txt").write_text("y", encoding="utf-8")
+    assert stage_cache_key(root, base, ["a.txt"]) != key
+
+
+def test_no_cache_mode_always_runs_and_writes_no_cache(tmp_path):
+    root = str(tmp_path)
+    stages = [_stage("s", "true")]
+    for _ in range(2):
+        report = run_gate(
+            root=root, stages=stages, use_cache=False, echo=lambda _line: None
+        )
+        assert _statuses(report) == {"s": "ok"}
+    assert not os.path.isdir(os.path.join(root, ".gate_cache"))
+
+
+# -------------------------------------------------------- dependencies ---
+
+
+def test_dependency_runs_before_dependent(tmp_path):
+    root = str(tmp_path)
+    stages = [
+        _stage("b", "echo b >> order.txt", deps=("a",)),
+        _stage("a", "echo a >> order.txt"),
+    ]
+    report = run_gate(
+        root=root, stages=stages, jobs=4, use_cache=False,
+        echo=lambda _line: None,
+    )
+    assert report["ok"] is True
+    order = (tmp_path / "order.txt").read_text(encoding="utf-8").split()
+    assert order == ["a", "b"]
+
+
+def test_failed_dependency_skips_dependents_with_reason(tmp_path):
+    root = str(tmp_path)
+    stages = [
+        _stage("a", "exit 1"),
+        _stage("b", "true", deps=("a",)),
+        _stage("c", "true", deps=("b",)),
+    ]
+    report = run_gate(
+        root=root, stages=stages, use_cache=False, echo=lambda _line: None
+    )
+    assert _statuses(report) == {"a": "failed", "b": "skipped", "c": "skipped"}
+    by_name = {r["name"]: r for r in report["stages"]}
+    assert "dependency not green: a" in by_name["b"]["reason"]
+    assert report["counts"] == {"ok": 0, "cached": 0, "failed": 1,
+                                "skipped": 2}
+
+
+def test_only_selection_runs_exactly_those_stages(tmp_path):
+    root = str(tmp_path)
+    stages = [
+        _stage("a", "echo a >> order.txt"),
+        _stage("b", "echo b >> order.txt", deps=("a",)),
+    ]
+    report = run_gate(
+        root=root, stages=stages, only=("b",), use_cache=False,
+        echo=lambda _line: None,
+    )
+    assert _statuses(report) == {"b": "ok"}
+    order = (tmp_path / "order.txt").read_text(encoding="utf-8").split()
+    assert order == ["b"]
+
+
+def test_parallel_stage_output_is_not_interleaved(tmp_path):
+    root = str(tmp_path)
+    stages = [
+        _stage("one", "echo one-1; echo one-2; echo one-3"),
+        _stage("two", "echo two-1; echo two-2; echo two-3"),
+    ]
+    lines = []
+    report = run_gate(
+        root=root, stages=stages, jobs=2, use_cache=False, verbose=True,
+        echo=lines.append,
+    )
+    assert report["ok"] is True
+    # Each stage's buffered output appears as one contiguous block —
+    # never mixed with the other stage's lines.
+    owners = [
+        line.strip().split("-", 1)[0]
+        for line in lines
+        if line.strip().startswith(("one-", "two-"))
+    ]
+    assert sorted(owners) == ["one"] * 3 + ["two"] * 3
+    runs = 1 + sum(1 for a, b in zip(owners, owners[1:]) if a != b)
+    assert runs == 2
+
+
+# ------------------------------------------------------- env & devices ---
+
+
+def test_env_pin_and_virtual_devices_reach_the_stage(tmp_path):
+    root = str(tmp_path)
+    stages = [
+        _stage(
+            "env-probe",
+            'printf "%s|%s" "$JAX_PLATFORMS" "$XLA_FLAGS" > probe.txt',
+            env=(("JAX_PLATFORMS", "cpu"),),
+            virtual_devices=8,
+        ),
+    ]
+    report = run_gate(
+        root=root, stages=stages, use_cache=False, echo=lambda _line: None
+    )
+    assert report["ok"] is True
+    probe = (tmp_path / "probe.txt").read_text(encoding="utf-8")
+    platform, flags = probe.split("|")
+    assert platform == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in flags
+
+
+def test_expand_inputs_prunes_ephemeral_and_dirs(tmp_path):
+    (tmp_path / "artifacts" / "xla_cache").mkdir(parents=True)
+    (tmp_path / "artifacts" / "xla_cache" / "blob").write_text("x")
+    (tmp_path / "artifacts" / "real.json").write_text("{}")
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "m.py").write_text("pass\n")
+    rels = expand_inputs(str(tmp_path), ["artifacts/**", "pkg/**/*.py"])
+    assert rels == ["artifacts/real.json", "pkg/m.py"]
+
+
+# --------------------------------------------------------- changed-only --
+
+
+def test_changed_only_skips_unchanged_and_runs_changed(tmp_path):
+    root = str(tmp_path)
+    (tmp_path / "input.txt").write_text("v1\n", encoding="utf-8")
+    git = ["git", "-C", root, "-c", "user.email=t@t", "-c", "user.name=t"]
+    subprocess.run(["git", "-C", root, "init", "-q"], check=True)
+    subprocess.run(["git", "-C", root, "add", "-A"], check=True)
+    subprocess.run(git + ["commit", "-qm", "seed"], check=True)
+
+    stages = [_stage("copy", "cat input.txt > out.txt", ["input.txt"])]
+    report = run_gate(
+        root=root, stages=stages, changed_only=True, use_cache=False,
+        echo=lambda _line: None,
+    )
+    assert _statuses(report) == {"copy": "skipped"}
+    assert "no changed input" in report["stages"][0]["reason"]
+    assert report["changed_only"] is True
+
+    (tmp_path / "input.txt").write_text("v2\n", encoding="utf-8")
+    report = run_gate(
+        root=root, stages=stages, changed_only=True, use_cache=False,
+        echo=lambda _line: None,
+    )
+    assert _statuses(report) == {"copy": "ok"}
+
+
+def test_changed_only_skip_of_dep_still_satisfies_dependents(tmp_path):
+    """An unchanged dependency's previous green result stands: its
+    --changed-only skip must not cascade into a dependency-not-green
+    skip of a dependent whose own inputs DID change."""
+    root = str(tmp_path)
+    (tmp_path / "dep_in.txt").write_text("v1\n", encoding="utf-8")
+    (tmp_path / "child_in.txt").write_text("v1\n", encoding="utf-8")
+    git = ["git", "-C", root, "-c", "user.email=t@t", "-c", "user.name=t"]
+    subprocess.run(["git", "-C", root, "init", "-q"], check=True)
+    subprocess.run(["git", "-C", root, "add", "-A"], check=True)
+    subprocess.run(git + ["commit", "-qm", "seed"], check=True)
+
+    stages = [
+        _stage("dep", "cat dep_in.txt > dep_out.txt", ["dep_in.txt"]),
+        _stage("child", "cat child_in.txt > child_out.txt",
+               ["child_in.txt"], deps=("dep",)),
+    ]
+    (tmp_path / "child_in.txt").write_text("v2\n", encoding="utf-8")
+    report = run_gate(
+        root=root, stages=stages, changed_only=True, use_cache=False,
+        echo=lambda _line: None,
+    )
+    assert _statuses(report) == {"dep": "skipped", "child": "ok"}
+    by_name = {r["name"]: r for r in report["stages"]}
+    assert "no changed input" in by_name["dep"]["reason"]
+
+    # A dep skipped because ITS dependency failed still cascades.
+    stages = [
+        _stage("bad", "exit 1", ["child_in.txt"]),
+        _stage("mid", "true", ["child_in.txt"], deps=("bad",)),
+        _stage("leaf", "true", ["child_in.txt"], deps=("mid",)),
+    ]
+    report = run_gate(
+        root=root, stages=stages, changed_only=True, use_cache=False,
+        echo=lambda _line: None,
+    )
+    assert _statuses(report) == {
+        "bad": "failed", "mid": "skipped", "leaf": "skipped"
+    }
+
+
+# ----------------------------------------------------- report validator --
+
+
+def test_validate_gate_report_green_then_tampered(tmp_path):
+    root = str(tmp_path)
+    stages = [_stage("a", "true"), _stage("b", "true", deps=("a",))]
+    report = run_gate(
+        root=root, stages=stages, use_cache=False, echo=lambda _line: None
+    )
+    assert validate_gate_report(report) == []
+
+    bad = json.loads(json.dumps(report))
+    bad["counts"]["ok"] = 99
+    assert any("do not recompute" in p for p in validate_gate_report(bad))
+
+    bad = json.loads(json.dumps(report))
+    bad["stages"][0]["status"] = "failed"
+    bad["counts"] = {"ok": 1, "cached": 0, "failed": 1, "skipped": 0}
+    assert any("ok flag" in p for p in validate_gate_report(bad))
+
+    bad = json.loads(json.dumps(report))
+    bad["stages"][0]["duration_s"] = 50.0
+    assert any("wall clock" in p for p in validate_gate_report(bad))
+
+    bad = json.loads(json.dumps(report))
+    del bad["total_s"]
+    assert any("total_s" in p for p in validate_gate_report(bad))
+
+
+def test_check_report_file_discipline(tmp_path):
+    root = str(tmp_path)
+    (tmp_path / "in.txt").write_text("x", encoding="utf-8")
+    stages = [_stage("a", "true", ["in.txt"]), _stage("b", "true", ["in.txt"])]
+    report = run_gate(
+        root=root, stages=stages, use_cache=False, echo=lambda _line: None
+    )
+    path = tmp_path / "gate_report.json"
+    path.write_text(json.dumps(report), encoding="utf-8")
+    assert check_report_file(str(path), stages=stages) == []
+
+    # A --changed-only or selected run is not committable evidence.
+    partial = dict(report, changed_only=True)
+    path.write_text(json.dumps(partial), encoding="utf-8")
+    assert any("--changed-only" in p
+               for p in check_report_file(str(path), stages=stages))
+
+    partial = dict(report, only=["a"])
+    path.write_text(json.dumps(partial), encoding="utf-8")
+    assert any("selection" in p
+               for p in check_report_file(str(path), stages=stages))
+
+    # Stage-set identity: a report from another stage era is rejected.
+    extra = stages + [_stage("c", "true")]
+    assert any("missing from the report" in p
+               for p in check_report_file(str(path), stages=extra))
+
+
+def test_check_report_file_rejects_synthesized_records(tmp_path):
+    """A report not produced by the runner — ok/cached rows with no
+    input provenance and zero wall clock — is not committable evidence."""
+    root = str(tmp_path)
+    (tmp_path / "in.txt").write_text("x", encoding="utf-8")
+    stages = [_stage("a", "true", ["in.txt"]), _stage("b", "true", ["in.txt"])]
+    report = run_gate(
+        root=root, stages=stages, use_cache=False, echo=lambda _line: None
+    )
+    path = tmp_path / "gate_report.json"
+
+    fake = json.loads(json.dumps(report))
+    for record in fake["stages"]:
+        record.pop("input_hash", None)
+        record["n_inputs"] = 0
+        record["duration_s"] = 0.0
+        record["status"] = "cached"
+    fake["counts"] = {"ok": 0, "cached": 2, "failed": 0, "skipped": 0}
+    fake["total_s"] = 0.0
+    path.write_text(json.dumps(fake), encoding="utf-8")
+    problems = check_report_file(str(path), stages=stages)
+    assert any("total_s" in p for p in problems)
+    assert any("n_inputs" in p for p in problems)
+    assert any("input_hash" in p for p in problems)
+
+    # Each provenance field is independently required.
+    fake = json.loads(json.dumps(report))
+    fake["stages"][0]["n_inputs"] = 0
+    path.write_text(json.dumps(fake), encoding="utf-8")
+    problems = check_report_file(str(path), stages=stages)
+    assert any("n_inputs" in p for p in problems)
+    assert not any("input_hash" in p for p in problems)
+
+    fake = json.loads(json.dumps(report))
+    fake["stages"][1]["input_hash"] = "not-a-hash"
+    path.write_text(json.dumps(fake), encoding="utf-8")
+    problems = check_report_file(str(path), stages=stages)
+    assert any("input_hash" in p for p in problems)
+    assert not any("n_inputs" in p for p in problems)
+
+    # The real report still passes untouched.
+    path.write_text(json.dumps(report), encoding="utf-8")
+    assert check_report_file(str(path), stages=stages) == []
+
+
+# --------------------------------------------------- stage-set identity --
+
+
+def test_registry_is_well_formed():
+    assert stage_problems(GATE_STAGES) == []
+    names = stage_names()
+    assert len(names) == len(set(names))
+    assert len(GATE_STAGES) >= 25
+
+
+def test_real_manifests_match_registry_exactly():
+    declared = set(stage_names())
+    for rel in ("scripts/lint.sh", ".github/workflows/ci.yml"):
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            entries = parse_manifest(fh.read())
+        manifest_names = [name for _line, name in entries]
+        assert len(manifest_names) == len(set(manifest_names)), rel
+        assert set(manifest_names) == declared, rel
+
+
+def test_registry_dependency_and_cycle_detection():
+    bad = (
+        _stage("a", "true", deps=("ghost",)),
+        _stage("b", "true", deps=("c",)),
+        _stage("c", "true", deps=("b",)),
+        _stage("b", "true"),
+    )
+    problems = stage_problems(bad)
+    assert any("ghost" in p for p in problems)
+    assert any("more than once" in p for p in problems)
+    cyc = (_stage("x", "true", deps=("y",)), _stage("y", "true", deps=("x",)))
+    assert any("cycle" in p for p in stage_problems(cyc))

@@ -1,0 +1,320 @@
+"""gatecheck: the GE rules red/green over the fixture corpus, the
+claim grammar (extraction, field resolution, unit transforms, precision
+matching), the declared VALIDATORS table's ordering invariants, the
+markdown pragma path, the clean-tree zero-findings gate, the CLI, and
+the engine-wide rule-id namespace."""
+
+import contextlib
+import io
+import json
+import os
+import shutil
+
+from pvraft_tpu.analysis.__main__ import main as analysis_main
+from pvraft_tpu.analysis.engine import known_rule_ids
+from pvraft_tpu.analysis.gate.check import check_repo
+from pvraft_tpu.analysis.gate.evidence import (
+    CLAIM_DOCS,
+    VALIDATORS,
+    ValidatorSpec,
+    apply_unit,
+    claim_matches,
+    extract_claims,
+    extract_citations,
+    resolve_field,
+)
+from pvraft_tpu.analysis.gate.model import build_evidence_model, first_match
+from pvraft_tpu.analysis.gate.rules import all_gate_rules
+from pvraft_tpu.analysis.gate.stages import GATE_STAGES, GateStage
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "gatecheck")
+
+# Small declared tables the fixture trees are checked against — the real
+# tables would drag the whole repo ledger into every assertion.
+FIX_VALIDATORS = (
+    ValidatorSpec(
+        schema="pvraft_report/v1",
+        globs=("artifacts/report.json", "artifacts/present.json"),
+        stage="validate-report",
+        note="fixture validator row",
+    ),
+    ValidatorSpec(
+        schema="",
+        globs=("artifacts/orphan.json",),
+        stage="",
+        note="fixture note row",
+    ),
+)
+FIX_STAGES = (
+    GateStage(name="validate-report", command="true", inputs=()),
+)
+
+
+def _fixture_check(name, rule, manifest_paths=()):
+    diags, _ = check_repo(
+        root=os.path.join(FIXTURES, name),
+        rule_ids=(rule,),
+        validators=FIX_VALIDATORS,
+        stages=FIX_STAGES,
+        manifest_paths=manifest_paths,
+        use_git=False,
+    )
+    return diags
+
+
+# ------------------------------------------------------------- rules ----
+
+
+def test_ge001_red_dangling_citation_and_unindexed_artifact():
+    diags = _fixture_check("ge001_red", "GE001")
+    messages = [d.message for d in diags]
+    assert any("artifacts/missing.json" in m for m in messages)
+    assert any("artifacts/orphan.json" in m and "index row" in m
+               for m in messages)
+    assert all(d.rule_id == "GE001" for d in diags)
+
+
+def test_ge001_green():
+    assert _fixture_check("ge001_green", "GE001") == []
+
+
+def test_ge002_red_uncovered_artifact():
+    diags = _fixture_check("ge002_red", "GE002")
+    assert len(diags) == 1
+    assert diags[0].path == "artifacts/orphan_metric.json"
+    assert "no" in diags[0].message and "VALIDATORS" in diags[0].message
+
+
+def test_ge002_green():
+    assert _fixture_check("ge002_green", "GE002") == []
+
+
+def test_ge003_red_stale_claim():
+    diags = _fixture_check("ge003_red", "GE003")
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.path == "README.md"
+    assert "stale claim" in d.message
+    assert "'99.9'" in d.message and "12.5" in d.message
+
+
+def test_ge003_green_including_len_unit():
+    assert _fixture_check("ge003_green", "GE003") == []
+
+
+def test_ge004_red_unowned_schema():
+    diags = _fixture_check("ge004_red", "GE004")
+    assert len(diags) == 1
+    assert diags[0].path == "artifacts/report.json"
+    assert "pvraft_ghost/v1" in diags[0].message
+
+
+def test_ge004_green():
+    assert _fixture_check("ge004_green", "GE004") == []
+
+
+def test_ge005_red_manifest_names_undeclared_stage():
+    diags = _fixture_check(
+        "ge005_red", "GE005", manifest_paths=("lint.sh",)
+    )
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.path == "lint.sh"
+    assert "phantom-stage" in d.message
+
+
+def test_ge005_green():
+    assert _fixture_check(
+        "ge005_green", "GE005", manifest_paths=("lint.sh",)
+    ) == []
+
+
+def test_ge005_missing_expected_manifest_is_a_finding():
+    # A deleted shim may not silently drop the identity check.
+    diags = _fixture_check(
+        "ge001_green", "GE005", manifest_paths=("lint.sh",)
+    )
+    assert any("missing" in d.message and d.path == "lint.sh" for d in diags)
+
+
+def test_markdown_pragma_suppresses_but_clean_tree_carries_none(tmp_path):
+    src = os.path.join(FIXTURES, "ge003_red")
+    root = tmp_path / "tree"
+    shutil.copytree(src, root)
+    readme = root / "README.md"
+    text = readme.read_text(encoding="utf-8")
+    text = text.replace(
+        "rps on the",
+        "rps <!-- # graftlint: disable=GE003 -- fixture suppression --> on the",
+    )
+    readme.write_text(text, encoding="utf-8")
+    diags, _ = check_repo(
+        root=str(root), rule_ids=("GE003",), validators=FIX_VALIDATORS,
+        stages=FIX_STAGES, manifest_paths=(), use_git=False,
+    )
+    assert diags == []
+
+
+# ------------------------------------------------------- claim grammar ---
+
+
+def test_extract_claims_segments_and_units():
+    lines = [
+        "p50 35.2 <!-- claim: artifacts/a.json#lat.p50 --> ms, "
+        "32.1 <!-- claim: artifacts/a.json#rps --> rps",
+        "95 <!-- claim: artifacts/b.json#leaves@len --> leaves",
+    ]
+    claims = extract_claims("DOC.md", lines)
+    assert [(c.field, c.unit, c.quoted) for c in claims] == [
+        ("lat.p50", "", "35.2"), ("rps", "", "32.1"), ("leaves", "len", "95"),
+    ]
+
+
+def test_extract_claims_skips_fenced_blocks():
+    lines = [
+        "```markdown",
+        "10.0 <!-- claim: artifacts/x.json#f -->",
+        "```",
+        "real 1.5 <!-- claim: artifacts/y.json#g -->",
+    ]
+    claims = extract_claims("DOC.md", lines)
+    assert [c.src for c in claims] == ["artifacts/y.json"]
+
+
+def test_extract_citations_normalizes_templates():
+    lines = ["see artifacts/run_<timestamp>.json and artifacts/a_{b,c}.json."]
+    cites = extract_citations("DOC.md", lines)
+    pats = [p for c in cites for p in c.patterns]
+    assert "artifacts/run_*.json" in pats
+    assert "artifacts/a_b.json" in pats and "artifacts/a_c.json" in pats
+
+
+def test_resolve_field_walks_dicts_and_list_indices():
+    obj = {"meshes": [{"scenes": [{"bytes": 7}]}]}
+    assert resolve_field(obj, "meshes.0.scenes.0.bytes") == (True, 7)
+    assert resolve_field(obj, "meshes.1.scenes") == (False, None)
+    assert resolve_field(obj, "meshes.x") == (False, None)
+
+
+def test_apply_unit_transforms():
+    assert apply_unit(2 ** 30, "gib") == (True, 1.0)
+    assert apply_unit(3 * 2 ** 20, "mib") == (True, 3.0)
+    assert apply_unit([1, 2, 3], "len") == (True, 3)
+    ok, _ = apply_unit("text", "gib")
+    assert not ok
+
+
+def test_claim_matches_at_prose_precision():
+    assert claim_matches("10.46", 10.4634)
+    assert not claim_matches("10.46", 10.47)
+    assert claim_matches("192,034", 192034)
+    assert claim_matches("29.3", 29.277)
+    assert not claim_matches("29.3", 29.35001)
+    assert not claim_matches("1", True)  # bools are not numbers
+
+
+# --------------------------------------------------- registry invariants --
+
+
+def test_validators_specific_rows_shadow_broad_serve_glob():
+    # First-match order: the trace/slo/calibration rows must win over the
+    # broad serve_*.json row (the artifact_budget.py discipline).
+    for rel, schema in (
+        ("artifacts/serve_ab.slo.json", "pvraft_slo/v1"),
+        ("artifacts/serve_chaos.trace.json", "pvraft_trace/v1"),
+        ("artifacts/serve_calibration.json", "pvraft_cost_calibration/v1"),
+        ("artifacts/serve_cpu_synthetic.json", "pvraft_serve_load/v1"),
+    ):
+        spec = first_match(rel, VALIDATORS)
+        assert spec is not None and spec.schema == schema, rel
+
+
+def test_validators_schema_namespace_is_exactly_once():
+    owned = [s.schema for s in VALIDATORS if s.schema]
+    assert len(owned) == len(set(owned))
+
+
+def test_rule_ids_are_the_declared_ge_family():
+    assert [r.id for r in all_gate_rules()] == [
+        "GE001", "GE002", "GE003", "GE004", "GE005",
+    ]
+
+
+def test_known_rule_ids_include_ge_family():
+    ids = known_rule_ids()
+    assert {"GE000", "GE001", "GE002", "GE003", "GE004", "GE005"} <= ids
+
+
+# ------------------------------------------------------- clean tree & CLI --
+
+
+def test_clean_tree_has_zero_findings_and_zero_ge_pragmas():
+    diags, model = check_repo(root=REPO)
+    assert diags == [], "\n".join(d.format() for d in diags)
+    # The discipline is fixed-not-pragma'd: no GE suppression anywhere in
+    # the claim docs.
+    for doc, lines in model.docs.items():
+        for line in lines:
+            assert "disable=GE" not in line, doc
+
+
+def test_clean_tree_model_is_populated():
+    model = build_evidence_model(REPO)
+    assert len(model.tracked) > 30
+    assert len(model.claims) >= 15
+    assert len(model.citations) > 50
+    assert set(model.manifests) == {
+        "scripts/lint.sh", ".github/workflows/ci.yml"
+    }
+    assert model.errors == []
+    assert "artifacts/README.md" in model.docs
+    assert CLAIM_DOCS[0] == "README.md"
+
+
+def test_cli_rules_green_and_list_flags():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        rc = analysis_main(["gate", "--rules", "--root", REPO])
+    assert rc == 0
+    assert "gatecheck: 0 finding(s)" in buf.getvalue()
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["gate", "--list-rules"])
+    assert rc == 0
+    for rid in ("GE001", "GE002", "GE003", "GE004", "GE005"):
+        assert rid in buf.getvalue()
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(["gate", "--list-stages"])
+    assert rc == 0
+    for stage in GATE_STAGES:
+        assert stage.name in buf.getvalue()
+
+
+def test_cli_rules_red_on_fixture(tmp_path):
+    src = os.path.join(FIXTURES, "ge003_red")
+    root = tmp_path / "tree"
+    shutil.copytree(src, root)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = analysis_main(
+            ["gate", "--rules", "--select", "GE003", "--root", str(root)]
+        )
+    assert rc == 1
+    assert "GE003" in buf.getvalue()
+
+
+def test_committed_gate_reports_are_valid_evidence():
+    from pvraft_tpu.analysis.gate.runner import check_report_file
+
+    for name in ("gate_cold.json", "gate_warm.json"):
+        path = os.path.join(REPO, "artifacts", name)
+        assert check_report_file(path) == [], name
+    with open(os.path.join(REPO, "artifacts", "gate_warm.json"),
+              encoding="utf-8") as fh:
+        warm = json.load(fh)
+    # The warm snapshot is the caching claim: most stages cached.
+    assert warm["counts"]["cached"] >= warm["counts"]["ok"]
