@@ -36,6 +36,7 @@ def default_scope() -> Tuple[str, ...]:
     pkg = os.path.dirname(os.path.abspath(pvraft_tpu.__file__))
     return (
         os.path.join(pkg, "serve"),
+        os.path.join(pkg, "fleet"),
         os.path.join(pkg, "obs"),
         os.path.join(pkg, "data", "loader.py"),
     )
@@ -43,7 +44,7 @@ def default_scope() -> Tuple[str, ...]:
 
 # Spelled as a constant for docs/tests; resolved lazily by the CLI so
 # importing this module never imports the full package tree.
-DEFAULT_SCOPE = ("pvraft_tpu/serve", "pvraft_tpu/obs",
+DEFAULT_SCOPE = ("pvraft_tpu/serve", "pvraft_tpu/fleet", "pvraft_tpu/obs",
                  "pvraft_tpu/data/loader.py")
 
 

@@ -148,6 +148,14 @@ VALIDATORS: Tuple[ValidatorSpec, ...] = (
         note="loadgen evidence; server_metrics reconcile",
     ),
     ValidatorSpec(
+        schema="pvraft_fleet_chaos/v1",
+        globs=("artifacts/fleet_chaos.json",),
+        stage="validate-fleet",
+        note="generator-refused unless identity held at every snapshot, "
+             "spillover resolved the lost backend and recompiles == 0; "
+             "embedded load block re-validated via the serve validator",
+    ),
+    ValidatorSpec(
         schema="pvraft_step_profile/v1",
         globs=("artifacts/step_profile.json",),
         stage="validate-profile",
@@ -220,6 +228,14 @@ VALIDATORS: Tuple[ValidatorSpec, ...] = (
         globs=("artifacts/*.log", "artifacts/logs/*"),
         stage="",
         note="raw queue logs: history, not citable evidence",
+    ),
+    ValidatorSpec(
+        schema="",
+        globs=("artifacts/legacy/*",),
+        stage="",
+        note="pre-gate CPU-fallback-era queue records (ex repo root): "
+             "explicitly incomparable history, never citable — the "
+             "artifacts/README 'Pre-gate bench records' section is the pin",
     ),
 )
 

@@ -99,11 +99,13 @@ GATE_STAGES: Tuple[GateStage, ...] = (
         command="python -m pvraft_tpu.analysis concurrency",
         inputs=(
             "pvraft_tpu/serve/**/*.py",
+            "pvraft_tpu/fleet/**/*.py",
             "pvraft_tpu/obs/**/*.py",
             "pvraft_tpu/data/*.py",
             "pvraft_tpu/analysis/concurrency/*.py",
         ) + ANALYSIS_CORE,
-        doc="Concurrency static analysis (GC rules) over serve/obs/loader: "
+        doc="Concurrency static analysis (GC rules) over serve/fleet/obs/"
+            "loader: "
             "guarded-by discipline, lock-order cycles, check-then-act "
             "shapes, un-joined non-daemon threads. Pure stdlib AST, no jax. "
             "The dynamic half is opt-in at test time (PVRAFT_CHECKS=1 turns "
@@ -356,6 +358,20 @@ GATE_STAGES: Tuple[GateStage, ...] = (
             "must parse against its schema. The trace/SLO siblings and the "
             "calibration evidence have their own validators in other "
             "stages — excluded here (the VALIDATORS first-match order).",
+    ),
+    GateStage(
+        name="validate-fleet",
+        command="python -m pvraft_tpu.fleet validate artifacts/fleet_chaos.json",
+        inputs=(
+            "pvraft_tpu/fleet/**/*.py",
+            "pvraft_tpu/serve/loadgen.py",
+            "artifacts/fleet_chaos.json",
+        ),
+        doc="pvraft_fleet_chaos/v1: the committed 2-backend chaos evidence "
+            "(backend loss resolved by spillover, zero-recompile hot-swap "
+            "under the sealed watchdog, a canary verdict) must re-validate "
+            "structurally — embedded load block included, through the serve "
+            "validator.",
     ),
     GateStage(
         name="validate-trace",

@@ -1,0 +1,46 @@
+"""pvraft_tpu.fleet: routing/fan-out tier over serve replica-pool hosts.
+
+The serving story one level up from ``pvraft_tpu.serve``: N backend
+hosts (each a full ``build_service`` replica pool) behind one thin HTTP
+router with
+
+- **per-bucket least-predicted-load routing** (polled backend queue
+  depth + cost-surface-predicted device-seconds) with spillover on
+  shed/unreachable backends and supervisor-vocabulary backend health
+  (healthy/degraded/quarantined/probing off polled ``/healthz``),
+- **zero-downtime weight hot-swap** — ``POST /admin/reload`` fans out
+  sequentially; each backend's engine swaps params into its AOT
+  executables with no recompile (the sealed retrace watchdog proves
+  it) after draining in-flight batches,
+- **a live canary** — a deterministic traffic fraction interleaved to
+  the new-weight backend, shadow-mirrored to the incumbent, promotion
+  gated on the pinned EPE bounds (the bf16-promotion precedent).
+
+Jax-free throughout: the fleet tier talks HTTP, never tensors.
+"""
+
+from pvraft_tpu.fleet.artifact import (  # noqa: F401
+    FLEET_CHAOS_SCHEMA,
+    validate_fleet_artifact,
+)
+from pvraft_tpu.fleet.backend import Backend, BackendClient  # noqa: F401
+from pvraft_tpu.fleet.canary import CanaryController, flow_epe  # noqa: F401
+from pvraft_tpu.fleet.metrics import FleetMetrics  # noqa: F401
+from pvraft_tpu.fleet.router import (  # noqa: F401
+    FleetConfig,
+    FleetRouter,
+    build_fleet,
+)
+
+__all__ = [
+    "FLEET_CHAOS_SCHEMA",
+    "validate_fleet_artifact",
+    "Backend",
+    "BackendClient",
+    "CanaryController",
+    "flow_epe",
+    "FleetMetrics",
+    "FleetConfig",
+    "FleetRouter",
+    "build_fleet",
+]

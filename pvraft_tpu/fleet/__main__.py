@@ -1,0 +1,104 @@
+"""CLI: the fleet router + its evidence validator.
+
+``python -m pvraft_tpu.fleet run --target h:p --target h:p [--port N]``
+stands the routing/fan-out tier up over already-running serve hosts;
+``python -m pvraft_tpu.fleet validate <artifact>...`` validates
+committed ``pvraft_fleet_chaos/v1`` evidence (the ``validate-fleet``
+gate stage). Jax-free — the fleet tier never imports a backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_validate(args) -> int:
+    from pvraft_tpu.fleet.artifact import validate_fleet_artifact
+
+    rc = 0
+    for path in args.paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"{path}: unreadable: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        problems = validate_fleet_artifact(doc, path=path)
+        if problems:
+            for p in problems:
+                print(f"{path}: {p}", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"{path}: ok (pvraft_fleet_chaos/v1)")
+    return rc
+
+
+def _cmd_run(args) -> int:
+    import threading
+
+    from pvraft_tpu.fleet.router import build_fleet
+
+    telemetry = None
+    if args.events:
+        from pvraft_tpu.serve.events import ServeTelemetry
+
+        telemetry = ServeTelemetry(args.events)
+    surface = None
+    if args.cost_surface:
+        from pvraft_tpu.programs.costs import CostSurface
+
+        # Arming is an explicit opt-in (the serve --cost_surface
+        # discipline): a bad path fails loudly here, never silently
+        # routes unpriced.
+        surface = CostSurface.load(args.cost_surface)
+    router = build_fleet(args.target, telemetry=telemetry,
+                         cost_surface=surface, host=args.host,
+                         port=args.port, quiet=not args.verbose)
+    router.start()
+    print(f"fleet router on {router.host}:{router.port} over "
+          f"{[b.client.endpoint for b in router.backends]} "
+          f"(cost surface {'armed' if surface else 'off'})",
+          file=sys.stderr)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.shutdown()
+        if telemetry is not None:
+            telemetry.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m pvraft_tpu.fleet")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    val = sub.add_parser(
+        "validate", help="validate pvraft_fleet_chaos/v1 artifacts")
+    val.add_argument("paths", nargs="+")
+    val.set_defaults(fn=_cmd_validate)
+    run = sub.add_parser(
+        "run", help="run the fleet router over N serve hosts")
+    run.add_argument("--target", action="append", required=True,
+                     metavar="HOST:PORT",
+                     help="a backend serve host (repeatable)")
+    run.add_argument("--host", default="127.0.0.1")
+    run.add_argument("--port", type=int, default=0,
+                     help="router port (0 = ephemeral)")
+    run.add_argument("--events", default="",
+                     help="fleet event log path (pvraft_events/v1)")
+    run.add_argument("--cost_surface", default="",
+                     help="pvraft_costs/v1 inventory to price routing "
+                          "decisions with (explicit opt-in)")
+    run.add_argument("-v", "--verbose", action="store_true",
+                     help="log HTTP requests")
+    run.set_defaults(fn=_cmd_run)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

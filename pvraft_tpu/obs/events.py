@@ -73,6 +73,30 @@ Tracing events (``pvraft_tpu/obs/trace.py``) ride the same stream:
     slo_report  path, slo_p99_ms    [+ max_qps_under_slo, programs,
                 requests] — pointer to a written pvraft_slo/v1 report
 
+Fleet events (``pvraft_tpu/fleet``) ride the same stream — the router
+tier emits next to the backends it fans out over:
+
+    fleet_route   backend, reason  [+ bucket, queue_depth, predicted_s,
+                attempts, canary, status] — one routing decision: which
+                backend got a request and why; ``reason`` must be one of
+                ``FLEET_ROUTE_REASONS`` (least_loaded = normal pick,
+                spillover = first choice shed and the request was
+                re-offered, canary = interleaved onto the canary
+                backend, shadow = the mirrored reference copy of a
+                canary request)
+    weight_swap   digest, epoch    [+ path, previous_digest, replicas,
+                swap_ms, drained] — one zero-downtime hot-swap: the
+                params pointer of every replica was replaced (no
+                recompile — AOT programs take params as arguments);
+                ``epoch`` carries the checkpoint's epoch or the ``-1``
+                epoch-less sentinel (engine/checkpoint.load_params)
+    canary_verdict verdict, epe, bound  [+ rel_epe, rel_bound, samples,
+                fraction, canary_backend, baseline_backend] — the
+                router's promotion gate fired: mean EPE between canary
+                and incumbent flows over the interleaved sample versus
+                the pinned bound (the bf16-promotion precedent);
+                ``verdict`` must be one of ``CANARY_VERDICTS``
+
 Performance-plane events (``pvraft_tpu/obs/retrace.py``,
 ``pvraft_tpu/obs/device_memory.py``) ride the same stream:
 
@@ -147,6 +171,15 @@ EVENT_TYPES: Dict[str, tuple] = {
     "fault_injected": (("point",),
                        ("replica", "bucket", "traversal", "fires",
                         "value")),
+    "fleet_route": (("backend", "reason"),
+                    ("bucket", "queue_depth", "predicted_s", "attempts",
+                     "canary", "status")),
+    "weight_swap": (("digest", "epoch"),
+                    ("path", "previous_digest", "replicas", "swap_ms",
+                     "drained")),
+    "canary_verdict": (("verdict", "epe", "bound"),
+                       ("rel_epe", "rel_bound", "samples", "fraction",
+                        "canary_backend", "baseline_backend")),
 }
 
 # serve_reject.reason vocabulary (validated like divergence.reason).
@@ -171,6 +204,14 @@ REPLICA_STATES = ("healthy", "degraded", "quarantined", "probing")
 FAULT_POINTS = (
     "replica_predict_error", "replica_latency_ms", "replica_wedge",
     "queue_stall", "compile_trip")
+
+# fleet_route.reason vocabulary — why the router sent a request where it
+# did (pvraft_tpu/fleet/router.py imports THIS, same direction as
+# FAULT_POINTS, so the validator stays fleet-import-free).
+FLEET_ROUTE_REASONS = ("least_loaded", "spillover", "canary", "shadow")
+
+# canary_verdict.verdict vocabulary — the promotion gate's two outcomes.
+CANARY_VERDICTS = ("promote", "reject")
 
 _BASE_FIELDS = ("schema", "type", "time", "seq")
 
@@ -198,6 +239,11 @@ _NUMERIC_FIELDS = {
     "replica_state": ("replica", "device_id"),
     "fault_injected": ("replica", "bucket", "traversal", "fires",
                        "value"),
+    "fleet_route": ("backend", "bucket", "queue_depth", "predicted_s",
+                    "attempts", "status"),
+    "weight_swap": ("epoch", "replicas", "swap_ms", "drained"),
+    "canary_verdict": ("epe", "bound", "rel_epe", "rel_bound", "samples",
+                       "fraction", "canary_backend", "baseline_backend"),
 }
 
 # device_memory per-device row shape: required/optional keys and which
@@ -351,6 +397,41 @@ def validate_event(record: Any, seq: Optional[int] = None) -> List[str]:
                 and not isinstance(record["extrapolated"], bool):
             problems.append(
                 "cost_calibration: extrapolated must be a bool")
+    if etype == "fleet_route":
+        if record.get("reason") not in FLEET_ROUTE_REASONS:
+            problems.append(
+                f"fleet_route: reason {record.get('reason')!r} must be "
+                f"one of {FLEET_ROUTE_REASONS}")
+        backend = record.get("backend")
+        if _is_number(backend) and isinstance(backend, (int, float)) \
+                and backend < 0:
+            problems.append(
+                f"fleet_route: backend {backend} must be >= 0")
+        if "canary" in record and not isinstance(record["canary"], bool):
+            problems.append("fleet_route: canary must be a bool")
+    if etype == "weight_swap":
+        if not isinstance(record.get("digest"), str) \
+                or not record.get("digest"):
+            problems.append(
+                "weight_swap: digest must be a non-empty string (the "
+                "params-content fingerprint a hot-swap is observable by)")
+        for key in ("replicas", "swap_ms", "drained"):
+            v = record.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v < 0:
+                problems.append(f"weight_swap: {key}={v} must be >= 0")
+    if etype == "canary_verdict":
+        if record.get("verdict") not in CANARY_VERDICTS:
+            problems.append(
+                f"canary_verdict: verdict {record.get('verdict')!r} "
+                f"must be one of {CANARY_VERDICTS}")
+        for key in ("epe", "bound", "rel_epe", "rel_bound", "samples",
+                    "fraction"):
+            v = record.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v < 0:
+                problems.append(
+                    f"canary_verdict: {key}={v} must be >= 0")
     if etype == "fault_injected" and record.get("point") not in (
             FAULT_POINTS):
         problems.append(

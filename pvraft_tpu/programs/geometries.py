@@ -162,6 +162,40 @@ SUPERVISOR_DEFAULTS = {
     "wedge_timeout_s": 30.0,
 }
 
+# Fleet-router defaults (pvraft_tpu/fleet reads THESE — same geometry-
+# data discipline as SUPERVISOR_DEFAULTS above). The router is a thin
+# HTTP fan-out tier over N backend hosts (each a serve.build_service
+# replica pool): it routes per-bucket by backend queue depth plus
+# cost-surface-predicted device-seconds, spills over on 503, and
+# quarantines backends with the supervisor's state vocabulary driven
+# from polled /healthz.
+FLEET_DEFAULTS = {
+    # Backend health poll cadence (GET /healthz per backend) and one
+    # poll's budget. A backend that misses `degraded_after` consecutive
+    # polls is degraded (still routable, visibly unhealthy); at
+    # `quarantine_after` it leaves the rotation until a probe poll
+    # succeeds — the same healthy -> degraded -> quarantined -> probing
+    # machine the replica supervisor runs one level down.
+    "poll_interval_s": 0.5,
+    "poll_timeout_s": 5.0,
+    "degraded_after": 1,
+    "quarantine_after": 3,
+    # Retry-After (seconds) the router sends when EVERY backend shed or
+    # is out of rotation — one poll cycle, like the supervisor's.
+    "retry_after_s": 1,
+    # Per-request forward budget against one backend.
+    "predict_timeout_s": 60.0,
+    # Canary promotion gate: the interleaved traffic fraction routed to
+    # the new-weight backend, the sample count the verdict needs, and
+    # the EPE bounds versus the incumbent — the SERVE_BF16_EPE_BOUND
+    # precedent (a weight swap that moves predictions more than a
+    # precision change would is not silently promoted).
+    "canary_fraction": 0.25,
+    "canary_min_samples": 8,
+    "canary_epe_bound": SERVE_BF16_EPE_BOUND,
+    "canary_rel_epe_bound": SERVE_BF16_REL_EPE_BOUND,
+}
+
 # pc1 is donated to every predict program: the unique input whose
 # (shape, dtype) matches the flow output, so XLA aliases instead of
 # allocating (deepcheck GJ004/GJ005 verify this on the serve.predict

@@ -51,10 +51,13 @@ float32`` away.
 from __future__ import annotations
 
 import dataclasses
+import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pvraft_tpu.analysis.concurrency.sanitizer import ordered_lock
 from pvraft_tpu.analysis.contracts import shapecheck
 from pvraft_tpu.config import ModelConfig
 from pvraft_tpu.programs.geometries import (
@@ -175,6 +178,27 @@ def pad_points(pc: np.ndarray, bucket: int,
         [np.asarray(pc, np.float32), pad], axis=0)
 
 
+def params_digest(variables) -> str:
+    """Content fingerprint of a params tree: sha256 over every leaf's
+    dtype/shape/bytes in deterministic tree order, truncated to 16 hex
+    chars. What ``/healthz``'s weights block and ``weight_swap`` events
+    carry — two engines serving the same weights agree on it, a hot-swap
+    visibly changes it."""
+    import hashlib
+
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(variables)
+    h = hashlib.sha256()
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()[:16]
+
+
 def build_predict_fn(model, num_iters: int, refine: bool = False):
     """The serve predict program body (what gets AOT-compiled):
     ``predict(params, pc1, pc2, valid1, valid2) -> flow`` with the
@@ -208,9 +232,44 @@ class Replica:
         self.index = index
         self.device = device
         self.device_id = int(device.id)
-        self.params = params
         self.engine = engine
         self.programs: Dict[Tuple[int, int], AotProgram] = {}
+        # Hot-swap coordination (engine.swap_params): dispatches read
+        # the params pointer AND register in-flight under _lock, so a
+        # swap can replace the pointer and then wait for every dispatch
+        # still holding the OLD params — never a torn read, never a
+        # dropped old-params reference while a batch is on device.
+        self._lock = ordered_lock("Replica._lock")
+        self.params = params                 # guarded-by: _lock
+        self._params_generation = 0          # guarded-by: _lock
+        self._inflight: Dict[int, int] = {}  # generation -> dispatches; guarded-by: _lock
+        self._drain_below = 0                # guarded-by: _lock
+        self._drained: Optional[threading.Event] = None  # guarded-by: _lock
+
+    def swap_params(self, params,
+                    drain_timeout_s: float = 30.0) -> Tuple[int, bool]:
+        """Replace this replica's device-resident params and wait for
+        every dispatch still running on the OLD params to drain. New
+        dispatches pick up the new pointer immediately (zero downtime);
+        the old params object stays referenced by in-flight calls until
+        they finish, and this method blocks (bounded) until that count
+        is zero. Returns ``(old_inflight, drained_in_time)``. The AOT
+        programs take params as a call argument, so nothing recompiles
+        — the sealed retrace watchdog proves it structurally."""
+        with self._lock:
+            self.params = params
+            self._params_generation += 1
+            self._drain_below = self._params_generation
+            pending = sum(c for g, c in self._inflight.items()
+                          if g < self._drain_below)
+            event = threading.Event() if pending else None
+            self._drained = event
+        if event is None:
+            return 0, True
+        drained = event.wait(drain_timeout_s)
+        with self._lock:
+            self._drained = None
+        return pending, drained
 
     def predict_batch(
         self,
@@ -248,16 +307,41 @@ class Replica:
         prog = self.programs[(bucket, bs)]
         import jax
 
-        # The annotation brackets execute + host fetch (np.asarray is
-        # the sync), so the trace plane's device_execute span lines up
-        # with this named region in an XLA profile captured via
-        # /debug/trace (one region per replica: device id in the name).
-        with jax.profiler.TraceAnnotation(
-                f"serve_device_execute_b{bucket}_bs{bs}_d{self.device_id}"):
-            flow = np.asarray(prog(
-                self.params,
-                np.stack(rows1), np.stack(rows2),
-                np.stack(v1), np.stack(v2)))
+        # Read the params pointer and register in-flight in ONE lock
+        # hold: a concurrent swap_params either sees this dispatch (and
+        # waits for it) or hasn't swapped yet (this dispatch runs the
+        # new params) — never a half-swapped view.
+        with self._lock:
+            params = self.params
+            gen = self._params_generation
+            self._inflight[gen] = self._inflight.get(gen, 0) + 1
+        try:
+            # The annotation brackets execute + host fetch (np.asarray
+            # is the sync), so the trace plane's device_execute span
+            # lines up with this named region in an XLA profile captured
+            # via /debug/trace (one region per replica: device id in the
+            # name).
+            with jax.profiler.TraceAnnotation(
+                    f"serve_device_execute_b{bucket}_bs{bs}"
+                    f"_d{self.device_id}"):
+                flow = np.asarray(prog(
+                    params,
+                    np.stack(rows1), np.stack(rows2),
+                    np.stack(v1), np.stack(v2)))
+        finally:
+            with self._lock:
+                self._inflight[gen] -= 1
+                if self._inflight[gen] == 0:
+                    del self._inflight[gen]
+                event = self._drained
+                old_pending = sum(
+                    c for g, c in self._inflight.items()
+                    if g < self._drain_below)
+            # Signal AFTER release (never wake a waiter into a held
+            # lock); the swap only cares that old-generation dispatches
+            # hit zero.
+            if event is not None and old_pending == 0:
+                event.set()
         return [flow[i, : requests[i][0].shape[0]]
                 for i in range(len(requests))]
 
@@ -280,6 +364,19 @@ class InferenceEngine:
         from jax.sharding import SingleDeviceSharding
 
         self.cfg = cfg
+        self._telemetry = telemetry
+        # Weights provenance (the /healthz weights block + weight_swap
+        # events): source path (None = in-memory params), content
+        # digest, checkpoint epoch (-1 = the epoch-less sentinel from
+        # engine/checkpoint.load_params), swap count. Swaps serialize
+        # behind _swap_lock (one admin reload at a time).
+        self._swap_lock = ordered_lock("InferenceEngine._swap_lock")
+        self._weights: Dict[str, Any] = {
+            "path": None,
+            "digest": params_digest(params),
+            "epoch": -1,
+            "swaps": 0,
+        }  # guarded-by: _swap_lock
         from pvraft_tpu.models.raft import PVRaft, PVRaftRefine
 
         model_cfg = dataclasses.replace(cfg.model, compute_dtype=cfg.dtype)
@@ -354,11 +451,17 @@ class InferenceEngine:
     @classmethod
     def from_checkpoint(cls, path: str, cfg: ServeConfig, telemetry=None):
         """Load a checkpoint written by either backend (msgpack file or
-        orbax directory, auto-detected) and build the engine."""
+        orbax directory, auto-detected) and build the engine. The
+        checkpoint's path and epoch (-1 = epoch-less sentinel) are kept
+        as weights provenance for /healthz and hot-swap events."""
         from pvraft_tpu.engine.checkpoint import load_params
 
-        variables, _ = load_params(path)
-        return cls(variables, cfg, telemetry=telemetry)
+        variables, epoch = load_params(path)
+        engine = cls(variables, cfg, telemetry=telemetry)
+        with engine._swap_lock:
+            engine._weights["path"] = path
+            engine._weights["epoch"] = int(epoch)
+        return engine
 
     def _compile(self, bucket: int, bs: int, replica: Replica,
                  sharding) -> AotProgram:
@@ -410,6 +513,100 @@ class InferenceEngine:
 
     def compile_report(self) -> List[Dict[str, Any]]:
         return [p.report() for p in self._programs.values()]
+
+    def weights_info(self) -> Dict[str, Any]:
+        """The /healthz weights block: checkpoint path + content digest
+        + epoch (-1 = the epoch-less sentinel) + hot-swap count."""
+        with self._swap_lock:
+            return dict(self._weights)
+
+    def _check_swap_structure(self, variables) -> None:
+        """A swapped-in tree must match the compiled params signature
+        exactly (structure, shapes, dtypes): the AOT programs were
+        compiled against it, so any mismatch would mean a recompile (or
+        a crash mid-dispatch) — rejected up front instead."""
+        import jax
+
+        new_leaves, new_def = jax.tree_util.tree_flatten(variables)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        if new_def != old_def:
+            raise ValueError(
+                "swap rejected: checkpoint tree structure differs from "
+                "the compiled params signature (a hot-swap must never "
+                f"recompile) — got {new_def}, serving {old_def}")
+        for i, (n, o) in enumerate(zip(new_leaves, old_leaves)):
+            if tuple(np.shape(n)) != tuple(np.shape(o)) \
+                    or np.dtype(np.asarray(n).dtype) != np.dtype(
+                        np.asarray(o).dtype):
+                raise ValueError(
+                    f"swap rejected: leaf {i} is "
+                    f"{np.asarray(n).dtype}{tuple(np.shape(n))}, the "
+                    f"compiled program expects "
+                    f"{np.asarray(o).dtype}{tuple(np.shape(o))} (a "
+                    "hot-swap must never recompile)")
+
+    def swap_params(self, variables, path: Optional[str] = None,
+                    epoch: int = -1,
+                    drain_timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Zero-downtime weight hot-swap: commit ``variables`` to every
+        replica's device and swap each replica's params pointer, waiting
+        for in-flight batches on the old params to drain. The AOT
+        programs take params as call arguments, so NOTHING recompiles —
+        the sealed retrace watchdog (build_service) structurally proves
+        it. Returns the swap report (also emitted as a ``weight_swap``
+        event when the engine has a telemetry sink)."""
+        import jax
+
+        self._check_swap_structure(variables)
+        t0 = time.monotonic()
+        digest = params_digest(variables)
+        drained = 0
+        all_in_time = True
+        pool_params = None
+        with self._swap_lock:
+            for replica in self.replicas:
+                dev_params = jax.device_put(variables, replica.device)
+                if pool_params is None:
+                    pool_params = dev_params
+                pending, in_time = replica.swap_params(
+                    dev_params, drain_timeout_s=drain_timeout_s)
+                drained += pending
+                all_in_time = all_in_time and in_time
+            self.params = pool_params
+            previous = self._weights["digest"]
+            self._weights = {
+                "path": path, "digest": digest, "epoch": int(epoch),
+                "swaps": self._weights["swaps"] + 1,
+            }
+        report = {
+            "digest": digest,
+            "previous_digest": previous,
+            "epoch": int(epoch),
+            "path": path,
+            "replicas": len(self.replicas),
+            "drained": drained,
+            "drained_in_time": all_in_time,
+            "swap_ms": round(1e3 * (time.monotonic() - t0), 3),
+        }
+        # Emit AFTER _swap_lock release: telemetry serializes behind its
+        # own lock and we never nest it under ours.
+        if self._telemetry is not None:
+            self._telemetry.emit_weight_swap(
+                digest=digest, epoch=int(epoch), path=path,
+                previous_digest=previous,
+                replicas=len(self.replicas), swap_ms=report["swap_ms"],
+                drained=drained)
+        return report
+
+    def reload_checkpoint(self, path: str,
+                          drain_timeout_s: float = 30.0) -> Dict[str, Any]:
+        """``POST /admin/reload`` body: load a checkpoint (msgpack or
+        orbax, auto-detected) and hot-swap it into the replica pool."""
+        from pvraft_tpu.engine.checkpoint import load_params
+
+        variables, epoch = load_params(path)
+        return self.swap_params(variables, path=path, epoch=int(epoch),
+                                drain_timeout_s=drain_timeout_s)
 
     def probe_request(self) -> Tuple[np.ndarray, int]:
         """The supervisor's synthetic health-probe payload: a

@@ -194,6 +194,83 @@ class ServeTelemetry:
         with self._lock:
             self.events.emit("cost_calibration", **fields)
 
+    def emit_fleet_route(self, backend: int, reason: str,
+                         bucket: Optional[int] = None,
+                         queue_depth: Optional[int] = None,
+                         predicted_s: Optional[float] = None,
+                         attempts: Optional[int] = None,
+                         canary: Optional[bool] = None,
+                         status: Optional[int] = None) -> None:
+        """One fleet-router routing decision (pvraft_tpu/fleet): which
+        backend got a request and why — the ledger a spillover or canary
+        interleave is replayed from."""
+        fields: Dict[str, Any] = {"backend": backend, "reason": reason}
+        if bucket is not None:
+            fields["bucket"] = bucket
+        if queue_depth is not None:
+            fields["queue_depth"] = queue_depth
+        if predicted_s is not None:
+            fields["predicted_s"] = predicted_s
+        if attempts is not None:
+            fields["attempts"] = attempts
+        if canary is not None:
+            fields["canary"] = canary
+        if status is not None:
+            fields["status"] = status
+        with self._lock:
+            self.events.emit("fleet_route", **fields)
+
+    def emit_weight_swap(self, digest: str, epoch: int,
+                         path: Optional[str] = None,
+                         previous_digest: Optional[str] = None,
+                         replicas: Optional[int] = None,
+                         swap_ms: Optional[float] = None,
+                         drained: Optional[int] = None) -> None:
+        """One zero-downtime hot-swap (engine.swap_params): every
+        replica's params pointer replaced with no recompile; ``epoch``
+        is the checkpoint's epoch or the -1 epoch-less sentinel."""
+        fields: Dict[str, Any] = {"digest": digest, "epoch": epoch}
+        if path is not None:
+            fields["path"] = path
+        if previous_digest is not None:
+            fields["previous_digest"] = previous_digest
+        if replicas is not None:
+            fields["replicas"] = replicas
+        if swap_ms is not None:
+            fields["swap_ms"] = swap_ms
+        if drained is not None:
+            fields["drained"] = drained
+        with self._lock:
+            self.events.emit("weight_swap", **fields)
+
+    def emit_canary_verdict(self, verdict: str, epe: float, bound: float,
+                            rel_epe: Optional[float] = None,
+                            rel_bound: Optional[float] = None,
+                            samples: Optional[int] = None,
+                            fraction: Optional[float] = None,
+                            canary_backend: Optional[int] = None,
+                            baseline_backend: Optional[int] = None
+                            ) -> None:
+        """The router's canary promotion gate fired: mean EPE between
+        canary and incumbent flows versus the pinned bound (the
+        bf16-promotion precedent, programs/geometries.py)."""
+        fields: Dict[str, Any] = {
+            "verdict": verdict, "epe": epe, "bound": bound}
+        if rel_epe is not None:
+            fields["rel_epe"] = rel_epe
+        if rel_bound is not None:
+            fields["rel_bound"] = rel_bound
+        if samples is not None:
+            fields["samples"] = samples
+        if fraction is not None:
+            fields["fraction"] = fraction
+        if canary_backend is not None:
+            fields["canary_backend"] = canary_backend
+        if baseline_backend is not None:
+            fields["baseline_backend"] = baseline_backend
+        with self._lock:
+            self.events.emit("canary_verdict", **fields)
+
     def emit_shutdown(self, served: int, rejected: int,
                       drained: int) -> None:
         with self._lock:

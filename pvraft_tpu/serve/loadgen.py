@@ -178,6 +178,16 @@ def validate_load_artifact(doc: Any,
                 problems.append(
                     f"{path}: per_request has {len(doc['per_request'])} "
                     f"entries, requests.total is {reqs['total']}")
+    # Additive multi-target field (fleet evidence): when the run
+    # round-robined several endpoints, config.targets records them.
+    cfg = doc.get("config")
+    if isinstance(cfg, dict) and "targets" in cfg:
+        tg = cfg["targets"]
+        if (not isinstance(tg, list) or not tg
+                or not all(isinstance(t, str) and t for t in tg)):
+            problems.append(
+                f"{path}: config.targets must be a non-empty list of "
+                f"'host:port' strings")
     if "request_points" in doc:
         rp = doc["request_points"]
         if (not isinstance(rp, dict)
@@ -286,15 +296,42 @@ def _get_json(host: str, port: int, path: str,
         conn.close()
 
 
+def _endpoints(server, targets) -> List[Tuple[str, int]]:
+    """Resolve the endpoint list a load run round-robins over: the
+    in-process ``server`` (the historical single-target path) and/or
+    ``targets`` — "host:port" strings, (host, port) tuples, or objects
+    with ``host``/``port`` (e.g. another started server)."""
+    eps: List[Tuple[str, int]] = []
+    if server is not None:
+        eps.append((server.host, int(server.port)))
+    for t in targets or ():
+        if isinstance(t, str):
+            host, _, port = t.rpartition(":")
+            host = host or "127.0.0.1"
+            # Accept URL spellings ("http://h:p/") without pulling in a
+            # URL parser: strip scheme prefix and trailing slash.
+            if host.startswith(("http://", "https://")):
+                host = host.split("://", 1)[1]
+            eps.append((host, int(port.rstrip("/"))))
+        elif isinstance(t, (tuple, list)):
+            eps.append((str(t[0]), int(t[1])))
+        else:
+            eps.append((t.host, int(t.port)))
+    if not eps:
+        raise ValueError("run_load needs a server or at least one target")
+    return eps
+
+
 def run_load(
-    server,                       # a started ServeHTTPServer
-    n_requests: int,
-    concurrency: int,
-    point_counts: List[int],
+    server=None,                  # a started ServeHTTPServer (or None)
+    n_requests: int = 0,
+    concurrency: int = 1,
+    point_counts: Optional[List[int]] = None,
     seed: int = 0,
     coord_scale: float = 1.0,
     retries: int = 0,
     backoff_ms: float = 50.0,
+    targets: Optional[List[Any]] = None,
 ) -> Dict[str, Any]:
     """Issue ``n_requests`` over ``concurrency`` client threads against a
     running server; returns the raw measurement dict (no schema fields).
@@ -308,7 +345,17 @@ def run_load(
     in lockstep. Every attempt is recorded: a retried request's
     ``per_request`` entry carries an ``attempts`` list (schema-additive)
     and its top-level status/ms are the FINAL attempt's — a request that
-    eventually succeeds counts ``ok``."""
+    eventually succeeds counts ``ok``.
+
+    ``targets`` (fleet evidence, ISSUE 20): additional/alternative
+    endpoints; requests round-robin across the full endpoint list by
+    request index, and a retried request rotates to the NEXT endpoint
+    (a shed client fails over instead of hammering the host that shed
+    it). With several endpoints ``server_metrics`` becomes
+    ``{"targets": [{"target": "host:port", ...snapshot...}, ...]}`` —
+    schema-additive, the single-target shape is unchanged."""
+    eps = _endpoints(server, targets)
+    point_counts = point_counts or []
     rng = host_rng(seed, "serve.loadgen")
     # Pre-generate the request payloads so client threads measure the
     # server, not numpy.
@@ -337,8 +384,9 @@ def run_load(
             for attempt in range(retries + 1):
                 t0 = time.monotonic()
                 retry_after = None
+                host, port = eps[(i + attempt) % len(eps)]
                 try:
-                    r = _post_json(server.host, server.port, "/predict",
+                    r = _post_json(host, port, "/predict",
                                    payloads[i])
                     ms = (time.monotonic() - t0) * 1000.0
                     retry_after = r.get("retry_after")
@@ -423,5 +471,9 @@ def run_load(
             for i, r in enumerate(results)],
         "request_points": {"edges": [int(e) for e in POINT_EDGES],
                            "counts": list(size_hist.counts)},
-        "server_metrics": _get_json(server.host, server.port, "/metrics"),
+        "server_metrics": (
+            _get_json(*eps[0], "/metrics") if len(eps) == 1 else
+            {"targets": [
+                {"target": f"{h}:{p}", **_get_json(h, p, "/metrics")}
+                for h, p in eps]}),
     }

@@ -39,6 +39,16 @@ Endpoints:
       profile from a LIVE server, no restart. One capture at a time
       (409 while busy); start/stop ride the event stream as
       ``trace_window`` records.
+  ``POST /admin/reload``
+      Zero-downtime weight hot-swap: body ``{"ckpt": "<path>"}`` loads
+      the checkpoint (msgpack or orbax) and swaps it into every replica
+      with NO recompile (AOT programs take params as arguments; the
+      sealed retrace watchdog proves it) while in-flight batches drain
+      on the old params. Returns the swap report (digest, epoch,
+      drained count, swap_ms) — also a ``weight_swap`` event. 400 bad
+      body / unreadable checkpoint, 409 structure mismatch (a tree that
+      would recompile is rejected, never swapped). The ``/healthz``
+      ``weights`` block (path, digest, epoch, swaps) observes the swap.
 """
 
 from __future__ import annotations
@@ -198,6 +208,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "cost_surface": (self.batcher.costing.coverage()
                                  if self.batcher.costing is not None
                                  else None),
+                # Weights provenance (ISSUE 20 satellite): checkpoint
+                # path + params-content digest + epoch + hot-swap count,
+                # so a /admin/reload is observable and test-pinnable.
+                # epoch -1 is the epoch-less sentinel
+                # (engine/checkpoint.load_params): random-init or a
+                # payload written without an epoch field.
+                "weights": self.batcher.engine.weights_info(),
                 "programs": self.batcher.engine.compile_report(),
                 "telemetry": {
                     "events_path": self.events_path or None,
@@ -303,9 +320,60 @@ class _Handler(BaseHTTPRequestHandler):
         if self.metrics is not None and bucket is not None and status == 200:
             self.metrics.record_stages(bucket, trace.stage_durations_ms())
 
+    def _admin_reload(self) -> None:
+        """``POST /admin/reload``: zero-downtime weight hot-swap. The
+        engine does the structural work (drain-aware per-replica pointer
+        swap, signature check); this handler only decodes the body and
+        maps failure classes to status codes."""
+        raw_length = self.headers.get("Content-Length")
+        try:
+            length = int(raw_length if raw_length is not None else "")
+        except ValueError:
+            length = -1
+        if not 0 <= length <= (1 << 20):
+            self.close_connection = True
+            self._reply_error(400, "bad_request",
+                              "missing or invalid Content-Length")
+            return
+        body = self.rfile.read(length)
+        try:
+            doc = json.loads(body or b"{}")
+        except ValueError as e:
+            self._reply_error(400, "bad_request", f"invalid JSON: {e}")
+            return
+        ckpt = doc.get("ckpt") if isinstance(doc, dict) else None
+        if not isinstance(ckpt, str) or not ckpt:
+            self._reply_error(
+                400, "bad_request", "body must carry 'ckpt': <path>")
+            return
+        try:
+            drain_s = float(doc.get("drain_timeout_s", 30.0))
+        except (TypeError, ValueError):
+            self._reply_error(400, "bad_request",
+                              "drain_timeout_s must be a number")
+            return
+        try:
+            report = self.batcher.engine.reload_checkpoint(
+                ckpt, drain_timeout_s=drain_s)
+        except ValueError as e:
+            # Structure/shape/dtype mismatch: swapping would recompile
+            # (or crash mid-dispatch) — rejected, incumbent untouched.
+            self._reply_error(409, "swap_rejected", str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — a handler must answer, not die
+            self._reply_error(
+                400, "bad_request",
+                f"checkpoint unreadable: {type(e).__name__}: {e}")
+            return
+        self._reply_json(200, report)
+
     def do_POST(self):  # noqa: N802 — stdlib handler naming
         self._extra_headers = []
-        if self.path.partition("?")[0] != "/predict":
+        post_path = self.path.partition("?")[0]
+        if post_path == "/admin/reload":
+            self._admin_reload()
+            return
+        if post_path != "/predict":
             # The body is left unread: a reused keep-alive connection
             # would parse it as the next request line, so close.
             self.close_connection = True

@@ -1,0 +1,568 @@
+#!/usr/bin/env python
+"""Fleet chaos-run evidence: lose a backend, hot-swap weights, canary.
+
+Stands up a REAL 2-backend fleet on virtual CPU devices — each backend
+a full ``serve.build_service`` stack (AOT engine, micro-batcher,
+supervisor) on its own ephemeral port — puts the ``fleet.FleetRouter``
+in front, and runs the ISSUE-20 acceptance scenario as four phases
+under the capacity plan's traffic mix (``artifacts/capacity_report.json``
+``per_bucket[].traffic_fraction``, mapped ordinally onto this run's
+buckets):
+
+  1. healthy baseline load through the router;
+  2. backend 1 is shut down MID-LOAD — the router spills its requests
+     to backend 0, the poll loop walks the dead backend to quarantined,
+     every client request still resolves; then the backend rejoins
+     (same engine, same port — zero new compiles) and a probe poll
+     revives it;
+  3. a new checkpoint lands mid-traffic via the router's
+     ``POST /admin/reload`` — the drain-aware pointer swap (AOT
+     programs take params as arguments) changes every backend's weights
+     digest with ZERO recompiles under the sealed retrace watchdog;
+  4. a second checkpoint goes to backend 1 only with ``canary: true`` —
+     the router interleaves a traffic fraction onto it, shadow-mirrors
+     those requests to the incumbent, and the EPE gate renders a
+     verdict against the pinned bounds.
+
+A sampler thread polls the router's ``/healthz`` throughout and checks
+the ledger identity (``requests == responses + rejected + in_flight``)
+at every snapshot. The script REFUSES to write evidence unless every
+acceptance property actually held: all requests resolved, the loss
+phase visibly spilled work, the quarantine and the revival were
+observed, every swap row is 200 with a changed digest, the canary
+verdict exists, the identity held at >= 3 snapshots, and the run made
+ZERO recompiles (events scan AND the watchdog counters).
+
+Committed artifacts (validated by the ``validate-fleet`` /
+``validate-events`` gate stages):
+
+    artifacts/fleet_chaos.json          pvraft_fleet_chaos/v1 with the
+                                        full pvraft_serve_load/v1
+                                        measurement embedded as "load"
+    artifacts/fleet_chaos.events.jsonl  pvraft_events/v1 incl.
+                                        fleet_route / weight_swap /
+                                        canary_verdict
+
+    python scripts/fleet_chaos.py --out artifacts/fleet_chaos.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pvraft_tpu import parse_int_list as _parse_ints  # noqa: E402 — needs the path hack
+
+
+def _traffic_mix(buckets, capacity_path):
+    """The capacity plan's per-bucket fractions, mapped ordinally onto
+    this run's bucket table (the plan prices TPU-scale buckets; the CPU
+    chaos run reuses its SHAPE — which fraction of traffic lands in the
+    n-th bucket — not its absolute sizes)."""
+    rows = []
+    source = None
+    if os.path.exists(capacity_path):
+        with open(capacity_path, encoding="utf-8") as f:
+            per_bucket = json.load(f).get("per_bucket") or []
+        source = capacity_path
+        for j, b in enumerate(buckets):
+            cap = per_bucket[j] if j < len(per_bucket) else {}
+            rows.append({"bucket": int(b),
+                         "fraction": float(cap.get("traffic_fraction", 0.0)),
+                         "capacity_bucket": cap.get("bucket")})
+    else:
+        rows = [{"bucket": int(b), "fraction": 0.0, "capacity_bucket": None}
+                for b in buckets]
+    total = sum(r["fraction"] for r in rows)
+    if total <= 0:
+        for r in rows:
+            r["fraction"] = 1.0 / len(rows)
+    else:
+        for r in rows:
+            r["fraction"] = r["fraction"] / total
+    return rows, source
+
+
+def _phase_counts(mix, n, min_points):
+    """Per-request point counts for one phase of ``n`` requests,
+    apportioned to buckets by the traffic mix (largest-remainder) and
+    interleaved so the mix holds over any prefix, not just the total."""
+    per = [int(r["fraction"] * n) for r in mix]
+    remainders = sorted(range(len(mix)),
+                        key=lambda j: mix[j]["fraction"] * n - per[j],
+                        reverse=True)
+    for j in remainders:
+        if sum(per) >= n:
+            break
+        per[j] += 1
+    points = [max(min_points, int(0.85 * r["bucket"])) for r in mix]
+    counts, remaining = [], list(per)
+    while len(counts) < n:
+        for j in range(len(mix)):
+            if remaining[j] > 0 and len(counts) < n:
+                counts.append(points[j])
+                remaining[j] -= 1
+    return counts
+
+
+class _IdentitySampler(threading.Thread):
+    """Polls the router's /healthz and checks the ledger identity at
+    every snapshot — the artifact's reconciliation block is this
+    thread's observation, not an at-rest afterthought."""
+
+    def __init__(self, host, port, interval_s=0.15):
+        super().__init__(name="fleet-chaos-identity", daemon=True)
+        self.host, self.port, self.interval_s = host, port, interval_s
+        self.snapshots = 0
+        self.violations = []
+        self._halt = threading.Event()
+
+    def run(self):
+        from pvraft_tpu.serve.loadgen import _get_json
+
+        while not self._halt.wait(self.interval_s):
+            try:
+                m = _get_json(self.host, self.port, "/healthz")["metrics"]
+            except (OSError, ValueError, KeyError):
+                continue  # a missed poll proves nothing either way
+            self.snapshots += 1
+            lhs = m["requests_total"]
+            rhs = (m["responses_total"] + sum(m["rejected"].values())
+                   + m["in_flight"])
+            if lhs != rhs:
+                self.violations.append(m)
+
+    def stop(self):
+        self._halt.set()
+        self.join(5.0)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/fleet_chaos.json")
+    ap.add_argument("--events", default="",
+                    help="events path (default: <out stem>.events.jsonl)")
+    ap.add_argument("--capacity", default="artifacts/capacity_report.json")
+    ap.add_argument("--buckets", default="96,128")
+    ap.add_argument("--batch_sizes", default="1")
+    ap.add_argument("--truncate_k", type=int, default=32)
+    ap.add_argument("--graph_k", type=int, default=8)
+    ap.add_argument("--corr_knn", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=24,
+                    help="requests per phase (canary phase doubles it)")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--canary_eps", type=float, default=5e-5,
+                    help="relative perturbation of the canary checkpoint "
+                         "(flips ~1%% of bf16 weight roundings — a "
+                         "candidate the EPE gate should PROMOTE; 8e-4 "
+                         "and up lands past the bound and demonstrates "
+                         "the reject path)")
+    ap.add_argument("--ckpt_dir", default="",
+                    help="where v2/v3 checkpoints go (default: a tmpdir)")
+    args = ap.parse_args()
+
+    from pvraft_tpu.serve.loadgen import force_host_device_count
+
+    force_host_device_count(1)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from pvraft_tpu.config import ModelConfig
+    from pvraft_tpu.engine.checkpoint import SUFFIX, save_checkpoint
+    from pvraft_tpu.fleet import FleetConfig, build_fleet
+    from pvraft_tpu.fleet.artifact import (
+        FLEET_CHAOS_SCHEMA,
+        validate_fleet_artifact,
+    )
+    from pvraft_tpu.models.raft import PVRaft
+    from pvraft_tpu.programs.costs import CostSurface
+    from pvraft_tpu.serve import (
+        InferenceEngine,
+        ServeConfig,
+        ServeTelemetry,
+        build_service,
+    )
+    from pvraft_tpu.serve.loadgen import (
+        SCHEMA_VERSION,
+        _get_json,
+        _post_json,
+        merge_measurements,
+        run_load,
+        validate_load_artifact,
+    )
+    from pvraft_tpu.serve.supervisor import SupervisorConfig
+
+    model = ModelConfig(truncate_k=args.truncate_k, graph_k=args.graph_k,
+                        corr_knn=args.corr_knn)
+    cfg = ServeConfig(model=model, buckets=_parse_ints(args.buckets),
+                      batch_sizes=_parse_ints(args.batch_sizes),
+                      num_iters=args.iters, dtype="bfloat16", replicas=1)
+    sup_cfg = SupervisorConfig(degraded_after=1, quarantine_after=2,
+                               probe_interval_s=0.1)
+    fleet_cfg = FleetConfig(poll_interval_s=0.1, poll_timeout_s=2.0,
+                            degraded_after=1, quarantine_after=2,
+                            retry_after_s=1, predict_timeout_s=60.0,
+                            canary_fraction=0.5, canary_min_samples=6)
+    mix, mix_source = _traffic_mix(cfg.buckets, args.capacity)
+    print(f"[fleet] traffic mix (from {mix_source or 'uniform fallback'}): "
+          + ", ".join(f"{r['bucket']}:{r['fraction']:.2f}" for r in mix),
+          flush=True)
+
+    events_path = args.events or (
+        os.path.splitext(args.out)[0] + ".events.jsonl")
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    if os.path.exists(events_path):
+        os.unlink(events_path)
+    telemetry = ServeTelemetry(events_path, cfg=cfg)
+
+    m = PVRaft(model)
+    rng = np.random.default_rng(args.seed)
+    pc = jax.numpy.asarray(
+        rng.uniform(-1, 1, (1, cfg.buckets[0], 3)).astype(np.float32))
+    params = m.init(jax.random.key(args.seed), pc, pc, 2)
+
+    print(f"[fleet] compiling 2 backends (buckets={cfg.buckets}, "
+          f"batch_sizes={cfg.batch_sizes}, dtype={cfg.dtype})...",
+          flush=True)
+    engines = [InferenceEngine(params, cfg, telemetry=telemetry)
+               for _ in range(2)]
+    servers = []   # every server ever started — watchdog audit at the end
+    backends = []
+    for engine in engines:
+        srv = build_service(engine, max_wait_ms=5, queue_depth=64,
+                            telemetry=telemetry, trace_sample_every=1,
+                            supervisor_cfg=sup_cfg)
+        srv.start()
+        servers.append(srv)
+        backends.append(srv)
+
+    # v2 (fleet-wide rollout) and v3 (canary candidate) checkpoints:
+    # small relative perturbations of the serving weights, so the swap
+    # digests provably change and the canary EPE is a real, nonzero
+    # comparison while staying inside the pinned bounds.
+    ckpt_dir = args.ckpt_dir
+    if not ckpt_dir:
+        import tempfile
+
+        ckpt_dir = tempfile.mkdtemp(prefix="fleet_chaos_ckpt_")
+
+    def perturbed(scale):
+        return jax.tree_util.tree_map(
+            lambda x: x * (1.0 + scale)
+            if hasattr(x, "dtype") and jax.numpy.issubdtype(
+                jax.numpy.asarray(x).dtype, jax.numpy.floating) else x,
+            params)
+
+    ckpts = {}
+    for name, epoch, scale in (("v2", 1, args.canary_eps),
+                               ("v3", 2, 2 * args.canary_eps)):
+        d = os.path.join(ckpt_dir, name)
+        save_checkpoint(d, perturbed(scale), {}, epoch,
+                        checkpoint_interval=0)
+        ckpts[name] = os.path.join(d, "last_checkpoint" + SUFFIX)
+
+    surface = (CostSurface.load() if os.path.exists(
+        os.path.join("artifacts", "programs_costs.json")) else None)
+    router = build_fleet(backends, cfg=fleet_cfg, telemetry=telemetry,
+                         cost_surface=surface)
+    router.start()
+    print(f"[fleet] router on port {router.port} over "
+          f"{[f'{s.host}:{s.port}' for s in backends]}; cost surface "
+          f"{'armed' if surface is not None else 'absent'}", flush=True)
+
+    sampler = _IdentitySampler(router.host, router.port)
+    sampler.start()
+
+    def poll(predicate, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.05)
+        return predicate()
+
+    def backend_state(i):
+        try:
+            doc = _get_json(router.host, router.port, "/healthz")
+            return doc["backends"][i]["state"]
+        except (OSError, ValueError, KeyError, IndexError):
+            return None
+
+    def load_in_thread(n, seed, retries=0):
+        out = {}
+
+        def drive():
+            out["round"] = run_load(
+                None, targets=[router], n_requests=n,
+                concurrency=args.concurrency,
+                point_counts=_phase_counts(mix, n, cfg.min_points),
+                seed=seed, retries=retries)
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        return t, out
+
+    rounds = []
+
+    # Phase 1: healthy baseline through the router.
+    print("[fleet] phase 1: baseline", flush=True)
+    t, out = load_in_thread(args.requests, args.seed)
+    t.join()
+    rounds.append(out["round"])
+
+    # Phase 2: backend 1 dies MID-LOAD; the fleet keeps answering.
+    print("[fleet] phase 2: killing backend 1 mid-load", flush=True)
+    before_loss = router.metrics.snapshot()
+    b1_port = backends[1].port
+    t, out = load_in_thread(args.requests, args.seed + 1, retries=2)
+    mid = before_loss["responses_total"] + max(2, args.requests // 4)
+    poll(lambda: router.metrics.snapshot()["responses_total"] >= mid,
+         timeout=60.0)
+    backends[1].shutdown(drain=True)
+    killed_at_responses = router.metrics.snapshot()["responses_total"]
+    t.join()
+    rounds.append(out["round"])
+    observed = {
+        "quarantined": poll(lambda: backend_state(1) == "quarantined",
+                            timeout=15.0)}
+    after_loss = router.metrics.snapshot()
+    spillovers = (after_loss["spillovers_total"]
+                  - before_loss["spillovers_total"])
+    loss_resolved = out["round"]["requests"]["errors"] == 0
+    print(f"[fleet]   spillovers={spillovers} "
+          f"quarantined={observed['quarantined']} "
+          f"resolved={loss_resolved}", flush=True)
+
+    # Backend 1 rejoins: same engine (already-compiled AOT programs —
+    # nothing recompiles), same port; a probing poll revives it.
+    revived = build_service(engines[1], max_wait_ms=5, queue_depth=64,
+                            telemetry=telemetry, trace_sample_every=1,
+                            supervisor_cfg=sup_cfg, port=b1_port)
+    revived.start()
+    servers.append(revived)
+    backends[1] = revived
+    observed["revived"] = poll(lambda: backend_state(1) == "healthy",
+                               timeout=15.0)
+    print(f"[fleet]   backend 1 rejoined on :{b1_port}; "
+          f"revived={observed['revived']}", flush=True)
+
+    # Phase 3: fleet-wide weight hot-swap lands mid-traffic.
+    print("[fleet] phase 3: hot-swap v2 mid-traffic", flush=True)
+    before_swap = router.metrics.snapshot()
+    t, out = load_in_thread(args.requests, args.seed + 2, retries=1)
+    mid = before_swap["responses_total"] + max(2, args.requests // 4)
+    poll(lambda: router.metrics.snapshot()["responses_total"] >= mid,
+         timeout=60.0)
+    swap = _post_json(router.host, router.port, "/admin/reload",
+                      {"ckpt": ckpts["v2"], "drain_timeout_s": 10.0},
+                      timeout=120.0)
+    t.join()
+    rounds.append(out["round"])
+    swap_rows = (swap["body"] or {}).get("swapped") or []
+    print(f"[fleet]   swap status={swap['status']} rows="
+          + json.dumps([{k: r.get(k) for k in ('backend', 'status')}
+                        for r in swap_rows]), flush=True)
+
+    # Phase 4: canary checkpoint on backend 1, EPE-gated promotion.
+    print("[fleet] phase 4: canary v3 on backend 1", flush=True)
+    canary_swap = _post_json(
+        router.host, router.port, "/admin/reload",
+        {"ckpt": ckpts["v3"], "backend": 1, "canary": True,
+         "drain_timeout_s": 10.0}, timeout=120.0)
+    verdict = None
+    canary_requests = 0
+    for extra_round in range(3):
+        n = 2 * args.requests
+        t, out = load_in_thread(n, args.seed + 3 + extra_round)
+        t.join()
+        rounds.append(out["round"])
+        canary_requests += n
+        verdict = _get_json(router.host, router.port,
+                            "/healthz")["canary"]["verdict"]
+        if verdict is not None:
+            break
+    final = router.metrics.snapshot()
+    print(f"[fleet]   verdict={json.dumps(verdict)}", flush=True)
+
+    sampler.stop()
+    watchdog_trips = sum(s.batcher.metrics.recompiles_total
+                         for s in servers)
+    router.shutdown()
+    for s in backends:
+        s.shutdown(drain=True)
+    telemetry.close()
+
+    with open(events_path, encoding="utf-8") as f:
+        recompiles = sum(1 for line in f if '"recompile"' in line
+                         and json.loads(line)["type"] == "recompile")
+
+    merged = merge_measurements(rounds)
+
+    # --- acceptance gate: refuse to commit evidence that proves nothing.
+    problems = []
+    req = merged["requests"]
+    if req["ok"] + req["rejected"] + req["errors"] != req["total"]:
+        problems.append(f"requests do not reconcile: {req}")
+    if req["errors"]:
+        problems.append(
+            f"{req['errors']} request(s) never resolved (transport "
+            f"errors at the router)")
+    if spillovers <= 0:
+        problems.append("losing a backend mid-load caused no spillover — "
+                        "the loss was not observed under load")
+    if not observed["quarantined"]:
+        problems.append("backend 1 was never quarantined by the poll loop")
+    if not observed["revived"]:
+        problems.append("backend 1 never rejoined the rotation")
+    if not loss_resolved:
+        problems.append("loss-phase requests did not all resolve")
+    if swap["status"] != 200 or not swap_rows or any(
+            r.get("status") != 200 for r in swap_rows):
+        problems.append(f"hot-swap was not clean: {swap}")
+    for r in swap_rows:
+        rep = r.get("report") or {}
+        if not rep.get("digest") or rep.get("digest") == rep.get(
+                "previous_digest"):
+            problems.append(f"swap row {r.get('backend')} shows no digest "
+                            f"change: {rep}")
+    if canary_swap["status"] != 200:
+        problems.append(f"canary swap failed: {canary_swap}")
+    if not isinstance(verdict, dict):
+        problems.append("the canary gate never rendered a verdict")
+    if sampler.snapshots < 3:
+        problems.append(f"only {sampler.snapshots} identity snapshot(s) — "
+                        f"the mid-run identity was not observed")
+    if sampler.violations:
+        problems.append(f"ledger identity BROKE mid-run: "
+                        f"{sampler.violations[0]}")
+    if recompiles:
+        problems.append(f"{recompiles} recompile event(s): the sealed "
+                        "watchdog fired — the swap was not compile-free")
+    if watchdog_trips:
+        problems.append(f"watchdog counted {watchdog_trips} trip(s)")
+    if problems:
+        for p in problems:
+            print(f"[fleet] ACCEPTANCE FAILURE: {p}", file=sys.stderr)
+        return 1
+
+    load_doc = {
+        "schema": SCHEMA_VERSION,
+        "config": {
+            "buckets": list(cfg.buckets),
+            "batch_sizes": list(cfg.batch_sizes),
+            "num_iters": cfg.num_iters,
+            "truncate_k": model.truncate_k,
+            "graph_k": model.graph_k,
+            "corr_knn": model.corr_knn,
+            "compute_dtype": cfg.dtype,
+            "requests": req["total"],
+            "concurrency": args.concurrency,
+            "weights": "random_init (+ perturbed v2/v3 swaps)",
+            "platform": jax.devices()[0].platform,
+            "replicas": 1,
+            "eager_when_idle": True,
+            "targets": [f"{router.host}:{router.port}"],
+        },
+        "compile": [row for e in engines for row in e.compile_report()],
+        **merged,
+    }
+    artifact = {
+        "schema": FLEET_CHAOS_SCHEMA,
+        "config": {
+            "backends": 2,
+            "targets": [f"{s.host}:{s.port}" for s in backends],
+            "router": f"{router.host}:{router.port}",
+            "buckets": list(cfg.buckets),
+            "batch_sizes": list(cfg.batch_sizes),
+            "compute_dtype": cfg.dtype,
+            "replicas_per_backend": 1,
+            "traffic_mix": mix,
+            "traffic_mix_source": (
+                f"{mix_source} per_bucket[].traffic_fraction, mapped "
+                f"ordinally onto this run's buckets" if mix_source
+                else "uniform fallback (no capacity report)"),
+            "fleet": {
+                "poll_interval_s": fleet_cfg.poll_interval_s,
+                "degraded_after": fleet_cfg.degraded_after,
+                "quarantine_after": fleet_cfg.quarantine_after,
+                "retry_after_s": fleet_cfg.retry_after_s,
+                "canary_fraction": fleet_cfg.canary_fraction,
+                "canary_min_samples": fleet_cfg.canary_min_samples,
+                "canary_epe_bound": fleet_cfg.canary_epe_bound,
+                "canary_rel_epe_bound": fleet_cfg.canary_rel_epe_bound,
+                "cost_surface": surface is not None,
+            },
+            "canary_eps": args.canary_eps,
+            "seed": args.seed,
+        },
+        "load": load_doc,
+        "phases": [
+            {"phase": "baseline",
+             "requests": rounds[0]["requests"],
+             "duration_s": rounds[0]["duration_s"]},
+            {"phase": "backend_loss",
+             "killed_backend": 1,
+             "killed_at_responses": killed_at_responses,
+             "spillovers": spillovers,
+             "resolved": loss_resolved,
+             "observed": observed,
+             "requests": rounds[1]["requests"],
+             "retries": 2},
+            {"phase": "hot_swap",
+             "swap": {"ckpt": ckpts["v2"], "swapped": swap_rows},
+             "requests": rounds[2]["requests"]},
+            {"phase": "canary",
+             "swap": {"ckpt": ckpts["v3"],
+                      "swapped": (canary_swap["body"] or {}).get(
+                          "swapped") or []},
+             "verdict": verdict,
+             "requests": {
+                 key: sum(r["requests"][key] for r in rounds[3:])
+                 for key in ("total", "ok", "rejected", "errors")},
+             "canary_served": final["canary_total"],
+             "shadows": final["shadow_total"]},
+        ],
+        "reconciliation": {
+            "holds": not sampler.violations,
+            "snapshots": sampler.snapshots,
+            "final": final,
+        },
+        "recompiles": recompiles,
+        "watchdog_trips": watchdog_trips,
+    }
+
+    schema_problems = (validate_fleet_artifact(artifact, path=args.out)
+                       + validate_load_artifact(load_doc,
+                                                path=f"{args.out}#load"))
+    if schema_problems:
+        for p in schema_problems:
+            print(f"[fleet] SCHEMA PROBLEM: {p}", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"[fleet] wrote {args.out} and {events_path}")
+    print(json.dumps({
+        "ok": req["ok"], "rejected": req["rejected"],
+        "errors": req["errors"], "spillovers": spillovers,
+        "swapped_backends": len(swap_rows),
+        "verdict": verdict["verdict"], "epe": verdict["epe"],
+        "identity_snapshots": sampler.snapshots,
+        "recompiles": recompiles, "watchdog_trips": watchdog_trips,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

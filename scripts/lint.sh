@@ -40,6 +40,7 @@
 #   # gate-stage: artifact-budget
 #   # gate-stage: validate-events
 #   # gate-stage: validate-load
+#   # gate-stage: validate-fleet
 #   # gate-stage: validate-trace
 #   # gate-stage: validate-slo
 #   # gate-stage: validate-profile

@@ -39,6 +39,77 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from pvraft_tpu import parse_int_list as _parse_ints  # noqa: E402 — needs the path hack
 
 
+def _bucket_point_counts(buckets, lo: int) -> list:
+    """Point counts at ~75%/95% of each bucket span (the standing
+    loadgen mix), capped below by the model minimum."""
+    counts = []
+    prev_bucket = 0
+    for b in buckets:
+        span = b - prev_bucket
+        counts.append(max(lo, prev_bucket + int(0.75 * span)))
+        counts.append(max(lo, prev_bucket + int(0.95 * span)))
+        prev_bucket = b
+    return counts
+
+
+def _drive_targets(args) -> int:
+    """Round-robin client over already-running servers (--target): no
+    in-process engine, no jax — the serving geometry and compile report
+    come from the first target's /healthz. Events (and therefore the
+    trace sibling) belong to the target processes, so only the load
+    artifact is written here."""
+    from pvraft_tpu.serve.loadgen import (
+        SCHEMA_VERSION,
+        _endpoints,
+        _get_json,
+        run_load,
+        validate_load_artifact,
+    )
+
+    eps = _endpoints(None, args.target)
+    health = _get_json(*eps[0], "/healthz")
+    counts = _bucket_point_counts(health["buckets"],
+                                  int(health.get("min_points", 1)))
+    print(f"[loadgen] driving {len(eps)} target(s) "
+          f"{['%s:%s' % e for e in eps]}; {args.requests} requests x "
+          f"{args.concurrency} clients", flush=True)
+    measurement = run_load(None, n_requests=args.requests,
+                           concurrency=args.concurrency,
+                           point_counts=counts, seed=args.seed,
+                           retries=args.retries, targets=args.target)
+    artifact = {
+        "schema": SCHEMA_VERSION,
+        "config": {
+            "targets": ["%s:%s" % e for e in eps],
+            "buckets": list(health["buckets"]),
+            "batch_sizes": list(health.get("batch_sizes", [])),
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "point_counts": counts,
+            "retries": args.retries,
+        },
+        "compile": health.get("programs", []),
+        **measurement,
+    }
+    problems = validate_load_artifact(artifact, path=args.out)
+    if problems:
+        for p in problems:
+            print(f"[loadgen] SCHEMA PROBLEM: {p}", file=sys.stderr)
+        return 1
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"[loadgen] wrote {args.out}")
+    print(json.dumps({
+        "ok": artifact["requests"]["ok"],
+        "rejected": artifact["requests"]["rejected"],
+        "p50_ms": artifact["latency_ms"]["p50"],
+        "p99_ms": artifact["latency_ms"]["p99"],
+        "throughput_rps": artifact["throughput_rps"],
+    }))
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="artifacts/serve_cpu_synthetic.json")
@@ -78,7 +149,18 @@ def main() -> int:
                          "attempt recorded in per_request[].attempts). "
                          "Default 0 keeps committed artifacts' exact "
                          "semantics")
+    ap.add_argument("--target", action="append", default=[],
+                    help="drive an ALREADY RUNNING server at host:port "
+                         "instead of standing one up in-process; repeat "
+                         "for several targets (requests round-robin "
+                         "across them — the fleet evidence path). The "
+                         "artifact records config.targets and fetches "
+                         "buckets/compile report from the first "
+                         "target's /healthz")
     args = ap.parse_args()
+
+    if args.target:
+        return _drive_targets(args)
 
     # Virtual device count must land before the backend initializes
     # (loadgen.py is jax-free at import time, so this is safe here).
@@ -153,14 +235,7 @@ def main() -> int:
     # Point counts spread across the buckets: ~75% and ~95% of each
     # bucket's capacity (capped below by the model minimum), so both the
     # padding machinery and the bucket router are exercised.
-    counts = []
-    lo = engine.cfg.min_points
-    prev_bucket = 0
-    for b in cfg.buckets:
-        span = b - prev_bucket
-        counts.append(max(lo, prev_bucket + int(0.75 * span)))
-        counts.append(max(lo, prev_bucket + int(0.95 * span)))
-        prev_bucket = b
+    counts = _bucket_point_counts(cfg.buckets, engine.cfg.min_points)
 
     measurement = run_load(server, n_requests=args.requests,
                            concurrency=args.concurrency,

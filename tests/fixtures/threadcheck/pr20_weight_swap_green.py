@@ -1,0 +1,42 @@
+"""CURRENT shape of the ISSUE-20 weight-swap dispatch path (clean).
+
+The swap's exclusivity check, pointer write and generation bump are
+ONE critical section under the replica lock, and a dispatch's
+params-pointer read + in-flight registration are another — a
+concurrent swap either sees the dispatch registered (and drains it on
+the OLD params) or the dispatch starts after the swap and runs wholly
+on the NEW params. No torn view, no drain barrier passing while a
+batch still holds retired weights; the old params object stays
+referenced by in-flight calls until their ``finally`` runs. AOT
+programs take params as a call argument, so the swap never recompiles
+— the sealed RetraceWatchdog proves it.
+"""
+
+import threading
+
+
+class Replica:
+    def __init__(self, params):
+        self._lock = threading.Lock()
+        self.params = params
+        self.generation = 0
+        self.in_flight = 0
+
+    def swap_params(self, params):
+        with self._lock:
+            self.params = params
+            self.generation += 1
+
+    def drained(self):
+        with self._lock:
+            return self.in_flight == 0
+
+    def dispatch(self, batch, run):
+        with self._lock:
+            params = self.params       # pointer read + registration:
+            self.in_flight += 1        # one lock hold, never torn
+        try:
+            return run(params, batch)
+        finally:
+            with self._lock:
+                self.in_flight -= 1

@@ -1,0 +1,44 @@
+"""PRE-fix shape of the ISSUE-20 concurrent weight-swap race
+(detected: GC003).
+
+The fleet router fans ``/admin/reload`` out to every backend, and two
+reloads can land on the same replica concurrently (an operator retry
+racing the fleet sweep). The naive swap tests ``self._swap_pending``
+for exclusivity and assigns it later with no lock — both callers pass
+the check, their pointer writes and generation bumps interleave, and
+the drain barrier then waits against the WRONG generation: it reports
+"drained" while a dispatch still runs on weights the first swap
+claims retired. Found during the design review of
+``serve/engine.py``'s ``swap_params``; the shipped shape runs the
+exclusivity check, the pointer write and the generation bump as one
+critical section under the replica lock.
+"""
+
+import threading
+
+
+class Replica:
+    def __init__(self, params):
+        self._lock = threading.Lock()
+        self._swap_pending = None
+        self.params = params
+        self.generation = 0
+        self.in_flight = 0
+
+    def swap_params(self, params):
+        if self._swap_pending is not None:   # check...
+            raise RuntimeError("a swap is already in flight")
+        self._swap_pending = params          # ...then act, no lock held
+        self.params = self._swap_pending
+        self.generation += 1
+        self._swap_pending = None
+
+    def dispatch(self, batch, run):
+        with self._lock:
+            params = self.params
+            self.in_flight += 1
+        try:
+            return run(params, batch)
+        finally:
+            with self._lock:
+                self.in_flight -= 1

@@ -306,6 +306,9 @@ class _Engine:
     def compile_report(self):
         return []
 
+    def weights_info(self):
+        return {"path": "", "digest": "fake", "epoch": -1, "swaps": 0}
+
 
 def _fake_service(tmp_path, supervisor_cfg=TIGHT, queue_depth=16,
                   **kw):

@@ -225,6 +225,9 @@ class _PoolFakeEngine:
     def compile_report(self):
         return []
 
+    def weights_info(self):
+        return {"path": "", "digest": "fake", "epoch": -1, "swaps": 0}
+
 
 def _pc(n, seed=0):
     return np.random.default_rng(seed).uniform(

@@ -342,6 +342,12 @@ CORPUS = {
     # assigned it with no lock — two concurrent installers both pass the
     # exclusivity check (design-review find, serve/faults.py).
     "pr13_fault_install": "GC003",
+    # ISSUE 20: the naive weight hot-swap tested _swap_pending for
+    # exclusivity and assigned it with no lock — two concurrent
+    # /admin/reload fan-outs both pass, interleaving pointer writes and
+    # generation bumps so the drain barrier waits against the wrong
+    # generation (design-review find, serve/engine.py swap_params).
+    "pr20_weight_swap": "GC003",
 }
 
 
